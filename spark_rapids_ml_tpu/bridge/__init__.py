@@ -1,0 +1,22 @@
+"""Columnar data plane: host columnar batches <-> device arrays.
+
+TPU-native replacement for the reference's L1 GPU data plane — cuDF LIST
+columns delivered by spark-rapids' ``ColumnarRdd`` and accessed zero-copy via
+``cudf::lists_column_view::child()`` (reference rapidsml_jni.cu:80-81,114-115).
+Here the host columnar format is Apache Arrow; ``arrow.py`` converts Arrow
+list columns to contiguous ``(n, d)`` matrices (zero-copy for
+``fixed_size_list`` of primitives), and ``native.py`` loads an optional C++
+fast path for the ragged-list flatten/cast that cannot be zero-copied.
+"""
+
+from spark_rapids_ml_tpu.bridge.arrow import (
+    list_column_to_matrix,
+    matrix_to_list_column,
+    table_column_to_matrix,
+)
+
+__all__ = [
+    "list_column_to_matrix",
+    "matrix_to_list_column",
+    "table_column_to_matrix",
+]

@@ -1,0 +1,132 @@
+"""Arrow list column <-> contiguous (n, d) matrix conversion.
+
+Replaces the reference's cuDF LIST-column data path: the reference reads
+training rows as a device-resident LIST column and grabs the flat child
+buffer zero-copy (``lists_column_view(A).child()``, rapidsml_jni.cu:114-115),
+and produces transform output as a new LIST column built from a flat result
+buffer plus a stride-k offsets sequence (``cudf::sequence`` +
+``make_lists_column``, rapidsml_jni.cu:98-106).
+
+Arrow equivalents here:
+
+* ``fixed_size_list<float32/float64>`` → zero-copy reshape of the child
+  values buffer (the fast path; this is what a well-configured Spark→Arrow
+  exporter produces for ML vectors).
+* ragged ``list``/``large_list`` → validated gather into a contiguous matrix
+  (native C++ threaded path when available, NumPy otherwise). Rows must all
+  have width d; nulls are rejected — same constraint the reference's GEMM
+  silently assumes of its input.
+* matrix → ``fixed_size_list`` column for transform output, zero-copy over
+  the result buffer (the make_lists_column equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover - pyarrow is expected in this image
+    pa = None
+
+_FLOAT_TYPES = ("float", "double", "halffloat")
+
+
+def _require_pa():
+    if pa is None:
+        raise ImportError("pyarrow is required for the Arrow columnar bridge")
+
+
+def list_column_to_matrix(col, n_cols: Optional[int] = None) -> np.ndarray:
+    """Convert an Arrow (Chunked)Array of list type to an (n, d) ndarray.
+
+    Zero-copy when the input is a fixed_size_list of float32/float64 with no
+    nulls and an unsliced contiguous child buffer.
+    """
+    _require_pa()
+    if isinstance(col, pa.ChunkedArray):
+        if col.num_chunks == 1:
+            return _array_to_matrix(col.chunk(0), n_cols)
+        mats = [_array_to_matrix(c, n_cols) for c in col.chunks if len(c)]
+        if not mats:
+            return np.empty((0, n_cols or 0))
+        return np.concatenate(mats, axis=0)
+    return _array_to_matrix(col, n_cols)
+
+
+def _array_to_matrix(arr, n_cols: Optional[int]) -> np.ndarray:
+    if arr.null_count:
+        raise ValueError("list column contains nulls; expected dense vectors")
+    t = arr.type
+    if pa.types.is_fixed_size_list(t):
+        d = t.list_size
+        if n_cols is not None and d != n_cols:
+            raise ValueError(f"fixed_size_list width {d} != expected {n_cols}")
+        # flatten() accounts for slicing (arr.values would return the full
+        # unsliced child buffer and misalign sliced arrays).
+        flat = arr.flatten()
+        if flat.null_count:
+            raise ValueError("list column contains null elements; expected dense vectors")
+        vals = flat.to_numpy(zero_copy_only=flat.null_count == 0)
+        return vals.reshape(len(arr), d)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        offsets = np.asarray(arr.offsets)
+        # arr.values of a sliced list array is the *unsliced* child; index via
+        # offsets which are absolute into it.
+        child = arr.values
+        if child.null_count:
+            # Only reject nulls inside this array's extent.
+            window = child.slice(int(offsets[0]), int(offsets[-1]) - int(offsets[0]))
+            if window.null_count:
+                raise ValueError(
+                    "list column contains null elements; expected dense vectors"
+                )
+        vals = child.to_numpy(zero_copy_only=child.null_count == 0)
+        widths = np.diff(offsets)
+        if len(widths) == 0:
+            return np.empty((0, n_cols or 0), dtype=vals.dtype)
+        d = int(widths[0]) if n_cols is None else n_cols
+        if not np.all(widths == d):
+            raise ValueError("ragged list column: rows have differing lengths")
+        # Uniform widths imply the window [offsets[0], offsets[-1]) is exactly
+        # len(arr)*d contiguous values — reshape is a view, no copy.
+        start, stop = int(offsets[0]), int(offsets[-1])
+        return vals[start:stop].reshape(len(arr), d)
+    raise TypeError(f"unsupported Arrow type for vector column: {t}")
+
+
+def table_column_to_matrix(table, name: str, n_cols: Optional[int] = None) -> np.ndarray:
+    """Extract column ``name`` of an Arrow Table as an (n, d) matrix."""
+    _require_pa()
+    if name not in table.column_names:
+        raise KeyError(f"column {name!r} not in table (have {table.column_names})")
+    return list_column_to_matrix(table.column(name), n_cols)
+
+
+def matrix_to_list_column(mat: np.ndarray):
+    """Wrap an (n, d) ndarray as an Arrow fixed_size_list array, zero-copy.
+
+    Equivalent of the reference's output construction: flat GEMM result +
+    stride-d offsets → LIST column (rapidsml_jni.cu:98-106). fixed_size_list
+    needs no offsets buffer at all — strictly less work than the reference.
+    """
+    _require_pa()
+    mat = np.ascontiguousarray(mat)
+    n, d = mat.shape
+    flat = pa.array(mat.reshape(-1))
+    return pa.FixedSizeListArray.from_arrays(flat, d)
+
+
+def matrix_from_any(col) -> Tuple[np.ndarray, int]:
+    """Best-effort conversion of a column-of-vectors in any host format."""
+    if pa is not None and isinstance(col, (pa.Array, pa.ChunkedArray)):
+        m = list_column_to_matrix(col)
+        return m, m.shape[1]
+    arr = np.asarray(col)
+    if arr.dtype == object:
+        arr = np.stack([np.asarray(r) for r in arr])
+    if arr.ndim != 2:
+        raise ValueError(f"expected 2-D vector column, got shape {arr.shape}")
+    return arr, arr.shape[1]

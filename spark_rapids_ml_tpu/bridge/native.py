@@ -1,0 +1,127 @@
+"""ctypes loader for the native columnar library (libsrml_tpu.so).
+
+The reference packages its native library inside the jar and extracts it at
+first use (JniRAPIDSML.java:34-58). Here the .so is built from
+``native/src/columnar.cpp`` (``make -C native``) and looked up next to the
+package and in the repo's ``native/build`` dir; if absent or disabled via
+config ``use_native_bridge``, callers fall back to the pure-NumPy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu import config
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+_SO_NAME = "libsrml_tpu.so"
+
+
+def _candidate_paths():
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return [
+        # Explicit config wins over discovery.
+        os.environ.get("SRML_TPU_NATIVE_LIB", ""),
+        os.path.join(here, _SO_NAME),
+        os.path.join(repo, "native", "build", _SO_NAME),
+    ]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load and memoize the native library; None if unavailable/disabled."""
+    global _lib, _lib_tried
+    if not config.get("use_native_bridge"):
+        return None
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        for path in _candidate_paths():
+            if path and os.path.exists(path):
+                try:
+                    lib = ctypes.CDLL(path)
+                    _configure(lib)
+                    _lib = lib
+                    break
+                except OSError:
+                    continue
+        return _lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    c_i64 = ctypes.c_int64
+    c_p = ctypes.c_void_p
+    # int srml_flatten_list_f64(const double* values, const int64_t* offsets,
+    #                           int64_t n_rows, int64_t n_cols, double* out,
+    #                           int n_threads)
+    lib.srml_flatten_list_f64.restype = ctypes.c_int
+    lib.srml_flatten_list_f64.argtypes = [c_p, c_p, c_i64, c_i64, c_p, ctypes.c_int]
+    lib.srml_flatten_list_f32.restype = ctypes.c_int
+    lib.srml_flatten_list_f32.argtypes = [c_p, c_p, c_i64, c_i64, c_p, ctypes.c_int]
+    # int srml_cast_f64_to_f32(const double* src, int64_t n, float* dst, int n_threads)
+    lib.srml_cast_f64_to_f32.restype = ctypes.c_int
+    lib.srml_cast_f64_to_f32.argtypes = [c_p, c_i64, c_p, ctypes.c_int]
+
+
+def _nthreads() -> int:
+    return min(16, os.cpu_count() or 1)
+
+
+def flatten_ragged(values: np.ndarray, offsets: np.ndarray, n_cols: int) -> Optional[np.ndarray]:
+    """Native gather of a ragged list column into an (n_rows, n_cols) matrix.
+
+    ``values`` is the flat child buffer, ``offsets`` the (n_rows+1,) int64
+    offsets. Every row must have exactly ``n_cols`` elements (validated
+    natively; returns None to signal fallback on any error).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_rows = len(offsets) - 1
+    if values.dtype == np.float64:
+        fn = lib.srml_flatten_list_f64
+        out = np.empty((n_rows, n_cols), dtype=np.float64)
+    elif values.dtype == np.float32:
+        fn = lib.srml_flatten_list_f32
+        out = np.empty((n_rows, n_cols), dtype=np.float32)
+    else:
+        return None
+    values = np.ascontiguousarray(values)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    rc = fn(
+        values.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        n_rows,
+        n_cols,
+        out.ctypes.data_as(ctypes.c_void_p),
+        _nthreads(),
+    )
+    if rc != 0:
+        return None
+    return out
+
+
+def cast_f64_to_f32(src: np.ndarray) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None or src.dtype != np.float64:
+        return None
+    src = np.ascontiguousarray(src)
+    dst = np.empty(src.shape, dtype=np.float32)
+    rc = lib.srml_cast_f64_to_f32(
+        src.ctypes.data_as(ctypes.c_void_p),
+        src.size,
+        dst.ctypes.data_as(ctypes.c_void_p),
+        _nthreads(),
+    )
+    if rc != 0:
+        return None
+    return dst

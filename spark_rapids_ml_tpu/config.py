@@ -1,0 +1,98 @@
+"""Runtime configuration (tier-2 flags).
+
+The reference has a three-tier config system (SURVEY.md §5): algorithm params
+(Spark ML Params — see core/params.py), runtime/cluster flags (Spark conf keys
+like ``spark.rapids.sql.enabled``), and build flags. This module is the tier-2
+equivalent: process-wide runtime knobs, settable programmatically or via
+environment variables prefixed ``SRML_TPU_``.
+
+Reference citations: spark conf tier at README.md:103-113 and
+RapidsMLTest.scala:23-25 in /root/reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+def _env(name: str, default: Any, cast: Callable[[str], Any]) -> Any:
+    raw = os.environ.get(f"SRML_TPU_{name}")
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def _as_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_DEFAULTS: Dict[str, Any] = {
+    # Master switch, analogous to spark.rapids.sql.enabled: when False all
+    # estimators run their host (numpy) fallback path.
+    "enabled": _env("ENABLED", True, _as_bool),
+    # Accumulation dtype for Gram/centroid reductions. float64 gives parity
+    # with the reference's double-precision cuBLAS path; float32 is the fast
+    # TPU-native mode (MXU). (SURVEY.md §7 hard part (c).)
+    "accum_dtype": _env("ACCUM_DTYPE", "float32", str),
+    # Compute dtype for the big GEMMs; bfloat16 engages the MXU at full rate.
+    "compute_dtype": _env("COMPUTE_DTYPE", "float32", str),
+    # Default mesh axis sizes; None = use all local devices on the data axis.
+    "mesh_data_axis": _env("MESH_DATA_AXIS", None, int),
+    "mesh_model_axis": _env("MESH_MODEL_AXIS", 1, int),
+    # Max rows per device batch when streaming host data to device.
+    "stream_batch_rows": _env("STREAM_BATCH_ROWS", 1 << 20, int),
+    # Use the native C++ columnar bridge if the shared library is present.
+    "use_native_bridge": _env("USE_NATIVE_BRIDGE", True, _as_bool),
+    # Emit profiler trace annotations (NVTX-range equivalent; SURVEY.md §5).
+    "tracing": _env("TRACING", False, _as_bool),
+    # Use Pallas kernels for hot ops (Gram, pairwise distance) on TPU.
+    "use_pallas": _env("USE_PALLAS", False, _as_bool),
+}
+
+_lock = threading.Lock()
+_conf: Dict[str, Any] = dict(_DEFAULTS)
+
+
+def get(key: str) -> Any:
+    """Get a runtime config value."""
+    with _lock:
+        if key not in _conf:
+            raise KeyError(f"unknown config key: {key!r} (known: {sorted(_conf)})")
+        return _conf[key]
+
+
+def set(key: str, value: Any) -> None:  # noqa: A003 - mirrors SparkConf.set
+    """Set a runtime config value."""
+    with _lock:
+        if key not in _conf:
+            raise KeyError(f"unknown config key: {key!r} (known: {sorted(_conf)})")
+        _conf[key] = value
+
+
+def reset() -> None:
+    """Restore defaults (mainly for tests)."""
+    with _lock:
+        _conf.clear()
+        _conf.update(_DEFAULTS)
+
+
+class option:
+    """Context manager to temporarily override a config value."""
+
+    def __init__(self, key: str, value: Any):
+        self._key = key
+        self._value = value
+        self._saved: Optional[Any] = None
+
+    def __enter__(self) -> "option":
+        self._saved = get(self._key)
+        set(self._key, self._value)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        set(self._key, self._saved)

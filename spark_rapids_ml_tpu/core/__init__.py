@@ -1,0 +1,65 @@
+"""Core framework layer: Spark-ML-contract params/estimators, dataset
+abstraction, and model persistence.
+
+This is the TPU build's equivalent of the reference's L5/L6 layers
+(SURVEY.md §1): the Estimator/Model/Params machinery of
+``org.apache.spark.ml`` that RapidsPCA.scala plugs into.
+"""
+
+from spark_rapids_ml_tpu.core.params import (
+    Param,
+    Params,
+    Estimator,
+    Model,
+    TypeConverters,
+    HasInputCol,
+    HasOutputCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasFeaturesCol,
+    HasSeed,
+    HasTol,
+    HasMaxIter,
+    HasRegParam,
+    HasElasticNetParam,
+    HasFitIntercept,
+)
+from spark_rapids_ml_tpu.core.dataset import (
+    as_matrix,
+    as_column,
+    with_column,
+    num_rows,
+)
+from spark_rapids_ml_tpu.core.persistence import (
+    DefaultParamsWriter,
+    DefaultParamsReader,
+    MLWriter,
+    MLReader,
+)
+
+__all__ = [
+    "Param",
+    "Params",
+    "Estimator",
+    "Model",
+    "TypeConverters",
+    "HasInputCol",
+    "HasOutputCol",
+    "HasLabelCol",
+    "HasPredictionCol",
+    "HasFeaturesCol",
+    "HasSeed",
+    "HasTol",
+    "HasMaxIter",
+    "HasRegParam",
+    "HasElasticNetParam",
+    "HasFitIntercept",
+    "as_matrix",
+    "as_column",
+    "with_column",
+    "num_rows",
+    "DefaultParamsWriter",
+    "DefaultParamsReader",
+    "MLWriter",
+    "MLReader",
+]

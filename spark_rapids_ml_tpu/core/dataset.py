@@ -1,0 +1,126 @@
+"""Dataset abstraction: column access over host data containers.
+
+The reference consumes Spark DataFrames with an ``ArrayType`` vector column
+(README.md:26-37 — the API change vs. stock Spark ML, which uses ``Vector``).
+This framework is host-framework-agnostic: estimators address columns by name
+over any of
+
+* ``pyarrow.Table`` / ``pyarrow.RecordBatch`` (the columnar interchange
+  format a Spark executor ships to a TPU host — list column = ArrayType),
+* ``pandas.DataFrame`` (vector column = column of array-likes, or 2-D),
+* ``dict`` of name → array,
+* bare ``numpy.ndarray`` (2-D; column names ignored — the "matrix in hand"
+  path used by tests and the pure-JAX API).
+
+``with_column`` returns the same container kind with the output column
+appended, mirroring ``df.withColumn(outputCol, ...)`` (RapidsPCA.scala:165).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+try:
+    import pandas as pd
+except ImportError:  # pragma: no cover
+    pd = None
+
+from spark_rapids_ml_tpu.bridge import arrow as _arrow_bridge
+
+
+def _is_arrow(dataset: Any) -> bool:
+    return pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch))
+
+
+def _is_pandas(dataset: Any) -> bool:
+    return pd is not None and isinstance(dataset, pd.DataFrame)
+
+
+def num_rows(dataset: Any) -> int:
+    if _is_arrow(dataset):
+        return dataset.num_rows
+    if _is_pandas(dataset):
+        return len(dataset)
+    if isinstance(dataset, dict):
+        if not dataset:
+            return 0
+        return len(next(iter(dataset.values())))
+    arr = np.asarray(dataset)
+    return arr.shape[0]
+
+
+def as_matrix(dataset: Any, col: Optional[str] = None, n_cols: Optional[int] = None) -> np.ndarray:
+    """Extract a column of fixed-width vectors as an (n, d) ndarray."""
+    if _is_arrow(dataset):
+        assert col is not None, "column name required for Arrow datasets"
+        if isinstance(dataset, pa.RecordBatch):
+            dataset = pa.Table.from_batches([dataset])
+        return _arrow_bridge.table_column_to_matrix(dataset, col, n_cols)
+    if _is_pandas(dataset):
+        assert col is not None, "column name required for pandas datasets"
+        series = dataset[col]
+        mat, _ = _arrow_bridge.matrix_from_any(series.to_numpy())
+        return mat
+    if isinstance(dataset, dict):
+        assert col is not None, "column name required for dict datasets"
+        mat, _ = _arrow_bridge.matrix_from_any(dataset[col])
+        return mat
+    arr = np.asarray(dataset)
+    if arr.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix dataset, got shape {arr.shape}")
+    return arr
+
+
+def as_column(dataset: Any, col: str) -> np.ndarray:
+    """Extract a scalar column (labels, weights) as a 1-D ndarray."""
+    if _is_arrow(dataset):
+        if isinstance(dataset, pa.RecordBatch):
+            dataset = pa.Table.from_batches([dataset])
+        return np.asarray(dataset.column(col))
+    if _is_pandas(dataset):
+        return dataset[col].to_numpy()
+    if isinstance(dataset, dict):
+        return np.asarray(dataset[col])
+    raise TypeError(
+        f"cannot extract named column {col!r} from a bare array dataset; "
+        "pass a dict/arrow/pandas container"
+    )
+
+
+def with_column(dataset: Any, name: str, values: np.ndarray) -> Any:
+    """Return the dataset with ``values`` appended as column ``name``.
+
+    2-D values become a vector column in the container's native vector
+    representation (Arrow fixed_size_list / pandas object column of arrays).
+    """
+    values = np.asarray(values)
+    if _is_arrow(dataset):
+        if isinstance(dataset, pa.RecordBatch):
+            dataset = pa.Table.from_batches([dataset])
+        if values.ndim == 2:
+            col = _arrow_bridge.matrix_to_list_column(values)
+        else:
+            col = pa.array(values)
+        if name in dataset.column_names:
+            dataset = dataset.drop_columns([name])
+        return dataset.append_column(name, col)
+    if _is_pandas(dataset):
+        out = dataset.copy()
+        if values.ndim == 2:
+            out[name] = list(values)
+        else:
+            out[name] = values
+        return out
+    if isinstance(dataset, dict):
+        out = dict(dataset)
+        out[name] = values
+        return out
+    # Bare ndarray in, bare ndarray out (the pure-matrix API).
+    return values

@@ -1,0 +1,193 @@
+"""Model/estimator persistence: params JSON + data Parquet.
+
+Reproduces the Spark ML on-disk contract the reference uses
+(RapidsPCA.scala:193-228 — ``DefaultParamsWriter.saveMetadata`` + a
+single-partition Parquet ``data`` dir; reload via ``loadMetadata`` +
+``getAndSetParams``):
+
+    path/
+      metadata/part-00000     <- one JSON object (class, uid, params, defaults)
+      data/part-00000.parquet <- model payload (fitted arrays), when a Model
+
+A model saved by this framework is layout-compatible in spirit: params land
+in the same metadata JSON shape (``class``/``timestamp``/``uid``/``paramMap``/
+``defaultParamMap``) so tooling that inspects Spark ML metadata can read it.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+except ImportError:  # pragma: no cover
+    pa = None
+    pq = None
+
+
+def _json_default(value: Any):
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    raise TypeError(f"not JSON-serializable: {type(value)}")
+
+
+class MLWriter:
+    """write() handle: ``model.write().overwrite().save(path)``."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "MLWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        if os.path.exists(path):
+            if not self._overwrite:
+                raise FileExistsError(
+                    f"path {path} already exists; use write().overwrite().save()"
+                )
+            import shutil
+
+            shutil.rmtree(path)
+        os.makedirs(path)
+        DefaultParamsWriter.save_metadata(self._instance, path)
+        payload = getattr(self._instance, "_model_data", None)
+        if callable(payload):
+            data = payload()
+            if data:
+                _write_data(path, data)
+
+
+class MLReader:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def load(self, path: str):
+        return DefaultParamsReader.load_instance(path, expected_cls=self._cls)
+
+
+def _write_data(path: str, data: Dict[str, np.ndarray]) -> None:
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    if pa is not None:
+        # Arrays stored as single-row table: each fitted tensor is one cell
+        # (list for 1-D, list-of-list kept flat + shape column for >=2-D).
+        cols: Dict[str, Any] = {}
+        shapes: Dict[str, Any] = {}
+        for name, arr in data.items():
+            arr = np.asarray(arr)
+            shapes[name] = list(arr.shape)
+            cols[name] = [arr.reshape(-1).tolist()]
+        cols["__shapes__"] = [json.dumps(shapes)]
+        table = pa.table(cols)
+        pq.write_table(table, os.path.join(data_dir, "part-00000.parquet"))
+    else:  # pragma: no cover - numpy fallback
+        np.savez(os.path.join(data_dir, "part-00000.npz"), **data)
+
+
+def _read_data(path: str) -> Optional[Dict[str, np.ndarray]]:
+    data_dir = os.path.join(path, "data")
+    if not os.path.isdir(data_dir):
+        return None
+    pq_path = os.path.join(data_dir, "part-00000.parquet")
+    if pa is not None and os.path.exists(pq_path):
+        table = pq.read_table(pq_path)
+        shapes = json.loads(table.column("__shapes__")[0].as_py())
+        out = {}
+        for name, shape in shapes.items():
+            flat = np.asarray(table.column(name)[0].as_py(), dtype=np.float64)
+            out[name] = flat.reshape(shape)
+        return out
+    npz_path = os.path.join(data_dir, "part-00000.npz")  # pragma: no cover
+    if os.path.exists(npz_path):  # pragma: no cover
+        with np.load(npz_path) as z:
+            return {k: z[k] for k in z.files}
+    return None
+
+
+class DefaultParamsWriter:
+    @staticmethod
+    def save_metadata(instance, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
+        cls = type(instance)
+        meta = {
+            "class": f"{cls.__module__}.{cls.__qualname__}",
+            "timestamp": int(time.time() * 1000),
+            "sparkVersion": "tpu-native",
+            "uid": instance.uid,
+            "paramMap": {p.name: v for p, v in instance._paramMap.items()},
+            "defaultParamMap": {p.name: v for p, v in instance._defaultParamMap.items()},
+        }
+        if extra:
+            meta.update(extra)
+        meta_dir = os.path.join(path, "metadata")
+        os.makedirs(meta_dir, exist_ok=True)
+        with open(os.path.join(meta_dir, "part-00000"), "w") as f:
+            json.dump(meta, f, default=_json_default)
+        # Spark writes an empty _SUCCESS marker per saved dir.
+        open(os.path.join(meta_dir, "_SUCCESS"), "w").close()
+
+
+class DefaultParamsReader:
+    @staticmethod
+    def load_metadata(path: str) -> Dict[str, Any]:
+        with open(os.path.join(path, "metadata", "part-00000")) as f:
+            return json.load(f)
+
+    @staticmethod
+    def load_instance(path: str, expected_cls=None):
+        meta = DefaultParamsReader.load_metadata(path)
+        module_name, _, cls_name = meta["class"].rpartition(".")
+        module = importlib.import_module(module_name)
+        cls = getattr(module, cls_name)
+        if expected_cls is not None and not issubclass(cls, expected_cls):
+            raise TypeError(
+                f"saved class {meta['class']} is not a {expected_cls.__name__}"
+            )
+        data = _read_data(path)
+        if data is not None and hasattr(cls, "_from_model_data"):
+            instance = cls._from_model_data(meta["uid"], data)
+        else:
+            instance = cls(uid=meta["uid"]) if cls._accepts_uid() else cls()
+            instance.uid = meta["uid"]
+        for name, value in meta.get("defaultParamMap", {}).items():
+            if instance.hasParam(name):
+                instance.setDefault(**{name: value})
+        for name, value in meta.get("paramMap", {}).items():
+            if instance.hasParam(name):
+                instance._set(**{name: value})
+        return instance
+
+
+class MLWritable:
+    """Mixin: DefaultParamsWritable equivalent (RapidsPCA.scala:53,182)."""
+
+    def write(self) -> MLWriter:
+        return MLWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+
+class MLReadable:
+    """Mixin: DefaultParamsReadable equivalent (RapidsPCA.scala:90,205)."""
+
+    @classmethod
+    def read(cls) -> MLReader:
+        return MLReader(cls)
+
+    @classmethod
+    def load(cls, path: str):
+        return cls.read().load(path)

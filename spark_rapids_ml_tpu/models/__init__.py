@@ -1,0 +1,8 @@
+"""Model families (BASELINE.json north-star set).
+
+Each model follows the frame established by PCA — the reference's
+architecture generalized (SURVEY.md §7 step 6): a pure-JAX sharded
+"partition kernel + psum + finalize" core, wrapped by a Spark-ML-contract
+Estimator/Model pair. "Each is new partition-kernel + new finalize; the
+frame is fixed."
+"""

@@ -1,0 +1,11 @@
+"""KMeans — placeholder, implemented in the breadth pass."""
+
+from spark_rapids_ml_tpu.core.params import Estimator, Model
+
+
+class KMeans(Estimator):
+    _uid_prefix = "KMeans"
+
+
+class KMeansModel(Model):
+    _uid_prefix = "KMeansModel"

@@ -1,0 +1,19 @@
+"""Nearest neighbors — placeholder, implemented in the breadth pass."""
+
+from spark_rapids_ml_tpu.core.params import Estimator, Model
+
+
+class NearestNeighbors(Estimator):
+    _uid_prefix = "NearestNeighbors"
+
+
+class NearestNeighborsModel(Model):
+    _uid_prefix = "NearestNeighborsModel"
+
+
+class ApproximateNearestNeighbors(Estimator):
+    _uid_prefix = "ApproximateNearestNeighbors"
+
+
+class ApproximateNearestNeighborsModel(Model):
+    _uid_prefix = "ApproximateNearestNeighborsModel"

@@ -1,0 +1,11 @@
+"""LinearRegression — placeholder, implemented in the breadth pass."""
+
+from spark_rapids_ml_tpu.core.params import Estimator, Model
+
+
+class LinearRegression(Estimator):
+    _uid_prefix = "LinearRegression"
+
+
+class LinearRegressionModel(Model):
+    _uid_prefix = "LinearRegressionModel"

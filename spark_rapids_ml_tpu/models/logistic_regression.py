@@ -1,0 +1,11 @@
+"""LogisticRegression — placeholder, implemented in the breadth pass."""
+
+from spark_rapids_ml_tpu.core.params import Estimator, Model
+
+
+class LogisticRegression(Estimator):
+    _uid_prefix = "LogisticRegression"
+
+
+class LogisticRegressionModel(Model):
+    _uid_prefix = "LogisticRegressionModel"

@@ -1,0 +1,359 @@
+"""Principal Component Analysis — the reference's one shipped algorithm,
+rebuilt TPU-native.
+
+Reference call stack being replaced (SURVEY.md §3.1):
+``com.nvidia.spark.ml.feature.PCA.fit`` (PCA.scala:27-37) →
+``RapidsPCA.fit`` (RapidsPCA.scala:72-80) →
+``RapidsRowMatrix.computePrincipalComponentsAndExplainedVariance``
+(RapidsRowMatrix.scala:59-102): per-partition cuBLAS Gram (dgemmCov) →
+JVM ``RDD.reduce`` → single-GPU cuSOLVER eig (calSVD) → top-k slice.
+
+Here the whole fit is ONE compiled SPMD program: row-sharded fused stats
+(count/Σx/XᵀX) → ``psum`` over ICI → eigh + sign-flip + slice on device.
+No host round-trip between phases, no per-call device context setup
+(the anti-pattern noted at SURVEY.md §3.4), and mean-centering is fused
+(fixing the reference's ETL-preprocess stub, SURVEY.md §2.4).
+
+Transform matches ``RapidsPCAModel.transform`` (RapidsPCA.scala:122-166):
+y = x @ pc with NO re-centering (the reference's CPU fallback is
+``pc.transpose.multiply(v)``, :159 — centering is the caller's concern),
+and the principal-components matrix stays device-resident across batches
+(avoiding the reference's per-batch host→device PC copy, rapidsml_jni.cu:85).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Iterable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.core.dataset import as_matrix, with_column
+from spark_rapids_ml_tpu.core.params import (
+    Estimator,
+    HasInputCol,
+    HasOutputCol,
+    Model,
+    ParamDecl,
+    TypeConverters,
+)
+from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
+from spark_rapids_ml_tpu.ops import gram as gram_ops
+from spark_rapids_ml_tpu.ops.eigh import pca_from_gram
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    default_mesh,
+    make_mesh,
+)
+from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding, shard_rows
+from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+
+class PCASolution(NamedTuple):
+    """Fit result of the pure-JAX core (host-side numpy)."""
+
+    pc: np.ndarray  # (d, k) principal components, columns descending
+    explained_variance: np.ndarray  # (k,) σᵢ/Σσ — reference semantics
+    sigma: np.ndarray  # (d,) singular values √λ of the (centered) Gram
+    mean: np.ndarray  # (d,) column means observed during fit
+    n_rows: int
+
+
+# ---------------------------------------------------------------------------
+# Pure-JAX core
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _fit_fn(mesh: Mesh, k: int, mean_center: bool, two_d: bool, cd: str, ad: str):
+    """Compile the full fit (stats + psum + eig finalize) once per config.
+
+    ``cd``/``ad`` (compute/accum dtype names) are part of the cache key so a
+    config change recompiles rather than silently reusing old-dtype programs.
+    """
+
+    def fit(x, mask):
+        if two_d:
+            stats = jax.shard_map(
+                lambda xb, mb: gram_ops._stats_shard_2d(xb, mb, cd, ad),
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P(), P(MODEL_AXIS, None)),
+                # count/colsum are value-replicated over `model` after the
+                # all_gather, which VMA inference can't prove statically.
+                check_vma=False,
+            )
+        else:
+            stats = jax.shard_map(
+                lambda xb, mb: gram_ops._stats_shard(xb, mb, cd, ad),
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+                out_specs=(P(), P(), P()),
+            )
+        count, colsum, g = stats(x, mask)
+        g, mean = gram_ops.finalize_gram(count, colsum, g, mean_center)
+        pc, ev, s = pca_from_gram(g, k)
+        return pc, ev, s, mean, count
+
+    return jax.jit(fit)
+
+
+def fit_pca(
+    x: np.ndarray,
+    k: int,
+    mean_center: bool = True,
+    mesh: Optional[Mesh] = None,
+) -> PCASolution:
+    """Fit PCA on a host matrix, sharding rows (and features if the mesh has a
+    model axis > 1) across the mesh."""
+    mesh = mesh or default_mesh()
+    d = x.shape[1]
+    if not 0 < k <= d:
+        # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60
+        raise ValueError(f"k = {k} out of range (0, n = {d}]")
+    two_d = mesh.shape[MODEL_AXIS] > 1 and d % mesh.shape[MODEL_AXIS] == 0
+    with trace_span("compute cov"):  # phase names kept from the reference
+        if two_d:
+            from jax.sharding import NamedSharding
+
+            n_true = x.shape[0]
+            xp, mask_np = pad_rows(np.asarray(x), mesh.shape[DATA_AXIS])
+            xs = jax.device_put(xp, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)))
+            mask = jax.device_put(mask_np, NamedSharding(mesh, P(DATA_AXIS)))
+        else:
+            xs, mask, n_true = shard_rows(x, mesh)
+        fit = _fit_fn(
+            mesh,
+            k,
+            mean_center,
+            two_d,
+            config.get("compute_dtype"),
+            config.get("accum_dtype"),
+        )
+        pc, ev, s, mean, count = fit(xs, mask)
+    with trace_span("eig finalize"):
+        pc, ev, s, mean = jax.device_get((pc, ev, s, mean))
+    return PCASolution(
+        pc=np.asarray(pc, dtype=np.float64),
+        explained_variance=np.asarray(ev, dtype=np.float64),
+        sigma=np.asarray(s, dtype=np.float64),
+        mean=np.asarray(mean, dtype=np.float64),
+        n_rows=n_true,
+    )
+
+
+def fit_pca_stream(
+    batches: Iterable[np.ndarray],
+    k: int,
+    n_cols: int,
+    mean_center: bool = True,
+    mesh: Optional[Mesh] = None,
+) -> PCASolution:
+    """Fit PCA over a stream of host row-batches (dataset ≫ HBM).
+
+    The accumulator state lives on device; each batch is row-sharded,
+    reduced with psum, and folded in with buffer donation. This is the
+    scale path for BASELINE.json config #2 (100M×2048).
+    """
+    if not 0 < k <= n_cols:
+        # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60
+        raise ValueError(f"k = {k} out of range (0, n = {n_cols}]")
+    mesh = mesh or default_mesh()
+    update = gram_ops.streaming_update(mesh)
+    state = gram_ops.init_stats(n_cols)
+    n_data = mesh.shape[DATA_AXIS]
+    sharding = row_sharding(mesh)
+    mask_sharding = row_sharding(mesh, ndim=1)
+    n_true = 0
+    with trace_span("compute cov"):
+        for batch in batches:
+            batch = np.asarray(batch)
+            n_true += batch.shape[0]
+            xb, mb = pad_rows(batch, n_data)
+            xs = jax.device_put(xb, sharding)
+            ms = jax.device_put(mb, mask_sharding)
+            state = update(state, xs, ms)
+    count, colsum, g = state
+    with trace_span("eig finalize"):
+        finalize = jax.jit(
+            lambda c, cs, gg: pca_from_gram(
+                gram_ops.finalize_gram(c, cs, gg, mean_center)[0], k
+            ),
+            static_argnums=(),
+        )
+        pc, ev, s = jax.device_get(finalize(count, colsum, g))
+        mean = jax.device_get(colsum / jnp.maximum(count, 1))
+    return PCASolution(
+        pc=np.asarray(pc, dtype=np.float64),
+        explained_variance=np.asarray(ev, dtype=np.float64),
+        sigma=np.asarray(s, dtype=np.float64),
+        mean=np.asarray(mean, dtype=np.float64),
+        n_rows=n_true,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Estimator / Model (Spark ML contract — reference RapidsPCA.scala)
+# ---------------------------------------------------------------------------
+
+
+class _PCAParams(HasInputCol, HasOutputCol):
+    """Params shared by PCA and PCAModel (RapidsPCAParams, RapidsPCA.scala:34-46)."""
+
+    k = ParamDecl("k", "number of principal components (> 0)", TypeConverters.toInt)
+    meanCentering = ParamDecl(
+        "meanCentering",
+        "whether to center data before computing the covariance "
+        "(fused on-device here; the reference stubs this to ETL)",
+        TypeConverters.toBoolean,
+    )
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+        # default true — RapidsPCA.scala:45-46
+        self.setDefault(meanCentering=True, inputCol="features", outputCol="pca_features")
+
+    def getK(self) -> int:
+        return self.getOrDefault(self.k)
+
+    def getMeanCentering(self) -> bool:
+        return self.getOrDefault(self.meanCentering)
+
+
+class PCA(Estimator, _PCAParams, MLWritable, MLReadable):
+    """PCA estimator: ``PCA().setInputCol("features").setK(3).fit(df)``.
+
+    Drop-in shaped for the reference's public API
+    (com.nvidia.spark.ml.feature.PCA, PCA.scala:27-37; input is an
+    array-of-floats column, README.md:26-37).
+    """
+
+    _uid_prefix = "PCA"
+
+    def __init__(self, uid=None, mesh: Optional[Mesh] = None):
+        super().__init__(uid=uid)
+        self._mesh = mesh
+
+    def setK(self, value: int) -> "PCA":
+        return self._set(k=value)
+
+    def setMeanCentering(self, value: bool) -> "PCA":
+        return self._set(meanCentering=value)
+
+    def _copy_extra_state(self, source):
+        self._mesh = getattr(source, "_mesh", None)
+
+    def _fit(self, dataset) -> "PCAModel":
+        x = as_matrix(dataset, self.getInputCol())
+        sol = fit_pca(
+            x,
+            k=self.getK(),
+            mean_center=self.getMeanCentering(),
+            mesh=self._mesh,
+        )
+        model = PCAModel(
+            pc=sol.pc,
+            explained_variance=sol.explained_variance,
+            mean=sol.mean,
+        )
+        model.uid = self.uid
+        # Parent params flow to the model — Model.copy semantics in Spark.
+        for name, p in self._params.items():
+            if p in self._paramMap and model.hasParam(name):
+                model._set(**{name: self._paramMap[p]})
+            if p in self._defaultParamMap and model.hasParam(name):
+                model.setDefault(**{name: self._defaultParamMap[p]})
+        return model
+
+
+class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
+    """Fitted PCA model: pc (d, k), explainedVariance (k,).
+
+    (RapidsPCAModel, RapidsPCA.scala:102-166.)
+    """
+
+    _uid_prefix = "PCAModel"
+
+    def __init__(
+        self,
+        pc: Optional[np.ndarray] = None,
+        explained_variance: Optional[np.ndarray] = None,
+        mean: Optional[np.ndarray] = None,
+        uid=None,
+    ):
+        super().__init__(uid=uid)
+        self.pc = None if pc is None else np.asarray(pc)
+        self.explainedVariance = (
+            None if explained_variance is None else np.asarray(explained_variance)
+        )
+        self.mean = None if mean is None else np.asarray(mean)
+        self._project_cache: dict = {}
+
+    # -- persistence (PCAModelWriter/Reader, RapidsPCA.scala:193-228) ------
+    def _model_data(self):
+        data = {"pc": self.pc, "explainedVariance": self.explainedVariance}
+        if self.mean is not None:
+            data["mean"] = self.mean
+        return data
+
+    @classmethod
+    def _from_model_data(cls, uid, data):
+        return cls(
+            pc=data["pc"],
+            explained_variance=data["explainedVariance"],
+            mean=data.get("mean"),
+            uid=uid,
+        )
+
+    def _copy_extra_state(self, source):
+        self.pc = source.pc
+        self.explainedVariance = source.explainedVariance
+        self.mean = source.mean
+        self._project_cache = {}
+
+    # -- transform ---------------------------------------------------------
+    def _projector(self):
+        """Jitted y = x @ pc with the PC matrix resident on device.
+
+        The reference re-uploads the PC matrix host→device on every batch
+        (rapidsml_jni.cu:85, flagged in SURVEY.md §7(d)); keeping it as a
+        captured device constant amortizes it to once per compile. The cache
+        is keyed by the dtype config so later config changes recompile.
+        """
+        key = (config.get("compute_dtype"), config.get("accum_dtype"))
+        if key not in self._project_cache:
+            pc_dev = jnp.asarray(self.pc, dtype=jnp.dtype(key[0]))
+            accum = jnp.dtype(key[1])
+
+            @jax.jit
+            def project(x):
+                return jax.lax.dot_general(
+                    x.astype(pc_dev.dtype),
+                    pc_dev,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=accum,
+                )
+
+            self._project_cache[key] = project
+        return self._project_cache[key]
+
+    def _transform(self, dataset):
+        if self.pc is None:
+            raise RuntimeError("PCAModel has no principal components (unfitted?)")
+        x = as_matrix(dataset, self.getInputCol())
+        # Pad rows to a bucket so repeated batches hit the jit cache instead
+        # of recompiling per shape.
+        n = x.shape[0]
+        bucket = max(256, 1 << (n - 1).bit_length()) if n else 256
+        xp, _ = pad_rows(np.asarray(x), bucket)
+        y = self._projector()(xp)
+        y = np.asarray(jax.device_get(y))[:n]
+        return with_column(dataset, self.getOutputCol(), y)
+
+    def setOutputCol(self, value: str) -> "PCAModel":
+        return self._set(outputCol=value)

@@ -1,0 +1,46 @@
+"""XLA-compiled math kernels — the TPU equivalent of the reference's native
+device math core (``native/src/rapidsml_jni.cu``; SURVEY.md §2.2).
+
+Mapping to the reference's native symbols:
+
+* ``dgemmCov`` (partition Gram AᵀA, rapidsml_jni.cu:109-127)  → ``gram.py``
+  (fused count/sum/Gram statistics, sharded via shard_map + psum, optional
+  Pallas kernel).
+* ``calSVD`` (eigendecomposition + reorder + sqrt + sign flip,
+  rapidsml_jni.cu:215-269) → ``eigh.py``.
+* ``signFlip`` Thrust kernel (rapidsml_jni.cu:35-61) → ``eigh.sign_flip``.
+* ``dgemm`` (projection GEMM for transform, rapidsml_jni.cu:75-107) →
+  plain ``x @ pc`` under jit (XLA emits the MXU GEMM; no hand kernel needed).
+* plus what the reference lacks: pairwise distances (``distances.py``) and
+  SPD solves (``linalg.py``) for the KMeans / linear-model / KNN families.
+"""
+
+from spark_rapids_ml_tpu.ops.gram import (
+    local_stats,
+    sharded_stats,
+    sharded_stats_2d,
+    finalize_gram,
+)
+from spark_rapids_ml_tpu.ops.eigh import (
+    eigh_descending,
+    sign_flip,
+    explained_variance_reference,
+    explained_variance_ratio,
+    pca_from_gram,
+)
+from spark_rapids_ml_tpu.ops.distances import sq_euclidean
+from spark_rapids_ml_tpu.ops.linalg import solve_spd
+
+__all__ = [
+    "local_stats",
+    "sharded_stats",
+    "sharded_stats_2d",
+    "finalize_gram",
+    "eigh_descending",
+    "sign_flip",
+    "explained_variance_reference",
+    "explained_variance_ratio",
+    "pca_from_gram",
+    "sq_euclidean",
+    "solve_spd",
+]

@@ -1,0 +1,32 @@
+"""Pairwise squared-Euclidean distances via the Gram trick.
+
+Not present in the reference (PCA-only), but required by the north-star
+algorithm set (BASELINE.json: KMeans pairwise-dist kernel, approx-KNN
+distance kernel). ‖x−y‖² = ‖x‖² + ‖y‖² − 2⟨x,y⟩ turns the O(m·k·d) distance
+computation into one MXU GEMM plus rank-1 updates — the TPU-idiomatic form.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sq_euclidean(
+    x: jax.Array,
+    y: jax.Array,
+    compute_dtype=None,
+    accum_dtype=jnp.float32,
+) -> jax.Array:
+    """(m, d) × (k, d) → (m, k) squared distances, clipped at 0."""
+    xc = x.astype(compute_dtype) if compute_dtype is not None else x
+    yc = y.astype(compute_dtype) if compute_dtype is not None else y
+    xy = jax.lax.dot_general(
+        xc, yc, (((1,), (1,)), ((), ())), preferred_element_type=accum_dtype
+    )
+    x2 = jnp.sum(jnp.square(x.astype(accum_dtype)), axis=1)
+    y2 = jnp.sum(jnp.square(y.astype(accum_dtype)), axis=1)
+    d = x2[:, None] + y2[None, :] - 2.0 * xy
+    return jnp.maximum(d, 0.0)

@@ -1,0 +1,190 @@
+"""Fused second-moment statistics: count / column-sum / Gram matrix.
+
+This is the framework's hot loop, replacing the reference's per-partition
+``dgemmCov`` cuBLAS AᵀA (rapidsml_jni.cu:109-127) *and* fixing its known gap:
+mean-centering in the reference is a stubbed TODO pushed to upstream ETL
+(RapidsRowMatrix.scala:111-117; SURVEY.md §2.4). Here every pass computes the
+row count, the column sums, and the Gram matrix in one fused kernel, so a
+centered Gram is available for free via G_c = G − n·μμᵀ — one extra rank-1
+update instead of a second data pass.
+
+Sharding: rows over the ``data`` mesh axis; partials combine with
+``jax.lax.psum`` over ICI — the device-plane reduction the reference's JVM
+``RDD.reduce`` (RapidsRowMatrix.scala:139) approximates, and the device-side
+combiner its never-implemented ``accumulateCov`` intended (SURVEY.md §2.4).
+A 2-D variant shards features over ``model`` as well, lifting the reference's
+one-device covariance assumption (RapidsRowMatrix.scala:74-86).
+
+Padded rows are masked out, so stats are exact for any row count.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+Stats = Tuple[jax.Array, jax.Array, jax.Array]  # (count, colsum, gram)
+
+
+def _dtypes():
+    return jnp.dtype(config.get("compute_dtype")), jnp.dtype(config.get("accum_dtype"))
+
+
+def local_stats(
+    x: jax.Array,
+    mask: Optional[jax.Array] = None,
+    compute_dtype=None,
+    accum_dtype=None,
+) -> Stats:
+    """Single-block fused stats. x: (m, d); mask: (m,) of {0,1} or None.
+
+    The GEMM runs in ``compute_dtype`` (bfloat16 engages the MXU at full
+    rate) and accumulates in ``accum_dtype`` via ``preferred_element_type``.
+    """
+    cd, ad = _dtypes()
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else cd
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
+    xc = x.astype(cd)
+    if mask is not None:
+        xc = xc * mask.astype(cd)[:, None]
+        count = jnp.sum(mask.astype(ad))
+    else:
+        count = jnp.asarray(x.shape[0], dtype=ad)
+    colsum = jnp.sum(xc.astype(ad), axis=0)
+    gram = jax.lax.dot_general(
+        xc,
+        xc,
+        (((0,), (0,)), ((), ())),  # contract over rows: xᵀx
+        preferred_element_type=ad,
+    )
+    return count, colsum, gram
+
+
+def _stats_shard(x, mask, compute_dtype, accum_dtype):
+    count, colsum, gram = local_stats(
+        x, mask, compute_dtype=compute_dtype, accum_dtype=accum_dtype
+    )
+    count = jax.lax.psum(count, DATA_AXIS)
+    colsum = jax.lax.psum(colsum, DATA_AXIS)
+    gram = jax.lax.psum(gram, DATA_AXIS)
+    return count, colsum, gram
+
+
+def sharded_stats(mesh: Mesh, compute_dtype=None, accum_dtype=None):
+    """Build a jitted fn(x_rowsharded, mask) -> replicated (count, colsum, gram).
+
+    One compiled SPMD program: per-shard fused stats + psum over ``data``.
+    """
+    f = jax.shard_map(
+        functools.partial(
+            _stats_shard, compute_dtype=compute_dtype, accum_dtype=accum_dtype
+        ),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(f)
+
+
+def _stats_shard_2d(x, mask, compute_dtype, accum_dtype):
+    """2-D sharded stats: x block is (rows/data, d/model).
+
+    all_gather the feature blocks along ``model`` so each device computes its
+    (d/model, d) horizontal slab of the Gram; psum slabs over ``data``. The
+    result stays feature-sharded — the full n×n never materializes on one
+    device (the upgrade over RapidsRowMatrix.scala:74-86).
+    """
+    cd, ad = _dtypes()
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else cd
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
+    xc = x.astype(cd) * mask.astype(cd)[:, None]
+    # (m_local, d_full) — ICI all-gather of feature blocks.
+    x_full = jax.lax.all_gather(xc, MODEL_AXIS, axis=1, tiled=True)
+    count = jax.lax.psum(jnp.sum(mask.astype(ad)), DATA_AXIS)
+    colsum = jax.lax.psum(jnp.sum(x_full.astype(ad), axis=0), DATA_AXIS)
+    slab = jax.lax.dot_general(
+        xc, x_full, (((0,), (0,)), ((), ())), preferred_element_type=ad
+    )
+    gram_slab = jax.lax.psum(slab, DATA_AXIS)
+    return count, colsum, gram_slab
+
+
+def sharded_stats_2d(mesh: Mesh, compute_dtype=None, accum_dtype=None):
+    """fn(x_2dsharded, mask) -> (count repl, colsum repl, gram model-sharded)."""
+    f = jax.shard_map(
+        functools.partial(
+            _stats_shard_2d, compute_dtype=compute_dtype, accum_dtype=accum_dtype
+        ),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(MODEL_AXIS, None)),
+        # count/colsum are value-replicated over `model` after the
+        # all_gather, which VMA inference can't prove statically.
+        check_vma=False,
+    )
+    return jax.jit(f)
+
+
+def streaming_update(mesh: Mesh, compute_dtype=None, accum_dtype=None):
+    """Jitted (state, x_batch, mask) -> state for out-of-HBM datasets.
+
+    State (count, colsum, gram) lives replicated on device; host streams
+    row-sharded batches in. Donation makes the accumulate in-place. This is
+    the path for BASELINE.json config #2 (100M×2048 ≫ HBM).
+    """
+
+    def shard_update(count, colsum, gram, x, mask):
+        c, s, g = _stats_shard(x, mask, compute_dtype, accum_dtype)
+        return count + c, colsum + s, gram + g
+
+    f = jax.shard_map(
+        shard_update,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, x, mask):
+        return f(state[0], state[1], state[2], x, mask)
+
+    return update
+
+
+def init_stats(n_cols: int, accum_dtype=None) -> Stats:
+    _, ad = _dtypes()
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
+    return (
+        jnp.zeros((), dtype=ad),
+        jnp.zeros((n_cols,), dtype=ad),
+        jnp.zeros((n_cols, n_cols), dtype=ad),
+    )
+
+
+def finalize_gram(
+    count: jax.Array,
+    colsum: jax.Array,
+    gram: jax.Array,
+    mean_center: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """(count, colsum, gram) -> (G, mean).
+
+    ``mean_center=True``: G = Σxxᵀ − n·μμᵀ, the Gram of centered data — the
+    real fused fix for the reference's ETL-preprocess stub (SURVEY.md §2.4).
+    ``False``: raw Gram, byte-for-byte the reference's ``cov.reduce(_+_)``
+    semantics (RapidsRowMatrix.scala:139 — no centering, no normalization).
+    """
+    n = jnp.maximum(count, 1)
+    mean = colsum / n
+    if mean_center:
+        g = gram - jnp.outer(mean, colsum)
+    else:
+        g = gram
+    return g, mean

@@ -1,0 +1,31 @@
+"""Dense solves for the normal-equations model family.
+
+The reference has no solver beyond eigendecomposition; LinearRegression /
+LogisticRegression (BASELINE.json configs) need SPD solves of the d×d system
+(XᵀX + λI)w = Xᵀy. Cholesky is the MXU-friendly choice; a diagonal-jitter
+retry guards near-singular systems without data-dependent Python control
+flow (the retry is branchless: solve once with jitter chosen by a
+finiteness check on the first factorization).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def solve_spd(a: jax.Array, b: jax.Array, reg: float = 0.0) -> jax.Array:
+    """Solve (a + reg·I) x = b for symmetric positive (semi-)definite a."""
+    d = a.shape[0]
+    eye = jnp.eye(d, dtype=a.dtype)
+    a_reg = a + reg * eye
+
+    factor = jnp.linalg.cholesky(a_reg)
+    ok = jnp.all(jnp.isfinite(factor))
+    # Branchless fallback: re-factor with jitter scaled to the diagonal when
+    # the plain factorization failed (NaNs from a non-PD matrix).
+    jitter = 1e-6 * jnp.maximum(jnp.max(jnp.abs(jnp.diag(a_reg))), 1.0)
+    factor2 = jnp.linalg.cholesky(a_reg + jitter * eye)
+    chol = jnp.where(ok, factor, factor2)
+    y = jax.scipy.linalg.solve_triangular(chol, b, lower=True)
+    return jax.scipy.linalg.solve_triangular(chol.T, y, lower=False)

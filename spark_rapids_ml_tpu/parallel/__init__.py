@@ -1,0 +1,38 @@
+"""Distributed execution layer: device mesh, sharding, collectives.
+
+TPU-native replacement for the reference's entire parallelism story
+(SURVEY.md §2.3): where the reference runs one Spark task per partition and
+combines n×n Gram partials with a JVM ``RDD.reduce`` (RapidsRowMatrix.scala:
+122-139) — device→host→JVM→wire→JVM — this layer keeps partials on the
+device plane: rows are sharded over the ``data`` mesh axis, features
+(optionally) over ``model``, and partials combine with ``jax.lax.psum`` over
+ICI/DCN inside one compiled program. This also implements the device-side
+combiner the reference declared but never built (``accumulateCov``,
+SURVEY.md §2.4).
+"""
+
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    default_mesh,
+    make_mesh,
+    mesh_shape,
+)
+from spark_rapids_ml_tpu.parallel.sharding import (
+    pad_rows,
+    shard_rows,
+    replicated,
+    row_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "default_mesh",
+    "make_mesh",
+    "mesh_shape",
+    "pad_rows",
+    "shard_rows",
+    "replicated",
+    "row_sharding",
+]

@@ -1,0 +1,81 @@
+"""Device mesh construction and naming conventions.
+
+Axes:
+  * ``data``  — row/batch parallelism (the reference's one-task-per-partition
+    data parallelism, RapidsRowMatrix.scala:122-137, made device-native).
+  * ``model`` — feature/model parallelism (the upgrade the reference lacks:
+    it assumes the n×n covariance fits one device, RapidsRowMatrix.scala:74-86;
+    sharding features over ``model`` lifts that limit).
+
+Multi-host: ``jax.devices()`` already spans all hosts in a multi-host
+runtime, so the same mesh code scales from 1 chip to a pod; XLA routes
+``psum`` over ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from spark_rapids_ml_tpu import config
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_default_mesh: Optional[Mesh] = None
+_default_mesh_key: Optional[tuple] = None
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, model) mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} needs {data * model} devices, have {n}")
+    devs = devs[: data * model]
+    arr = np.array(devs).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def default_mesh() -> Mesh:
+    """Process-wide default mesh (all devices on the data axis unless
+    overridden by config ``mesh_data_axis``/``mesh_model_axis``).
+
+    Rebuilt when the axis config changes or the live device set changes."""
+    global _default_mesh, _default_mesh_key
+    key = (config.get("mesh_data_axis"), config.get("mesh_model_axis") or 1)
+    if _default_mesh is None or key != _default_mesh_key or _mesh_is_stale(_default_mesh):
+        _default_mesh = make_mesh(data=key[0], model=key[1])
+        _default_mesh_key = key
+    return _default_mesh
+
+
+def _mesh_is_stale(mesh: Mesh) -> bool:
+    # Tests flip between CPU/TPU backends in one process; rebuild if the
+    # mesh's devices are no longer the live ones.
+    try:
+        live = set(jax.devices())
+    except RuntimeError:  # pragma: no cover
+        return True
+    return not set(mesh.devices.flat).issubset(live)
+
+
+def reset_default_mesh() -> None:
+    global _default_mesh, _default_mesh_key
+    _default_mesh = None
+    _default_mesh_key = None
+
+
+def mesh_shape(mesh: Mesh) -> tuple:
+    return tuple(mesh.shape[a] for a in (DATA_AXIS, MODEL_AXIS))

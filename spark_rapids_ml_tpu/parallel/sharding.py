@@ -1,0 +1,67 @@
+"""Host array -> sharded device array placement helpers.
+
+The reference's data placement is Spark's: partitions land wherever tasks are
+scheduled and each task grabs its assigned GPU (TaskContext.resources(),
+RapidsRowMatrix.scala:125-126). Here placement is explicit: rows are padded
+to a multiple of the data-axis size and placed with a NamedSharding, so the
+whole fit is one SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad rows with zeros to a multiple; returns (padded, row_mask).
+
+    The mask rides along into the sharded stats kernels so padded rows
+    contribute nothing to counts/sums/Grams — the moment-based algorithms
+    stay exact under padding (tested by shard-count invariance, SURVEY.md §4).
+    """
+    n = x.shape[0]
+    n_pad = (-n) % multiple
+    mask = np.ones((n,), dtype=np.float32)
+    if n_pad:
+        x = np.concatenate([x, np.zeros((n_pad,) + x.shape[1:], dtype=x.dtype)], axis=0)
+        mask = np.concatenate([mask, np.zeros((n_pad,), dtype=np.float32)])
+    return x, mask
+
+
+def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Rows over the data axis, everything else replicated."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(
+    x: np.ndarray,
+    mesh: Mesh,
+    dtype: Optional[Any] = None,
+    with_mask: bool = True,
+):
+    """Pad + place a host matrix row-sharded on the mesh.
+
+    Returns (x_sharded, mask_sharded, n_true_rows). ``jax.device_put`` with a
+    NamedSharding splits the host buffer across devices without staging the
+    full array on any single device.
+    """
+    n_true = x.shape[0]
+    n_data = mesh.shape[DATA_AXIS]
+    x, mask = pad_rows(np.asarray(x), n_data)
+    if dtype is not None:
+        x = x.astype(dtype, copy=False)
+    xs = jax.device_put(x, row_sharding(mesh, x.ndim))
+    ms = jax.device_put(mask, row_sharding(mesh, 1)) if with_mask else None
+    return xs, ms, n_true

@@ -1,0 +1,4 @@
+from spark_rapids_ml_tpu.utils.profiling import trace_span, Timer
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+__all__ = ["trace_span", "Timer", "get_logger"]

@@ -1,0 +1,24 @@
+"""Logging setup — the Spark ``Logging`` trait equivalent.
+
+(Reference: RapidsRowMatrix extends Logging, RapidsRowMatrix.scala:24,32, and
+debug breadcrumbs marking which transform path ran, RapidsPCA.scala:131,158.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_CONFIGURED = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        level = os.environ.get("SRML_TPU_LOG_LEVEL", "WARNING").upper()
+        logging.basicConfig(
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+            level=getattr(logging, level, logging.WARNING),
+        )
+        _CONFIGURED = True
+    return logging.getLogger(name)

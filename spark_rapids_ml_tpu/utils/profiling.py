@@ -1,0 +1,57 @@
+"""Phase-named tracing spans — the NVTX-range idiom, TPU-native.
+
+The reference wraps its two fit phases in NVTX ranges so they show up in
+Nsight (``NvtxRange("compute cov", RED)`` / ``NvtxRange("cuSolver SVD",
+BLUE)``, RapidsRowMatrix.scala:62,70, closed in ``finally``). The TPU
+equivalent is ``jax.profiler.TraceAnnotation``, which names the span in
+xprof/Perfetto traces. ``trace_span`` keeps the same phase-named-span idiom
+and degrades to a no-op timer when tracing is disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+_logger = get_logger(__name__)
+
+
+class Timer:
+    """Wall-clock timer with a monotonic clock; used by spans and benches."""
+
+    def __init__(self) -> None:
+        self.start = time.perf_counter()
+        self.elapsed: Optional[float] = None
+
+    def stop(self) -> float:
+        self.elapsed = time.perf_counter() - self.start
+        return self.elapsed
+
+
+@contextlib.contextmanager
+def trace_span(name: str, log: bool = False) -> Iterator[Timer]:
+    """Context manager naming a phase in the JAX profiler timeline.
+
+    Usage mirrors the reference's try/finally NvtxRange pattern::
+
+        with trace_span("compute cov"):
+            gram = compute_gram(...)
+    """
+    timer = Timer()
+    if config.get("tracing"):
+        import jax.profiler
+
+        cm: contextlib.AbstractContextManager = jax.profiler.TraceAnnotation(name)
+    else:
+        cm = contextlib.nullcontext()
+    with cm:
+        try:
+            yield timer
+        finally:
+            timer.stop()
+            if log or config.get("tracing"):
+                _logger.debug("phase %s: %.3fs", name, timer.elapsed)

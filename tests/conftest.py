@@ -1,0 +1,63 @@
+"""Test harness: virtual 8-device CPU mesh + float64 parity mode.
+
+This is the "fake backend" testing capability the reference lacks
+(SURVEY.md §4): multi-device sharding tests with no hardware, via
+``--xla_force_host_platform_device_count``. Environment must be set before
+jax import, hence the top-of-conftest placement.
+
+float64 is enabled so differential tests against NumPy/sklearn oracles can
+assert at the reference's absTol 1e-5 (PCASuite.scala:80-87); a separate
+test exercises the float32 TPU-native mode with wider tolerance.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image pre-sets a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "True")
+# Package dtype defaults for parity testing (overridden per-test via
+# config.option for float32-mode tests).
+os.environ.setdefault("SRML_TPU_ACCUM_DTYPE", "float64")
+os.environ.setdefault("SRML_TPU_COMPUTE_DTYPE", "float64")
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the TPU backend and sets
+# jax.config.jax_platforms directly, which beats the env var — override the
+# config itself (must happen before the first backend touch).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    return make_mesh(data=8, model=1)
+
+
+@pytest.fixture(scope="session")
+def mesh4x2(devices):
+    return make_mesh(data=4, model=2)
+
+
+@pytest.fixture(scope="session")
+def mesh1(devices):
+    return make_mesh(data=1, model=1, devices=devices[:1])
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

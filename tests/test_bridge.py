@@ -1,0 +1,79 @@
+"""Arrow columnar bridge tests (regressions from code review included)."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from spark_rapids_ml_tpu.bridge.arrow import (  # noqa: E402
+    list_column_to_matrix,
+    matrix_to_list_column,
+)
+
+
+def test_fixed_size_list_roundtrip():
+    m = np.arange(12, dtype=np.float64).reshape(4, 3)
+    col = matrix_to_list_column(m)
+    back = list_column_to_matrix(col)
+    np.testing.assert_array_equal(back, m)
+
+
+def test_fixed_size_list_zero_copy():
+    m = np.arange(12, dtype=np.float32).reshape(4, 3)
+    back = list_column_to_matrix(matrix_to_list_column(m))
+    assert back.dtype == np.float32
+
+
+def test_sliced_fixed_size_list():
+    # Regression: sliced FSL arrays must honor the slice offset.
+    m = np.arange(20, dtype=np.float64).reshape(5, 4)
+    col = matrix_to_list_column(m).slice(2, 2)
+    back = list_column_to_matrix(col)
+    np.testing.assert_array_equal(back, m[2:4])
+
+
+def test_sliced_variable_list():
+    arr = pa.array([[float(i), float(i + 1)] for i in range(6)])
+    back = list_column_to_matrix(arr.slice(1, 3))
+    np.testing.assert_array_equal(back, [[1, 2], [2, 3], [3, 4]])
+
+
+def test_ragged_rejected():
+    arr = pa.array([[1.0, 2.0], [3.0]])
+    with pytest.raises(ValueError, match="ragged"):
+        list_column_to_matrix(arr)
+
+
+def test_row_nulls_rejected():
+    arr = pa.array([[1.0, 2.0], None], type=pa.list_(pa.float64()))
+    with pytest.raises(ValueError, match="null"):
+        list_column_to_matrix(arr)
+
+
+def test_inner_nulls_rejected():
+    # Regression: nulls *inside* rows must not silently become NaN.
+    arr = pa.array([[1.0, None, 3.0], [4.0, 5.0, 6.0]])
+    with pytest.raises(ValueError, match="null"):
+        list_column_to_matrix(arr)
+
+
+def test_inner_nulls_rejected_fixed_size_list():
+    flat = pa.array([1.0, None, 3.0, 4.0], type=pa.float64())
+    arr = pa.FixedSizeListArray.from_arrays(flat, 2)
+    with pytest.raises(ValueError, match="null"):
+        list_column_to_matrix(arr)
+
+
+def test_chunked_array():
+    m1 = np.ones((2, 3)); m2 = np.zeros((3, 3))
+    chunked = pa.chunked_array(
+        [matrix_to_list_column(m1), matrix_to_list_column(m2)]
+    )
+    back = list_column_to_matrix(chunked)
+    np.testing.assert_array_equal(back, np.concatenate([m1, m2]))
+
+
+def test_large_list():
+    arr = pa.array([[1.0, 2.0], [3.0, 4.0]], type=pa.large_list(pa.float64()))
+    back = list_column_to_matrix(arr)
+    np.testing.assert_array_equal(back, [[1, 2], [3, 4]])

@@ -1,0 +1,233 @@
+"""PCA differential tests — the reference's oracle pattern, extended.
+
+The reference's one integration test compares the accelerated path against
+Spark MLlib CPU PCA element-wise on absolute values at absTol 1e-5
+(PCASuite.scala:42-88; abs values because eigenvector sign is arbitrary).
+Here the oracle is NumPy/sklearn; plus the coverage the reference lacks
+(SURVEY.md §4): multi-device runs on a virtual mesh, shard-count invariance,
+2-D (feature-sharded) parity, streaming parity, and float32-mode sanity.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA, PCAModel, config
+from spark_rapids_ml_tpu.models.pca import fit_pca, fit_pca_stream
+from spark_rapids_ml_tpu.ops.eigh import sign_flip
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+ABS_TOL = 1e-5  # reference tolerance, PCASuite.scala:87
+
+
+def _oracle(x, k, mean_center=True):
+    """NumPy oracle replicating the reference pipeline exactly."""
+    x = np.asarray(x, dtype=np.float64)
+    if mean_center:
+        xc = x - x.mean(axis=0)
+    else:
+        xc = x
+    gram = xc.T @ xc
+    w, v = np.linalg.eigh(gram)
+    w, v = w[::-1], v[:, ::-1]
+    # reference sign flip: max-|x| element of each column made positive
+    idx = np.argmax(np.abs(v), axis=0)
+    signs = np.where(v[idx, np.arange(v.shape[1])] < 0, -1.0, 1.0)
+    v = v * signs
+    s = np.sqrt(np.clip(w, 0, None))
+    ev = s / s.sum()
+    return v[:, :k], ev[:k], s
+
+
+@pytest.fixture
+def data(rng):
+    # Anisotropic data so principal directions are well separated.
+    n, d = 500, 24
+    basis = rng.normal(size=(d, d))
+    scales = np.logspace(0, -2, d)
+    return rng.normal(size=(n, d)) @ (basis * scales)
+
+
+def test_fit_matches_oracle(data, mesh8):
+    k = 5
+    sol = fit_pca(data, k=k, mesh=mesh8)
+    pc_ref, ev_ref, s_ref = _oracle(data, k)
+    np.testing.assert_allclose(np.abs(sol.pc), np.abs(pc_ref), atol=ABS_TOL)
+    np.testing.assert_allclose(sol.explained_variance, ev_ref, atol=ABS_TOL)
+    np.testing.assert_allclose(sol.mean, data.mean(axis=0), atol=ABS_TOL)
+    assert sol.n_rows == data.shape[0]
+
+
+def test_sign_flip_matches_reference_semantics(data, mesh8):
+    # Signs should agree exactly with the oracle (not just up to sign),
+    # because both implement rapidsml_jni.cu:35-61 semantics.
+    k = 5
+    sol = fit_pca(data, k=k, mesh=mesh8)
+    pc_ref, _, _ = _oracle(data, k)
+    np.testing.assert_allclose(sol.pc, pc_ref, atol=ABS_TOL)
+
+
+def test_no_mean_centering_raw_gram(data, mesh8):
+    # meanCentering=False must reproduce the reference's raw-Gram path
+    # (RapidsRowMatrix.scala:139 — no centering applied on device).
+    k = 4
+    shifted = data + 3.0  # make centering matter
+    sol = fit_pca(shifted, k=k, mean_center=False, mesh=mesh8)
+    pc_ref, ev_ref, _ = _oracle(shifted, k, mean_center=False)
+    np.testing.assert_allclose(np.abs(sol.pc), np.abs(pc_ref), atol=ABS_TOL)
+    np.testing.assert_allclose(sol.explained_variance, ev_ref, atol=ABS_TOL)
+
+
+def test_shard_count_invariance(data):
+    # Property test from SURVEY.md §4: 1 vs N shards -> identical result.
+    k = 3
+    sols = [
+        fit_pca(data, k=k, mesh=make_mesh(data=n, model=1))
+        for n in (1, 2, 8)
+    ]
+    for sol in sols[1:]:
+        np.testing.assert_allclose(sol.pc, sols[0].pc, atol=1e-10)
+        np.testing.assert_allclose(
+            sol.explained_variance, sols[0].explained_variance, atol=1e-12
+        )
+
+
+def test_2d_feature_sharded_parity(data, mesh8, mesh4x2):
+    # Feature-sharded (model-axis) Gram must equal the 1-D path.
+    k = 6
+    a = fit_pca(data, k=k, mesh=mesh8)
+    b = fit_pca(data, k=k, mesh=mesh4x2)
+    np.testing.assert_allclose(b.pc, a.pc, atol=1e-8)
+    np.testing.assert_allclose(b.explained_variance, a.explained_variance, atol=1e-10)
+
+
+def test_uneven_rows_padding(mesh8, rng):
+    # Row counts not divisible by the mesh must be exact (mask correctness).
+    x = rng.normal(size=(101, 7))
+    sol = fit_pca(x, k=2, mesh=mesh8)
+    pc_ref, ev_ref, _ = _oracle(x, 2)
+    np.testing.assert_allclose(np.abs(sol.pc), np.abs(pc_ref), atol=ABS_TOL)
+
+
+def test_streaming_matches_batch(data, mesh8):
+    k = 4
+    batches = [data[i : i + 128] for i in range(0, len(data), 128)]
+    a = fit_pca_stream(batches, k=k, n_cols=data.shape[1], mesh=mesh8)
+    b = fit_pca(data, k=k, mesh=mesh8)
+    np.testing.assert_allclose(a.pc, b.pc, atol=1e-8)
+    np.testing.assert_allclose(a.explained_variance, b.explained_variance, atol=1e-10)
+    assert a.n_rows == b.n_rows == data.shape[0]
+
+
+def test_float32_mode(data, mesh8):
+    # The TPU-native dtype mode: looser tolerance, same structure.
+    with config.option("compute_dtype", "float32"), config.option(
+        "accum_dtype", "float32"
+    ):
+        sol = fit_pca(data, k=3, mesh=mesh8)
+    pc_ref, ev_ref, _ = _oracle(data, 3)
+    np.testing.assert_allclose(np.abs(sol.pc), np.abs(pc_ref), atol=5e-2)
+    np.testing.assert_allclose(sol.explained_variance, ev_ref, atol=1e-3)
+
+
+def test_k_validation(data, mesh8):
+    with pytest.raises(ValueError):
+        fit_pca(data, k=0, mesh=mesh8)
+    with pytest.raises(ValueError):
+        fit_pca(data, k=data.shape[1] + 1, mesh=mesh8)
+    # Regression: the streaming path must validate k identically.
+    with pytest.raises(ValueError):
+        fit_pca_stream([data], k=0, n_cols=data.shape[1], mesh=mesh8)
+    with pytest.raises(ValueError):
+        fit_pca_stream([data], k=data.shape[1] + 1, n_cols=data.shape[1], mesh=mesh8)
+
+
+def test_dtype_config_change_recompiles(data, mesh8):
+    # Regression: flipping dtype config must not silently reuse the cached
+    # float64 program (the lru_cache key now includes the dtypes).
+    a = fit_pca(data, k=3, mesh=mesh8)
+    with config.option("compute_dtype", "float32"), config.option(
+        "accum_dtype", "float32"
+    ):
+        b = fit_pca(data, k=3, mesh=mesh8)
+    # float32 result must differ at fine precision (else the cache lied)...
+    assert np.max(np.abs(a.pc - b.pc)) > 0
+    # ...but agree loosely (same algorithm).
+    np.testing.assert_allclose(np.abs(a.pc), np.abs(b.pc), atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# Estimator / Model API (PCASuite params + read/write tests equivalents)
+# ---------------------------------------------------------------------------
+
+
+def test_estimator_fit_transform_dict(data, mesh8):
+    ds = {"features": data}
+    pca = PCA(mesh=mesh8).setInputCol("features").setOutputCol("out").setK(3)
+    model = pca.fit(ds)
+    out = model.transform(ds)
+    assert out["out"].shape == (len(data), 3)
+    pc_ref, _, _ = _oracle(data, 3)
+    np.testing.assert_allclose(out["out"], data @ pc_ref, atol=1e-4)
+
+
+def test_estimator_fit_arrow(data, mesh8):
+    pa = pytest.importorskip("pyarrow")
+    from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
+
+    table = pa.table({"features": matrix_to_list_column(data)})
+    model = PCA(mesh=mesh8).setK(2).fit(table)
+    out = model.transform(table)
+    assert "pca_features" in out.column_names
+    mat = np.stack(out.column("pca_features").to_pylist())
+    assert mat.shape == (len(data), 2)
+
+
+def test_model_persistence_roundtrip(data, mesh8, tmp_path):
+    # testDefaultReadWrite equivalent (PCASuite.scala:91-105): params and
+    # fitted data must survive save/load, asserting pc equality (:104).
+    path = str(tmp_path / "pca_model")
+    model = PCA(mesh=mesh8).setK(3).setInputCol("features").fit({"features": data})
+    model.save(path)
+    loaded = PCAModel.load(path)
+    assert loaded.uid == model.uid
+    np.testing.assert_allclose(loaded.pc, model.pc, atol=1e-12)
+    np.testing.assert_allclose(
+        loaded.explainedVariance, model.explainedVariance, atol=1e-12
+    )
+    assert loaded.getK() == 3
+    assert loaded.getInputCol() == "features"
+    # loaded model must transform identically
+    a = model.transform({"features": data})["pca_features"]
+    b = loaded.transform({"features": data})["pca_features"]
+    np.testing.assert_allclose(a, b, atol=1e-7)
+
+
+def test_estimator_persistence_roundtrip(mesh8, tmp_path):
+    path = str(tmp_path / "pca_est")
+    est = PCA().setK(7).setMeanCentering(False)
+    est.save(path)
+    loaded = PCA.load(path)
+    assert loaded.getK() == 7
+    assert loaded.getMeanCentering() is False
+    assert loaded.uid == est.uid
+
+
+def test_params_contract():
+    # ParamsSuite.checkParams equivalent (PCASuite.scala:33-39).
+    pca = PCA()
+    assert pca.getMeanCentering() is True  # default, RapidsPCA.scala:45-46
+    assert pca.hasParam("k") and pca.hasParam("inputCol")
+    pca.setK(4)
+    copied = pca.copy()
+    assert copied.getK() == 4 and copied.uid == pca.uid
+    copied2 = pca.copy({pca.getParam("k"): 9})
+    assert copied2.getK() == 9 and pca.getK() == 4
+    text = pca.explainParams()
+    assert "meanCentering" in text and "principal components" in text
+
+
+def test_sign_flip_unit():
+    u = np.array([[0.1, -0.9], [-0.8, 0.2]])
+    out = np.asarray(sign_flip(u))
+    # col0: max-|x| is -0.8 -> flip; col1: max-|x| is -0.9 -> flip
+    np.testing.assert_allclose(out, -u)
