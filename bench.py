@@ -1,0 +1,91 @@
+"""Headline benchmark: PCA.fit throughput, rows/sec/chip.
+
+Measures the full fit step — fused count/colsum/Gram statistics (the
+reference's dgemmCov hot loop, rapidsml_jni.cu:120-125) + mean-centered
+finalize + eigh/sign-flip/top-k (the reference's calSVD, rapidsml_jni.cu:
+215-269) — on the BASELINE.json north-star shape (d=2048, k=32), in the
+TPU-native dtype mode (bfloat16 GEMM on the MXU, float32 accumulation).
+
+Data is generated on-device so the benchmark isolates the compute path
+(host→device feeding is benchmarked separately in the bridge).
+
+Baseline for ``vs_baseline``: the A100 cuML fit is GEMM-bound at
+2·d² flops/row; at ~110 TFLOP/s sustained TF32 that is ~13.1e6 rows/s.
+The north-star target (BASELINE.md) is within 2× of A100 per chip, i.e.
+vs_baseline >= 0.5.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+A100_CUML_ROWS_PER_SEC = 13.1e6  # GEMM-bound estimate, see module docstring
+
+D = 2048
+K = 32
+N_ROWS = 1 << 19  # 524288 rows x 2048 f32 = 4.3 GB on device
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.ops import gram as gram_ops
+    from spark_rapids_ml_tpu.ops.eigh import pca_from_gram_host
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    config.set("compute_dtype", "bfloat16")
+    config.set("accum_dtype", "float32")
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh(model=1)
+
+    # On-device data generation (no host transfer in the timed region).
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (N_ROWS, D), dtype=jnp.float32)
+    if n_chips > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+    mask = jnp.ones((N_ROWS,), dtype=jnp.float32)
+
+    stats = gram_ops.sharded_stats(mesh, compute_dtype="bfloat16", accum_dtype="float32")
+
+    def fit(x, mask):
+        # Device: the data-scaling reduction. Host: the tiny d×d eig
+        # finalize (eigh executes poorly on TPU; see config "finalize").
+        count, colsum, g = stats(x, mask)
+        g = np.asarray(g, dtype=np.float64)
+        colsum = np.asarray(colsum, dtype=np.float64)
+        n = max(float(count), 1.0)
+        g -= np.outer(colsum / n, colsum)
+        return pca_from_gram_host(g, K)
+
+    # Warmup / compile.
+    fit(x, mask)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pc, ev, _ = fit(x, mask)
+    dt = (time.perf_counter() - t0) / iters
+
+    rows_per_sec_per_chip = N_ROWS / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "pca_fit_rows_per_sec_per_chip_d2048_k32",
+                "value": round(rows_per_sec_per_chip, 1),
+                "unit": "rows/s/chip",
+                "vs_baseline": round(rows_per_sec_per_chip / A100_CUML_ROWS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,12 @@ _DEFAULTS: Dict[str, Any] = {
     "tracing": _env("TRACING", False, _as_bool),
     # Use Pallas kernels for hot ops (Gram, pairwise distance) on TPU.
     "use_pallas": _env("USE_PALLAS", False, _as_bool),
+    # Where the d×d eigendecomposition finalize runs: "auto" = on-device for
+    # CPU meshes, host LAPACK (float64) for TPU ("device"/"host" force it).
+    # The Gram reduction — the part that scales with data — always runs on
+    # device; eigh on TPU is an iterative algorithm XLA executes poorly for
+    # large d, while the d×d Gram is tiny to fetch.
+    "finalize": _env("FINALIZE", "auto", str),
 }
 
 _lock = threading.Lock()

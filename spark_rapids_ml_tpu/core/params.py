@@ -246,6 +246,19 @@ class Params:
     def _copy_extra_state(self, source: "Params") -> None:
         """Hook for models to copy non-param state (e.g. fitted matrices)."""
 
+    def _copy_params_to(self, target: "Params") -> "Params":
+        """Copy set and default params onto ``target`` (by name), skipping
+        params the target doesn't declare. Used by Estimator._fit to flow
+        parent params to the produced Model (Spark Model.copy semantics)."""
+        for name, p in self._params.items():
+            if not target.hasParam(name):
+                continue
+            if p in self._defaultParamMap:
+                target.setDefault(**{name: self._defaultParamMap[p]})
+            if p in self._paramMap:
+                target._set(**{name: self._paramMap[p]})
+        return target
+
     def extractParamMap(self, extra=None) -> Dict[Param, Any]:
         out = dict(self._defaultParamMap)
         out.update(self._paramMap)

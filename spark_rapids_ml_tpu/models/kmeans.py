@@ -1,11 +1,328 @@
-"""KMeans — placeholder, implemented in the breadth pass."""
+"""KMeans — Lloyd's algorithm as one compiled SPMD program.
 
-from spark_rapids_ml_tpu.core.params import Estimator, Model
+Not present in the reference repo (PCA-only), but part of the capability
+surface this framework targets (SURVEY.md §0 and BASELINE.json config #3:
+"KMeans k=100 on 50M×256, pairwise-dist kernel + centroid allreduce over
+ICI"). The architecture reuses the PCA frame (SURVEY.md §7 step 6): a
+sharded partition kernel + psum + finalize.
+
+TPU-first design decisions:
+
+* The assignment step is one MXU GEMM (pairwise distances via the Gram
+  trick, ops/distances.py), and the update step is another (one-hot
+  assignments ᵀ @ points), so the whole Lloyd iteration is GEMM-bound.
+* The ENTIRE Lloyd loop runs inside a single ``lax.while_loop`` under
+  ``shard_map`` — centroids carry on device, per-iteration psums ride ICI,
+  and nothing touches the host until convergence. This is the design the
+  reference's per-task JNI-call pattern cannot express (SURVEY.md §3.4).
+* Convergence = squared centroid movement ≤ tol², matching Spark MLlib's
+  KMeans convergence criterion shape.
+* Empty clusters keep their previous centroid (Spark behavior).
+
+Init: "k-means++" on a host-side subsample (the classic D² weighting;
+Spark's k-means|| is a distributed approximation of the same thing — for
+the sizes where init dominates, the subsample bound keeps it O(sample·k·d)).
+"random" picks k distinct rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.core.dataset import as_matrix, with_column
+from spark_rapids_ml_tpu.core.params import (
+    Estimator,
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    Model,
+    ParamDecl,
+    TypeConverters,
+)
+from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
+from spark_rapids_ml_tpu.ops.distances import sq_euclidean
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel.sharding import shard_rows
+from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
-class KMeans(Estimator):
+class KMeansSolution(NamedTuple):
+    centers: np.ndarray  # (k, d)
+    cost: float  # sum of squared distances to nearest center (training cost)
+    n_iter: int
+    n_rows: int
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _kmeans_plus_plus(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
+    """Classic k-means++ D² seeding on a host subsample."""
+    n = x.shape[0]
+    sample = x if n <= 65536 else x[rng.choice(n, 65536, replace=False)]
+    m = sample.shape[0]
+    centers = np.empty((k, x.shape[1]), dtype=np.float64)
+    centers[0] = sample[rng.integers(m)]
+    d2 = np.sum((sample - centers[0]) ** 2, axis=1)
+    for i in range(1, k):
+        total = d2.sum()
+        if total <= 0:
+            centers[i:] = sample[rng.integers(m, size=k - i)]
+            break
+        probs = d2 / total
+        centers[i] = sample[rng.choice(m, p=probs)]
+        d2 = np.minimum(d2, np.sum((sample - centers[i]) ** 2, axis=1))
+    return centers
+
+
+def _random_init(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
+    idx = rng.choice(x.shape[0], size=k, replace=False)
+    return np.asarray(x[idx], dtype=np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Lloyd loop (one compiled program)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _lloyd_fn(mesh: Mesh, k: int, max_iter: int, tol: float, cd: str, ad: str):
+    compute_dtype = jnp.dtype(cd)
+    accum_dtype = jnp.dtype(ad)
+
+    def lloyd_shard(x, mask, centers0):
+        xc = x.astype(compute_dtype)
+        maskc = mask.astype(accum_dtype)
+
+        def assign_and_update(centers):
+            d2 = sq_euclidean(
+                xc, centers.astype(compute_dtype), accum_dtype=accum_dtype
+            )
+            assign = jnp.argmin(d2, axis=1)
+            min_d2 = jnp.min(d2, axis=1)
+            onehot = (
+                jax.nn.one_hot(assign, k, dtype=compute_dtype)
+                * maskc[:, None].astype(compute_dtype)
+            )
+            # (k, d) sums and (k,) counts — both MXU/VPU friendly.
+            sums = jax.lax.dot_general(
+                onehot, xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=accum_dtype,
+            )
+            counts = jnp.sum(onehot.astype(accum_dtype), axis=0)
+            sums = jax.lax.psum(sums, DATA_AXIS)
+            counts = jax.lax.psum(counts, DATA_AXIS)
+            cost = jax.lax.psum(jnp.sum(min_d2 * maskc), DATA_AXIS)
+            new_centers = jnp.where(
+                (counts > 0)[:, None], sums / jnp.maximum(counts, 1)[:, None], centers
+            )
+            return new_centers, cost
+
+        def cond(carry):
+            _, _, moved2, it = carry
+            return jnp.logical_and(it < max_iter, moved2 > tol * tol)
+
+        def body(carry):
+            centers, _, _, it = carry
+            new_centers, cost = assign_and_update(centers)
+            moved2 = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+            return new_centers, cost, moved2, it + 1
+
+        centers0 = centers0.astype(accum_dtype)
+        init = (centers0, jnp.array(jnp.inf, accum_dtype), jnp.array(jnp.inf, accum_dtype), 0)
+        centers, cost, _, n_iter = jax.lax.while_loop(cond, body, init)
+        # Final cost at the converged centers.
+        _, final_cost = assign_and_update(centers)
+        return centers, final_cost, n_iter
+
+    f = jax.shard_map(
+        lloyd_shard,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(f)
+
+
+def fit_kmeans(
+    x: np.ndarray,
+    k: int,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+    seed: int = 0,
+    init: str = "k-means++",
+    mesh: Optional[Mesh] = None,
+) -> KMeansSolution:
+    mesh = mesh or default_mesh()
+    x = np.asarray(x)
+    n, d = x.shape
+    if not 0 < k <= n:
+        raise ValueError(f"k = {k} out of range (0, numRows = {n}]")
+    rng = np.random.default_rng(seed)
+    with trace_span("kmeans init"):
+        if init == "k-means++":
+            centers0 = _kmeans_plus_plus(x, k, rng)
+        elif init == "random":
+            centers0 = _random_init(x, k, rng)
+        else:
+            raise ValueError(f"unknown init mode {init!r} (k-means++|random)")
+    with trace_span("lloyd"):
+        xs, mask, n_true = shard_rows(x, mesh)
+        fn = _lloyd_fn(
+            mesh,
+            k,
+            max_iter,
+            float(tol),
+            config.get("compute_dtype"),
+            config.get("accum_dtype"),
+        )
+        centers, cost, n_iter = jax.device_get(
+            fn(xs, mask, jnp.asarray(centers0))
+        )
+    return KMeansSolution(
+        centers=np.asarray(centers, dtype=np.float64),
+        cost=float(cost),
+        n_iter=int(n_iter),
+        n_rows=n_true,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Estimator / Model
+# ---------------------------------------------------------------------------
+
+
+class _KMeansParams(HasFeaturesCol, HasPredictionCol, HasMaxIter, HasTol, HasSeed):
+    k = ParamDecl("k", "number of clusters (> 0)", TypeConverters.toInt)
+    initMode = ParamDecl(
+        "initMode", "initialization: k-means++ | random", TypeConverters.toString
+    )
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+        self.setDefault(
+            k=2,
+            maxIter=20,
+            tol=1e-4,
+            seed=0,
+            initMode="k-means++",
+            featuresCol="features",
+            predictionCol="prediction",
+        )
+
+    def getK(self) -> int:
+        return self.getOrDefault(self.k)
+
+    def getInitMode(self) -> str:
+        return self.getOrDefault(self.initMode)
+
+
+class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
+    """``KMeans().setK(100).fit(df)`` — Spark ML clustering API shape."""
+
     _uid_prefix = "KMeans"
 
+    def __init__(self, uid=None, mesh: Optional[Mesh] = None):
+        super().__init__(uid=uid)
+        self._mesh = mesh
 
-class KMeansModel(Model):
+    def setK(self, value: int) -> "KMeans":
+        return self._set(k=value)
+
+    def setInitMode(self, value: str) -> "KMeans":
+        return self._set(initMode=value)
+
+    def _copy_extra_state(self, source):
+        self._mesh = getattr(source, "_mesh", None)
+
+    def _fit(self, dataset) -> "KMeansModel":
+        x = as_matrix(dataset, self.getFeaturesCol())
+        sol = fit_kmeans(
+            x,
+            k=self.getK(),
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+            seed=self.getSeed(),
+            init=self.getInitMode(),
+            mesh=self._mesh,
+        )
+        model = KMeansModel(centers=sol.centers)
+        model.uid = self.uid
+        model._training_cost = sol.cost
+        model._n_iter = sol.n_iter
+        self._copy_params_to(model)
+        return model
+
+
+class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
+    """Fitted centers + predict(); ``summary.trainingCost`` equivalent."""
+
     _uid_prefix = "KMeansModel"
+
+    def __init__(self, centers: Optional[np.ndarray] = None, uid=None):
+        super().__init__(uid=uid)
+        self.centers = None if centers is None else np.asarray(centers)
+        self._training_cost: Optional[float] = None
+        self._n_iter: Optional[int] = None
+        self._predict_cache: dict = {}
+
+    def clusterCenters(self) -> np.ndarray:
+        return self.centers
+
+    @property
+    def trainingCost(self) -> Optional[float]:
+        return self._training_cost
+
+    def _model_data(self):
+        return {"clusterCenters": self.centers}
+
+    @classmethod
+    def _from_model_data(cls, uid, data):
+        return cls(centers=data["clusterCenters"], uid=uid)
+
+    def _copy_extra_state(self, source):
+        self.centers = source.centers
+        self._training_cost = source._training_cost
+        self._n_iter = source._n_iter
+        self._predict_cache = {}
+
+    def _predictor(self):
+        key = (config.get("compute_dtype"), config.get("accum_dtype"))
+        if key not in self._predict_cache:
+            centers_dev = jnp.asarray(self.centers, dtype=jnp.dtype(key[0]))
+            accum = jnp.dtype(key[1])
+
+            @jax.jit
+            def predict(x):
+                d2 = sq_euclidean(x.astype(centers_dev.dtype), centers_dev, accum_dtype=accum)
+                return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+            self._predict_cache[key] = predict
+        return self._predict_cache[key]
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x)
+        from spark_rapids_ml_tpu.parallel.sharding import pad_rows
+
+        n = x.shape[0]
+        bucket = max(256, 1 << (n - 1).bit_length()) if n else 256
+        xp, _ = pad_rows(x, bucket)
+        out = np.asarray(jax.device_get(self._predictor()(xp)))[:n]
+        return out
+
+    def _transform(self, dataset):
+        if self.centers is None:
+            raise RuntimeError("KMeansModel has no centers (unfitted?)")
+        x = as_matrix(dataset, self.getFeaturesCol())
+        return with_column(dataset, self.getPredictionCol(), self.predict(x))

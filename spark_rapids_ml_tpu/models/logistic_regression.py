@@ -1,11 +1,374 @@
-"""LogisticRegression — placeholder, implemented in the breadth pass."""
+"""LogisticRegression — distributed full-batch Newton (IRLS) / GD.
 
-from spark_rapids_ml_tpu.core.params import Estimator, Model
+BASELINE.json config #4 pairs LogisticRegression with the normal-equations
+family. TPU-first shape: every Newton iteration is two sharded GEMMs
+(gradient Xᵀr and Hessian XᵀDX) + psum over ICI, then a d×d Cholesky solve
+on device — the same partition-kernel + collective + finalize frame as PCA
+(SURVEY.md §7 step 6). The whole optimization loop runs inside ONE
+``lax.while_loop`` under ``shard_map``: data stays sharded on device for
+all iterations, nothing returns to the host until convergence.
+
+Objective (Spark ML LogisticRegression, ``standardization=False``):
+
+    min_w 1/n Σ log(1 + exp(−ŷᵢ·(xᵢw + b))) + λ/2·‖w‖₂²   (binary, L2)
+
+Binary labels are {0, 1}. Multinomial (softmax) uses the same loop with
+full-batch gradient descent + Nesterov momentum (the k·d×k·d Hessian is not
+materialized). Intercept is unpenalized, as in Spark.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.core.dataset import as_column, as_matrix, with_column
+from spark_rapids_ml_tpu.core.params import (
+    Estimator,
+    HasFeaturesCol,
+    HasFitIntercept,
+    HasLabelCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRegParam,
+    HasTol,
+    Model,
+)
+from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel.sharding import shard_rows
+from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
-class LogisticRegression(Estimator):
+class LogisticSolution(NamedTuple):
+    coefficients: np.ndarray  # (d,) binary or (c, d) multinomial
+    intercept: np.ndarray  # scalar (binary) or (c,)
+    n_iter: int
+    n_rows: int
+    loss: Optional[float] = None  # final training objective (binary path)
+
+
+@functools.lru_cache(maxsize=32)
+def _newton_fn(mesh: Mesh, reg: float, fit_intercept: bool, max_iter: int, tol: float, ad: str):
+    """Binary Newton-IRLS, whole loop in one compiled SPMD program."""
+    accum = jnp.dtype(ad)
+
+    def shard(x, y, mask):
+        xc = x.astype(accum)
+        yc = y.astype(accum)
+        maskc = mask.astype(accum)
+        n = jax.lax.psum(jnp.sum(maskc), DATA_AXIS)
+        d = x.shape[1]
+
+        def grad_hess(w, b):
+            z = xc @ w + b
+            p = jax.nn.sigmoid(z)
+            r = (p - yc) * maskc  # dL/dz, masked
+            grad_w = jax.lax.psum(xc.T @ r, DATA_AXIS) / n + reg * w
+            grad_b = jax.lax.psum(jnp.sum(r), DATA_AXIS) / n
+            wgt = jnp.maximum(p * (1.0 - p), 1e-10) * maskc
+            xw = xc * wgt[:, None]
+            h_ww = jax.lax.psum(
+                jax.lax.dot_general(xw, xc, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=accum),
+                DATA_AXIS,
+            ) / n + reg * jnp.eye(d, dtype=accum)
+            h_wb = jax.lax.psum(jnp.sum(xw, axis=0), DATA_AXIS) / n
+            h_bb = jax.lax.psum(jnp.sum(wgt), DATA_AXIS) / n
+            return grad_w, grad_b, h_ww, h_wb, h_bb
+
+        def loss_of(w, b):
+            z = xc @ w + b
+            # log(1+e^-z) for y=1, log(1+e^z) for y=0, numerically stable.
+            per = (jax.nn.softplus(z) - yc * z) * maskc
+            return jax.lax.psum(jnp.sum(per), DATA_AXIS) / n + 0.5 * reg * (w @ w)
+
+        def body(carry):
+            w, b, _, it = carry
+            grad_w, grad_b, h_ww, h_wb, h_bb = grad_hess(w, b)
+            if fit_intercept:
+                # Solve the bordered (d+1) system via block elimination:
+                # [H_ww h_wb][dw]   [g_w]
+                # [h_wbᵀ h_bb][db] = [g_b]
+                hinv_hwb = jnp.linalg.solve(h_ww, h_wb)
+                hinv_gw = jnp.linalg.solve(h_ww, grad_w)
+                schur = jnp.maximum(h_bb - h_wb @ hinv_hwb, 1e-12)
+                db = (grad_b - h_wb @ hinv_gw) / schur
+                dw = hinv_gw - hinv_hwb * db
+            else:
+                dw = jnp.linalg.solve(h_ww, grad_w)
+                db = jnp.zeros((), accum)
+            new_w = w - dw
+            new_b = b - db
+            delta = jnp.sqrt(jnp.sum(dw * dw) + db * db)
+            return new_w, new_b, delta, it + 1
+
+        def cond(carry):
+            _, _, delta, it = carry
+            return jnp.logical_and(it < max_iter, delta > tol)
+
+        w0 = jnp.zeros((d,), accum)
+        b0 = jnp.zeros((), accum)
+        w, b, _, n_iter = jax.lax.while_loop(
+            cond, body, (w0, b0, jnp.array(jnp.inf, accum), 0)
+        )
+        return w, b, n_iter, loss_of(w, b)
+
+    f = jax.shard_map(
+        shard,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(), P()),
+    )
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=32)
+def _softmax_gd_fn(
+    mesh: Mesh, n_classes: int, reg: float, fit_intercept: bool, max_iter: int, tol: float, ad: str
+):
+    """Multinomial softmax via Nesterov full-batch GD, one compiled loop."""
+    accum = jnp.dtype(ad)
+    c = n_classes
+
+    def shard(x, y_onehot, mask):
+        xc = x.astype(accum)
+        yc = y_onehot.astype(accum)
+        maskc = mask.astype(accum)
+        n = jax.lax.psum(jnp.sum(maskc), DATA_AXIS)
+        d = x.shape[1]
+
+        def grads(w, b):
+            # w: (d, c), b: (c,)
+            logits = xc @ w + b
+            p = jax.nn.softmax(logits, axis=1)
+            r = (p - yc) * maskc[:, None]
+            gw = jax.lax.psum(
+                jax.lax.dot_general(xc, r, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=accum),
+                DATA_AXIS,
+            ) / n + reg * w
+            gb = jax.lax.psum(jnp.sum(r, axis=0), DATA_AXIS) / n
+            if not fit_intercept:
+                gb = jnp.zeros_like(gb)
+            return gw, gb
+
+        # Lipschitz bound for softmax CE: L <= 0.5·λ_max(XᵀX)/n + reg.
+        # Estimate λ_max by power iteration on the psum'd Gram.
+        gram = jax.lax.psum(
+            jax.lax.dot_general(xc * maskc[:, None], xc * maskc[:, None],
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=accum),
+            DATA_AXIS,
+        )
+
+        def power(v, _):
+            v = gram @ v
+            return v / jnp.maximum(jnp.linalg.norm(v), 1e-30), None
+
+        v, _ = jax.lax.scan(power, jnp.ones((d,), accum) / jnp.sqrt(d), None, length=30)
+        lmax = jnp.maximum(v @ (gram @ v), 1e-12)
+        step = 1.0 / (0.5 * lmax / n + reg + 1e-12)
+
+        def body(carry):
+            w, b, zw, zb, t, _, it = carry
+            gw, gb = grads(zw, zb)
+            w_next = zw - step * gw
+            b_next = zb - step * gb
+            t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+            mom = (t - 1.0) / t_next
+            zw_next = w_next + mom * (w_next - w)
+            zb_next = b_next + mom * (b_next - b)
+            delta = jnp.sqrt(jnp.sum((w_next - w) ** 2) + jnp.sum((b_next - b) ** 2))
+            return w_next, b_next, zw_next, zb_next, t_next, delta, it + 1
+
+        def cond(carry):
+            delta, it = carry[5], carry[6]
+            return jnp.logical_and(it < max_iter, delta > tol)
+
+        w0 = jnp.zeros((d, c), accum)
+        b0 = jnp.zeros((c,), accum)
+        w, b, _, _, _, _, n_iter = jax.lax.while_loop(
+            cond,
+            body,
+            (w0, b0, w0, b0, jnp.array(1.0, accum), jnp.array(jnp.inf, accum), 0),
+        )
+        return w, b, n_iter
+
+    f = jax.shard_map(
+        shard,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(f)
+
+
+def fit_logistic_regression(
+    x: np.ndarray,
+    y: np.ndarray,
+    reg: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    mesh: Optional[Mesh] = None,
+) -> LogisticSolution:
+    mesh = mesh or default_mesh()
+    x = np.asarray(x)
+    y = np.asarray(y).reshape(-1)
+    if x.shape[0] != y.shape[0]:
+        raise ValueError(f"X rows {x.shape[0]} != y rows {y.shape[0]}")
+    classes = np.unique(y)
+    n_classes = len(classes)
+    if n_classes < 2:
+        raise ValueError("need at least 2 classes in the label column")
+    if not np.array_equal(classes, np.arange(n_classes)):
+        raise ValueError(
+            f"labels must be 0..{n_classes - 1} (Spark ML convention); got {classes[:8]}"
+        )
+    ad = config.get("accum_dtype")
+    with trace_span("logreg fit"):
+        xs, mask, n_true = shard_rows(x, mesh)
+        if n_classes == 2:
+            ys, _, _ = shard_rows(y.astype(np.float64), mesh)
+            fn = _newton_fn(mesh, float(reg), bool(fit_intercept), int(max_iter), float(tol), ad)
+            w, b, n_iter, loss = jax.device_get(fn(xs, ys, mask))
+            return LogisticSolution(
+                coefficients=np.asarray(w, dtype=np.float64),
+                intercept=np.asarray(b, dtype=np.float64),
+                n_iter=int(n_iter),
+                n_rows=n_true,
+                loss=float(loss),
+            )
+        onehot = np.eye(n_classes, dtype=np.float64)[y.astype(np.int64)]
+        os_, _, _ = shard_rows(onehot, mesh)
+        fn = _softmax_gd_fn(
+            mesh, n_classes, float(reg), bool(fit_intercept), int(max_iter), float(tol), ad
+        )
+        w, b, n_iter = jax.device_get(fn(xs, os_, mask))
+        return LogisticSolution(
+            coefficients=np.asarray(w.T, dtype=np.float64),  # (c, d) Spark layout
+            intercept=np.asarray(b, dtype=np.float64),
+            n_iter=int(n_iter),
+            n_rows=n_true,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Estimator / Model
+# ---------------------------------------------------------------------------
+
+
+class _LogisticRegressionParams(
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasRegParam,
+    HasFitIntercept,
+    HasMaxIter,
+    HasTol,
+):
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+        self.setDefault(
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+            regParam=0.0,
+            fitIntercept=True,
+            maxIter=100,
+            tol=1e-6,
+        )
+
+
+class LogisticRegression(Estimator, _LogisticRegressionParams, MLWritable, MLReadable):
     _uid_prefix = "LogisticRegression"
 
+    def __init__(self, uid=None, mesh: Optional[Mesh] = None):
+        super().__init__(uid=uid)
+        self._mesh = mesh
 
-class LogisticRegressionModel(Model):
+    def setRegParam(self, value: float) -> "LogisticRegression":
+        return self._set(regParam=value)
+
+    def _copy_extra_state(self, source):
+        self._mesh = getattr(source, "_mesh", None)
+
+    def _fit(self, dataset) -> "LogisticRegressionModel":
+        x = as_matrix(dataset, self.getFeaturesCol())
+        y = as_column(dataset, self.getLabelCol())
+        sol = fit_logistic_regression(
+            x,
+            y,
+            reg=self.getRegParam(),
+            fit_intercept=self.getFitIntercept(),
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+            mesh=self._mesh,
+        )
+        model = LogisticRegressionModel(
+            coefficients=sol.coefficients, intercept=sol.intercept
+        )
+        model.uid = self.uid
+        self._copy_params_to(model)
+        return model
+
+
+class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLReadable):
     _uid_prefix = "LogisticRegressionModel"
+
+    def __init__(self, coefficients=None, intercept=None, uid=None):
+        super().__init__(uid=uid)
+        self.coefficients = None if coefficients is None else np.asarray(coefficients)
+        self.intercept = None if intercept is None else np.asarray(intercept)
+
+    @property
+    def numClasses(self) -> int:
+        if self.coefficients is None:
+            return 0
+        return 2 if self.coefficients.ndim == 1 else self.coefficients.shape[0]
+
+    def _model_data(self):
+        return {
+            "coefficients": self.coefficients,
+            "intercept": np.atleast_1d(self.intercept),
+        }
+
+    @classmethod
+    def _from_model_data(cls, uid, data):
+        coef = data["coefficients"]
+        inter = data["intercept"]
+        if coef.ndim == 1 or coef.shape[0] == 1:
+            coef = coef.reshape(-1)
+            inter = np.asarray(inter).reshape(-1)[0]
+        return cls(coefficients=coef, intercept=inter, uid=uid)
+
+    def _copy_extra_state(self, source):
+        self.coefficients = source.coefficients
+        self.intercept = source.intercept
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        if self.coefficients.ndim == 1:
+            z = x @ self.coefficients + float(np.asarray(self.intercept).reshape(-1)[0])
+            p1 = 1.0 / (1.0 + np.exp(-z))
+            return np.stack([1.0 - p1, p1], axis=1)
+        logits = x @ self.coefficients.T + np.asarray(self.intercept)[None, :]
+        logits -= logits.max(axis=1, keepdims=True)
+        e = np.exp(logits)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.argmax(self.predict_proba(x), axis=1)
+
+    def _transform(self, dataset):
+        if self.coefficients is None:
+            raise RuntimeError("model has no coefficients (unfitted?)")
+        x = as_matrix(dataset, self.getFeaturesCol())
+        return with_column(dataset, self.getPredictionCol(), self.predict(x))

@@ -43,7 +43,7 @@ from spark_rapids_ml_tpu.core.params import (
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops import gram as gram_ops
-from spark_rapids_ml_tpu.ops.eigh import pca_from_gram
+from spark_rapids_ml_tpu.ops.eigh import pca_from_gram, pca_from_gram_host
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -69,12 +69,37 @@ class PCASolution(NamedTuple):
 # ---------------------------------------------------------------------------
 
 
+def _use_host_finalize(mesh: Mesh) -> bool:
+    """Host-LAPACK eig finalize on TPU meshes (config ``finalize``).
+
+    eigh is iterative and XLA executes it poorly on TPU for large d; the d×d
+    Gram is tiny to fetch, and the reference likewise ran its eig as a
+    separate single-device stage (RapidsRowMatrix.scala:70-86)."""
+    mode = config.get("finalize")
+    if mode == "host":
+        return True
+    if mode == "device":
+        return False
+    platform = next(iter(mesh.devices.flat)).platform
+    return platform != "cpu"
+
+
 @functools.lru_cache(maxsize=32)
-def _fit_fn(mesh: Mesh, k: int, mean_center: bool, two_d: bool, cd: str, ad: str):
-    """Compile the full fit (stats + psum + eig finalize) once per config.
+def _fit_fn(
+    mesh: Mesh,
+    k: int,
+    mean_center: bool,
+    two_d: bool,
+    cd: str,
+    ad: str,
+    fuse_finalize: bool = True,
+):
+    """Compile the fit (stats + psum [+ eig finalize]) once per config.
 
     ``cd``/``ad`` (compute/accum dtype names) are part of the cache key so a
     config change recompiles rather than silently reusing old-dtype programs.
+    With ``fuse_finalize=False`` the program stops at the replicated stats
+    (host finalize path).
     """
 
     def fit(x, mask):
@@ -96,11 +121,26 @@ def _fit_fn(mesh: Mesh, k: int, mean_center: bool, two_d: bool, cd: str, ad: str
                 out_specs=(P(), P(), P()),
             )
         count, colsum, g = stats(x, mask)
+        if not fuse_finalize:
+            return count, colsum, g
         g, mean = gram_ops.finalize_gram(count, colsum, g, mean_center)
         pc, ev, s = pca_from_gram(g, k)
         return pc, ev, s, mean, count
 
     return jax.jit(fit)
+
+
+def _finalize_on_host(count, colsum, gram, mean_center: bool, k: int):
+    """Centering + calSVD-equivalent on host float64 (TPU finalize path)."""
+    count = float(np.asarray(count))
+    colsum = np.asarray(colsum, dtype=np.float64)
+    g = np.asarray(gram, dtype=np.float64)
+    n = max(count, 1.0)
+    mean = colsum / n
+    if mean_center:
+        g = g - np.outer(mean, colsum)
+    pc, ev, s = pca_from_gram_host(g, k)
+    return pc, ev, s, mean, count
 
 
 def fit_pca(
@@ -127,6 +167,7 @@ def fit_pca(
             mask = jax.device_put(mask_np, NamedSharding(mesh, P(DATA_AXIS)))
         else:
             xs, mask, n_true = shard_rows(x, mesh)
+        host_finalize = _use_host_finalize(mesh)
         fit = _fit_fn(
             mesh,
             k,
@@ -134,10 +175,16 @@ def fit_pca(
             two_d,
             config.get("compute_dtype"),
             config.get("accum_dtype"),
+            fuse_finalize=not host_finalize,
         )
-        pc, ev, s, mean, count = fit(xs, mask)
+        out = fit(xs, mask)
     with trace_span("eig finalize"):
-        pc, ev, s, mean = jax.device_get((pc, ev, s, mean))
+        if host_finalize:
+            count, colsum, g = out
+            pc, ev, s, mean, _ = _finalize_on_host(count, colsum, g, mean_center, k)
+        else:
+            pc, ev, s, mean, count = out
+            pc, ev, s, mean = jax.device_get((pc, ev, s, mean))
     return PCASolution(
         pc=np.asarray(pc, dtype=np.float64),
         explained_variance=np.asarray(ev, dtype=np.float64),
@@ -180,14 +227,16 @@ def fit_pca_stream(
             state = update(state, xs, ms)
     count, colsum, g = state
     with trace_span("eig finalize"):
-        finalize = jax.jit(
-            lambda c, cs, gg: pca_from_gram(
-                gram_ops.finalize_gram(c, cs, gg, mean_center)[0], k
-            ),
-            static_argnums=(),
-        )
-        pc, ev, s = jax.device_get(finalize(count, colsum, g))
-        mean = jax.device_get(colsum / jnp.maximum(count, 1))
+        if _use_host_finalize(mesh):
+            pc, ev, s, mean, _ = _finalize_on_host(count, colsum, g, mean_center, k)
+        else:
+            finalize = jax.jit(
+                lambda c, cs, gg: pca_from_gram(
+                    gram_ops.finalize_gram(c, cs, gg, mean_center)[0], k
+                )
+            )
+            pc, ev, s = jax.device_get(finalize(count, colsum, g))
+            mean = jax.device_get(colsum / jnp.maximum(count, 1))
     return PCASolution(
         pc=np.asarray(pc, dtype=np.float64),
         explained_variance=np.asarray(ev, dtype=np.float64),
@@ -263,11 +312,7 @@ class PCA(Estimator, _PCAParams, MLWritable, MLReadable):
         )
         model.uid = self.uid
         # Parent params flow to the model — Model.copy semantics in Spark.
-        for name, p in self._params.items():
-            if p in self._paramMap and model.hasParam(name):
-                model._set(**{name: self._paramMap[p]})
-            if p in self._defaultParamMap and model.hasParam(name):
-                model.setDefault(**{name: self._defaultParamMap[p]})
+        self._copy_params_to(model)
         return model
 
 

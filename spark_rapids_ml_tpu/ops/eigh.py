@@ -73,3 +73,25 @@ def pca_from_gram(gram: jax.Array, k: int) -> Tuple[jax.Array, jax.Array, jax.Ar
     v = sign_flip(v)
     s, ev = explained_variance_reference(w)
     return v[:, :k], ev[:k], s
+
+
+def pca_from_gram_host(gram, k: int):
+    """Host (NumPy/LAPACK, float64) version of :func:`pca_from_gram`.
+
+    Used when the mesh's devices execute eigh poorly (TPU: eigh is an
+    iterative algorithm that XLA compiles/executes badly for large d, while
+    the d×d Gram is tiny to fetch). Architecturally this matches the
+    reference, where the eig ran as its own single-device stage separate
+    from the distributed reduction (RapidsRowMatrix.scala:70-86).
+    """
+    import numpy as np
+
+    a = np.asarray(gram, dtype=np.float64)
+    w, v = np.linalg.eigh(a)
+    w, v = w[::-1], v[:, ::-1]
+    idx = np.argmax(np.abs(v), axis=0)
+    signs = np.where(v[idx, np.arange(v.shape[1])] < 0, -1.0, 1.0)
+    v = v * signs
+    s = np.sqrt(np.clip(w, 0, None))
+    ev = s / max(s.sum(), 1e-300)
+    return v[:, :k], ev[:k], s

@@ -1,0 +1,110 @@
+"""KMeans differential tests: sklearn oracle + sharding invariance."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture
+def blobs(rng):
+    # 4 well-separated gaussian blobs in 8-d.
+    centers = rng.normal(size=(4, 8)) * 10.0
+    pts = np.concatenate(
+        [c + rng.normal(size=(200, 8)) for c in centers], axis=0
+    )
+    perm = rng.permutation(len(pts))
+    return pts[perm], centers
+
+
+def _match_centers(found, true):
+    """Greedy-match found centers to true ones; return max distance."""
+    found = found.copy()
+    worst = 0.0
+    for t in true:
+        d = np.linalg.norm(found - t, axis=1)
+        i = int(np.argmin(d))
+        worst = max(worst, d[i])
+        found[i] = np.inf
+    return worst
+
+
+def test_recovers_blob_centers(blobs, mesh8):
+    pts, centers = blobs
+    sol = fit_kmeans(pts, k=4, max_iter=50, seed=1, mesh=mesh8)
+    assert sol.n_rows == len(pts)
+    assert sol.n_iter > 0
+    # Each true center recovered to within ~3/sqrt(200) stderr.
+    assert _match_centers(sol.centers, centers) < 0.5
+
+
+def test_matches_sklearn_cost(blobs, mesh8):
+    pts, _ = blobs
+    sk = pytest.importorskip("sklearn.cluster")
+    km = sk.KMeans(n_clusters=4, n_init=3, random_state=0).fit(pts)
+    sol = fit_kmeans(pts, k=4, max_iter=50, seed=1, mesh=mesh8)
+    # Same local optimum on well-separated blobs: inertia within 1%.
+    assert sol.cost <= km.inertia_ * 1.01
+
+
+def test_shard_invariance(blobs):
+    pts, _ = blobs
+    sols = [
+        fit_kmeans(pts, k=4, max_iter=30, seed=7, mesh=make_mesh(data=n, model=1))
+        for n in (1, 8)
+    ]
+    np.testing.assert_allclose(sols[0].centers, sols[1].centers, atol=1e-7)
+    assert abs(sols[0].cost - sols[1].cost) < 1e-6 * max(1.0, sols[0].cost)
+
+
+def test_uneven_rows(mesh8, rng):
+    pts = rng.normal(size=(101, 5))
+    sol = fit_kmeans(pts, k=3, max_iter=10, seed=0, mesh=mesh8)
+    assert sol.centers.shape == (3, 5)
+    assert np.all(np.isfinite(sol.centers))
+
+
+def test_estimator_api(blobs, mesh8):
+    pts, _ = blobs
+    ds = {"features": pts}
+    km = KMeans(mesh=mesh8).setK(4).setMaxIter(30).setSeed(3)
+    model = km.fit(ds)
+    assert model.clusterCenters().shape == (4, 8)
+    assert model.trainingCost is not None and model.trainingCost > 0
+    out = model.transform(ds)
+    preds = out["prediction"]
+    assert preds.shape == (len(pts),)
+    assert set(np.unique(preds)) <= set(range(4))
+    # Points in the same blob get the same cluster: check self-consistency
+    # between predict() and the training assignment structure.
+    p2 = model.predict(pts)
+    np.testing.assert_array_equal(preds, p2)
+
+
+def test_model_persistence(blobs, mesh8, tmp_path):
+    pts, _ = blobs
+    model = KMeans(mesh=mesh8).setK(4).fit({"features": pts})
+    path = str(tmp_path / "km")
+    model.save(path)
+    loaded = KMeansModel.load(path)
+    np.testing.assert_allclose(loaded.centers, model.centers, atol=1e-12)
+    np.testing.assert_array_equal(loaded.predict(pts[:50]), model.predict(pts[:50]))
+
+
+def test_k_validation(mesh8, rng):
+    pts = rng.normal(size=(10, 3))
+    with pytest.raises(ValueError):
+        fit_kmeans(pts, k=0, mesh=mesh8)
+    with pytest.raises(ValueError):
+        fit_kmeans(pts, k=11, mesh=mesh8)
+    with pytest.raises(ValueError):
+        fit_kmeans(pts, k=3, init="bogus", mesh=mesh8)
+
+
+def test_empty_cluster_keeps_center(mesh8):
+    # Force an empty cluster: k=3 but only 2 distinct points.
+    pts = np.array([[0.0, 0.0], [10.0, 10.0]] * 50)
+    sol = fit_kmeans(pts, k=3, max_iter=5, init="random", seed=0, mesh=mesh8)
+    assert np.all(np.isfinite(sol.centers))

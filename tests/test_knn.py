@@ -1,0 +1,151 @@
+"""Nearest-neighbor tests: exact vs sklearn brute force, IVF recall."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    ApproximateNearestNeighbors,
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture
+def db_and_queries(rng):
+    db = rng.normal(size=(500, 16))
+    queries = rng.normal(size=(20, 16))
+    return db, queries
+
+
+def _sklearn_knn(db, queries, k):
+    sk = pytest.importorskip("sklearn.neighbors")
+    nn = sk.NearestNeighbors(n_neighbors=k, algorithm="brute").fit(db)
+    d, i = nn.kneighbors(queries)
+    return d, i
+
+
+def test_exact_matches_sklearn(db_and_queries, mesh8):
+    db, queries = db_and_queries
+    k = 7
+    model = NearestNeighbors(mesh=mesh8).setK(k).fit({"features": db})
+    dists, idx = model.kneighbors(queries)
+    ref_d, ref_i = _sklearn_knn(db, queries, k)
+    np.testing.assert_array_equal(idx, ref_i)
+    np.testing.assert_allclose(dists, ref_d, atol=1e-8)
+
+
+def test_exact_shard_invariance(db_and_queries):
+    db, queries = db_and_queries
+    k = 5
+    outs = []
+    for n in (1, 8):
+        model = NearestNeighbors(mesh=make_mesh(data=n, model=1)).setK(k).fit(
+            {"features": db}
+        )
+        outs.append(model.kneighbors(queries))
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+    np.testing.assert_allclose(outs[0][0], outs[1][0], atol=1e-8)
+
+
+def test_exact_uneven_db_rows(mesh8, rng):
+    # 101 rows: padding rows must never appear as neighbors.
+    db = rng.normal(size=(101, 4))
+    queries = db[:10]
+    model = NearestNeighbors(mesh=mesh8).setK(3).fit({"features": db})
+    dists, idx = model.kneighbors(queries)
+    assert np.all(idx < 101)
+    # Self is always the nearest neighbor at distance 0.
+    np.testing.assert_array_equal(idx[:, 0], np.arange(10))
+    # Gram-trick distances: ‖x‖²+‖y‖²−2xy is only ~eps-accurate at 0.
+    np.testing.assert_allclose(dists[:, 0], 0.0, atol=1e-6)
+
+
+def test_exact_k_exceeds_shard_size(mesh8, rng):
+    # Regression: k larger than the per-device shard (ceil(100/8)=13) must
+    # work as long as k <= total rows.
+    db = rng.normal(size=(100, 6))
+    queries = rng.normal(size=(5, 6))
+    model = NearestNeighbors(mesh=mesh8).setK(20).fit({"features": db})
+    dists, idx = model.kneighbors(queries)
+    ref_d, ref_i = _sklearn_knn(db, queries, 20)
+    np.testing.assert_array_equal(idx, ref_i)
+    np.testing.assert_allclose(dists, ref_d, atol=1e-8)
+
+
+def test_ann_k_validation(rng, mesh8):
+    db = rng.normal(size=(160, 8))
+    ann = (
+        ApproximateNearestNeighbors(mesh=mesh8)
+        .setK(5)
+        .setNlist(16)
+        .setNprobe(1)
+        .fit({"features": db})
+    )
+    with pytest.raises(ValueError):
+        ann.kneighbors(db[:3], k=0)
+    with pytest.raises(ValueError):
+        ann.kneighbors(db[:3], k=161)
+    # Regression: candidate pool (nprobe*maxlen) too small for k must raise
+    # with actionable advice, not crash in top_k.
+    with pytest.raises(ValueError, match="nprobe"):
+        ann.kneighbors(db[:3], k=100)
+
+
+def test_exact_k_validation(db_and_queries, mesh8):
+    db, queries = db_and_queries
+    model = NearestNeighbors(mesh=mesh8).setK(5).fit({"features": db})
+    with pytest.raises(ValueError):
+        model.kneighbors(queries, k=0)
+    with pytest.raises(ValueError):
+        model.kneighbors(queries, k=len(db) + 1)
+
+
+def test_exact_persistence(db_and_queries, mesh8, tmp_path):
+    db, queries = db_and_queries
+    model = NearestNeighbors(mesh=mesh8).setK(4).fit({"features": db})
+    path = str(tmp_path / "nn")
+    model.save(path)
+    loaded = NearestNeighborsModel.load(path)
+    a = model.kneighbors(queries)
+    b = loaded.kneighbors(queries)
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_ivf_flat_recall(rng, mesh8):
+    # Clustered data (IVF's favorable case): recall@10 should be high.
+    centers = rng.normal(size=(16, 24)) * 8
+    db = np.concatenate([c + rng.normal(size=(120, 24)) for c in centers])
+    queries = np.concatenate([c + rng.normal(size=(3, 24)) for c in centers])
+    k = 10
+    ann = (
+        ApproximateNearestNeighbors(mesh=mesh8)
+        .setK(k)
+        .setNlist(16)
+        .setNprobe(4)
+        .fit({"features": db})
+    )
+    dists, idx = ann.kneighbors(queries)
+    ref_d, ref_i = _sklearn_knn(db, queries, k)
+    recall = np.mean(
+        [len(set(idx[i]) & set(ref_i[i])) / k for i in range(len(queries))]
+    )
+    assert recall > 0.9, f"IVF recall@{k} too low: {recall}"
+    # Distances for true positives must agree.
+    assert np.all(np.isfinite(dists))
+
+
+def test_ivf_nprobe_all_is_exact(rng, mesh8):
+    db = rng.normal(size=(200, 8))
+    queries = rng.normal(size=(10, 8))
+    k = 5
+    ann = (
+        ApproximateNearestNeighbors(mesh=mesh8)
+        .setK(k)
+        .setNlist(8)
+        .setNprobe(8)  # probe everything -> exact
+        .fit({"features": db})
+    )
+    _, idx = ann.kneighbors(queries)
+    _, ref_i = _sklearn_knn(db, queries, k)
+    np.testing.assert_array_equal(np.sort(idx, axis=1), np.sort(ref_i, axis=1))

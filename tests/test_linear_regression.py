@@ -1,0 +1,96 @@
+"""LinearRegression differential tests vs numpy/sklearn closed forms."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LinearRegression, LinearRegressionModel
+from spark_rapids_ml_tpu.models.linear_regression import fit_linear_regression
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture
+def regression_data(rng):
+    n, d = 400, 12
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = x @ w_true + 2.5 + 0.01 * rng.normal(size=n)
+    return x, y, w_true
+
+
+def test_ols_matches_lstsq(regression_data, mesh8):
+    x, y, _ = regression_data
+    sol = fit_linear_regression(x, y, mesh=mesh8)
+    xa = np.concatenate([x, np.ones((len(x), 1))], axis=1)
+    ref = np.linalg.lstsq(xa, y, rcond=None)[0]
+    np.testing.assert_allclose(sol.coefficients, ref[:-1], atol=1e-6)
+    assert abs(sol.intercept - ref[-1]) < 1e-6
+
+
+def test_no_intercept(regression_data, mesh8):
+    x, y, _ = regression_data
+    sol = fit_linear_regression(x, y, fit_intercept=False, mesh=mesh8)
+    ref = np.linalg.lstsq(x, y, rcond=None)[0]
+    np.testing.assert_allclose(sol.coefficients, ref, atol=1e-6)
+    assert sol.intercept == 0.0
+
+
+def test_ridge_matches_sklearn(regression_data, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, _ = regression_data
+    lam = 0.3
+    sol = fit_linear_regression(x, y, reg=lam, mesh=mesh8)
+    # Spark's objective is 1/(2n)·RSS + λ/2·‖w‖²  ⇒  sklearn alpha = λ·n.
+    ref = sk.Ridge(alpha=lam * len(x), fit_intercept=True).fit(x, y)
+    np.testing.assert_allclose(sol.coefficients, ref.coef_, atol=1e-5)
+    assert abs(sol.intercept - ref.intercept_) < 1e-5
+
+
+def test_lasso_matches_sklearn(regression_data, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, _ = regression_data
+    lam = 0.1
+    sol = fit_linear_regression(
+        x, y, reg=lam, elastic_net=1.0, max_iter=2000, mesh=mesh8
+    )
+    ref = sk.Lasso(alpha=lam, fit_intercept=True, max_iter=10000).fit(x, y)
+    np.testing.assert_allclose(sol.coefficients, ref.coef_, atol=1e-4)
+    assert abs(sol.intercept - ref.intercept_) < 1e-4
+
+
+def test_elastic_net_matches_sklearn(regression_data, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, _ = regression_data
+    lam, alpha = 0.1, 0.5
+    sol = fit_linear_regression(
+        x, y, reg=lam, elastic_net=alpha, max_iter=2000, mesh=mesh8
+    )
+    ref = sk.ElasticNet(alpha=lam, l1_ratio=alpha, fit_intercept=True, max_iter=10000).fit(x, y)
+    np.testing.assert_allclose(sol.coefficients, ref.coef_, atol=1e-4)
+    assert abs(sol.intercept - ref.intercept_) < 1e-4
+
+
+def test_shard_invariance(regression_data):
+    x, y, _ = regression_data
+    a = fit_linear_regression(x, y, mesh=make_mesh(data=1, model=1))
+    b = fit_linear_regression(x, y, mesh=make_mesh(data=8, model=1))
+    np.testing.assert_allclose(a.coefficients, b.coefficients, atol=1e-9)
+
+
+def test_estimator_api_and_persistence(regression_data, mesh8, tmp_path):
+    x, y, _ = regression_data
+    ds = {"features": x, "label": y}
+    lr = LinearRegression(mesh=mesh8).setRegParam(0.0)
+    model = lr.fit(ds)
+    out = model.transform(ds)
+    resid = out["prediction"] - y
+    assert np.sqrt(np.mean(resid**2)) < 0.05  # noise level is 0.01
+    path = str(tmp_path / "lr")
+    model.save(path)
+    loaded = LinearRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients, atol=1e-12)
+    assert abs(loaded.intercept - model.intercept) < 1e-12
+
+
+def test_shape_mismatch(mesh8, rng):
+    with pytest.raises(ValueError):
+        fit_linear_regression(rng.normal(size=(10, 3)), rng.normal(size=9), mesh=mesh8)

@@ -1,0 +1,103 @@
+"""LogisticRegression differential tests vs sklearn."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LogisticRegression, LogisticRegressionModel
+from spark_rapids_ml_tpu.models.logistic_regression import fit_logistic_regression
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture
+def binary_data(rng):
+    n, d = 600, 6
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    logits = x @ w + 0.5
+    p = 1 / (1 + np.exp(-logits))
+    y = (rng.uniform(size=n) < p).astype(np.float64)
+    return x, y
+
+
+@pytest.fixture
+def multi_data(rng):
+    n, d, c = 600, 5, 3
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=(d, c)) * 2
+    y = np.argmax(x @ w + rng.normal(size=(n, c)) * 0.1, axis=1).astype(np.float64)
+    return x, y
+
+
+def test_binary_matches_sklearn(binary_data, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y = binary_data
+    lam = 0.01
+    sol = fit_logistic_regression(x, y, reg=lam, mesh=mesh8)
+    # Spark objective: 1/n Σ loss + λ/2 ‖w‖²  ⇒  sklearn C = 1/(n·λ).
+    ref = sk.LogisticRegression(C=1.0 / (len(x) * lam), tol=1e-10, max_iter=5000).fit(x, y)
+    np.testing.assert_allclose(sol.coefficients, ref.coef_[0], atol=2e-4)
+    np.testing.assert_allclose(sol.intercept, ref.intercept_[0], atol=2e-4)
+
+
+def test_binary_unregularized_separates(mesh8, rng):
+    # Nearly separable data, small reg to keep it finite.
+    x = np.concatenate([rng.normal(size=(100, 3)) + 3, rng.normal(size=(100, 3)) - 3])
+    y = np.concatenate([np.ones(100), np.zeros(100)])
+    sol = fit_logistic_regression(x, y, reg=1e-3, mesh=mesh8)
+    from spark_rapids_ml_tpu.models.logistic_regression import LogisticRegressionModel
+
+    m = LogisticRegressionModel(coefficients=sol.coefficients, intercept=sol.intercept)
+    acc = np.mean(m.predict(x) == y)
+    assert acc > 0.99
+
+
+def test_multinomial_matches_sklearn(multi_data, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y = multi_data
+    lam = 0.01
+    sol = fit_logistic_regression(x, y, reg=lam, max_iter=3000, tol=1e-9, mesh=mesh8)
+    ref = sk.LogisticRegression(C=1.0 / (len(x) * lam), tol=1e-10, max_iter=5000).fit(x, y)
+    # Softmax parameters are identifiable only up to a per-feature constant
+    # shift across classes; compare class-mean-centered coefficients.
+    ours = sol.coefficients - sol.coefficients.mean(axis=0, keepdims=True)
+    theirs = ref.coef_ - ref.coef_.mean(axis=0, keepdims=True)
+    np.testing.assert_allclose(ours, theirs, atol=5e-3)
+    acc_ours = np.mean(
+        LogisticRegressionModel(
+            coefficients=sol.coefficients, intercept=sol.intercept
+        ).predict(x)
+        == y
+    )
+    acc_ref = ref.score(x, y)
+    assert acc_ours >= acc_ref - 0.01
+
+
+def test_shard_invariance(binary_data):
+    x, y = binary_data
+    a = fit_logistic_regression(x, y, reg=0.01, mesh=make_mesh(data=1, model=1))
+    b = fit_logistic_regression(x, y, reg=0.01, mesh=make_mesh(data=8, model=1))
+    np.testing.assert_allclose(a.coefficients, b.coefficients, atol=1e-8)
+
+
+def test_estimator_api_and_persistence(binary_data, mesh8, tmp_path):
+    x, y = binary_data
+    ds = {"features": x, "label": y}
+    model = LogisticRegression(mesh=mesh8).setRegParam(0.01).fit(ds)
+    assert model.numClasses == 2
+    out = model.transform(ds)
+    assert np.mean(out["prediction"] == y) > 0.7
+    proba = model.predict_proba(x)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-9)
+    path = str(tmp_path / "logreg")
+    model.save(path)
+    loaded = LogisticRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients, atol=1e-12)
+    np.testing.assert_array_equal(loaded.predict(x), model.predict(x))
+
+
+def test_label_validation(mesh8, rng):
+    x = rng.normal(size=(20, 3))
+    with pytest.raises(ValueError, match="at least 2"):
+        fit_logistic_regression(x, np.zeros(20), mesh=mesh8)
+    with pytest.raises(ValueError, match="labels must be"):
+        fit_logistic_regression(x, np.where(rng.uniform(size=20) < 0.5, 1.0, 5.0), mesh=mesh8)

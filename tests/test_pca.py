@@ -129,6 +129,17 @@ def test_float32_mode(data, mesh8):
     np.testing.assert_allclose(sol.explained_variance, ev_ref, atol=1e-3)
 
 
+def test_host_finalize_parity(data, mesh8):
+    # The TPU path (device stats + host LAPACK eig) must equal the fully
+    # fused device path.
+    k = 4
+    a = fit_pca(data, k=k, mesh=mesh8)
+    with config.option("finalize", "host"):
+        b = fit_pca(data, k=k, mesh=mesh8)
+    np.testing.assert_allclose(a.pc, b.pc, atol=1e-8)
+    np.testing.assert_allclose(a.explained_variance, b.explained_variance, atol=1e-10)
+
+
 def test_k_validation(data, mesh8):
     with pytest.raises(ValueError):
         fit_pca(data, k=0, mesh=mesh8)
