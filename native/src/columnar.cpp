@@ -1,0 +1,135 @@
+// libsrml_tpu — native host-side columnar kernels for spark_rapids_ml_tpu.
+//
+// Role in the framework: the host data plane between Arrow columnar batches
+// and TPU device buffers. This is the TPU-native answer to the reference's
+// native layer (/root/reference/native/src): where the reference needed
+// CUDA/cuDF to access LIST-column device buffers zero-copy
+// (lists_column_view::child()), a TPU host feeds devices from HOST memory —
+// so the fast path is multithreaded host-side flatten/validate/cast, wide
+// enough to saturate the host→device DMA, not a device kernel.
+//
+// Exposed via a plain C ABI consumed with ctypes (bridge/native.py); no
+// pybind11 dependency by design. All functions return 0 on success,
+// negative error codes on validation failure (never throw across the ABI).
+//
+// Error codes:
+//   0  ok
+//  -1  invalid argument (null pointer / bad sizes)
+//  -2  ragged input: a row's width differs from n_cols
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(begin, end) over [0, n) items split across up to n_threads
+// workers. `elems_per_item` scales the per-thread floor so the grain is
+// measured in scalar elements, not items (a "row" item can be 1 or 10k
+// elements wide). Small inputs run inline: thread spawn costs ~10-20us
+// each, which would dominate sub-megabyte copies.
+template <typename Fn>
+void parallel_for(int64_t n, int n_threads, int64_t elems_per_item, Fn fn) {
+  constexpr int64_t kMinElemsPerThread = 1 << 20;
+  int64_t min_items =
+      std::max<int64_t>(1, kMinElemsPerThread / std::max<int64_t>(1, elems_per_item));
+  int workers = static_cast<int>(
+      std::min<int64_t>(n_threads, (n + min_items - 1) / min_items));
+  if (workers <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  int64_t chunk = (n + workers - 1) / workers;
+  for (int t = 0; t < workers; ++t) {
+    int64_t begin = t * chunk;
+    int64_t end = std::min<int64_t>(begin + chunk, n);
+    if (begin >= end) break;
+    threads.emplace_back([=] { fn(begin, end); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+template <typename T>
+int flatten_list(const T* values, const int64_t* offsets, int64_t n_rows,
+                 int64_t n_cols, T* out, int n_threads) {
+  if (!values || !offsets || !out || n_rows < 0 || n_cols <= 0) return -1;
+  // Validate widths first (cheap scan; catches ragged input before any
+  // copy so the output buffer is never half-written on failure).
+  std::atomic<int> status{0};
+  parallel_for(n_rows, n_threads, 1, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      if (offsets[i + 1] - offsets[i] != n_cols) {
+        status.store(-2, std::memory_order_relaxed);
+        return;
+      }
+    }
+  });
+  if (status.load()) return status.load();
+  parallel_for(n_rows, n_threads, n_cols, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(out + i * n_cols, values + offsets[i],
+                  static_cast<size_t>(n_cols) * sizeof(T));
+    }
+  });
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int srml_flatten_list_f64(const double* values, const int64_t* offsets,
+                          int64_t n_rows, int64_t n_cols, double* out,
+                          int n_threads) {
+  return flatten_list(values, offsets, n_rows, n_cols, out, n_threads);
+}
+
+int srml_flatten_list_f32(const float* values, const int64_t* offsets,
+                          int64_t n_rows, int64_t n_cols, float* out,
+                          int n_threads) {
+  return flatten_list(values, offsets, n_rows, n_cols, out, n_threads);
+}
+
+// Widened dtype conversion, threaded: Arrow ships float64 list columns by
+// default (Spark DoubleType), the TPU compute dtype is float32/bfloat16 —
+// this cast is on the host critical path for every batch fed to a device.
+int srml_cast_f64_to_f32(const double* src, int64_t n, float* dst,
+                         int n_threads) {
+  if (!src || !dst || n < 0) return -1;
+  parallel_for(n, n_threads, 1, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i)
+      dst[i] = static_cast<float>(src[i]);
+  });
+  return 0;
+}
+
+// Concatenate n_chunks row-blocks (each chunk_rows[c] x n_cols, contiguous)
+// into one matrix — the multi-chunk Arrow ChunkedArray assembly path.
+int srml_concat_chunks_f64(const double** chunks, const int64_t* chunk_rows,
+                           int64_t n_chunks, int64_t n_cols, double* out,
+                           int n_threads) {
+  if (!chunks || !chunk_rows || !out || n_chunks < 0 || n_cols <= 0) return -1;
+  std::vector<int64_t> row_offset(n_chunks + 1, 0);
+  for (int64_t c = 0; c < n_chunks; ++c) {
+    if (!chunks[c] || chunk_rows[c] < 0) return -1;
+    row_offset[c + 1] = row_offset[c] + chunk_rows[c];
+  }
+  int64_t avg_elems =
+      n_chunks ? (row_offset[n_chunks] * n_cols) / std::max<int64_t>(1, n_chunks) : 0;
+  parallel_for(n_chunks, n_threads, avg_elems, [&](int64_t begin, int64_t end) {
+    for (int64_t c = begin; c < end; ++c) {
+      std::memcpy(out + row_offset[c] * n_cols, chunks[c],
+                  static_cast<size_t>(chunk_rows[c]) * n_cols * sizeof(double));
+    }
+  });
+  return 0;
+}
+
+// Library self-description, so the loader can sanity-check the ABI.
+int srml_abi_version() { return 1; }
+
+}  // extern "C"

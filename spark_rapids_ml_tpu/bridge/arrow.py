@@ -31,6 +31,8 @@ try:
 except ImportError:  # pragma: no cover - pyarrow is expected in this image
     pa = None
 
+from spark_rapids_ml_tpu.bridge import native as _native
+
 _FLOAT_TYPES = ("float", "double", "halffloat")
 
 
@@ -52,6 +54,10 @@ def list_column_to_matrix(col, n_cols: Optional[int] = None) -> np.ndarray:
         mats = [_array_to_matrix(c, n_cols) for c in col.chunks if len(c)]
         if not mats:
             return np.empty((0, n_cols or 0))
+        if len(mats) > 1 and mats[0].dtype == np.float64:
+            out = _native.concat_chunks_f64(mats)  # threaded native assembly
+            if out is not None:
+                return out
         return np.concatenate(mats, axis=0)
     return _array_to_matrix(col, n_cols)
 

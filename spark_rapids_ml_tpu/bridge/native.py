@@ -52,7 +52,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
                     _configure(lib)
                     _lib = lib
                     break
-                except OSError:
+                except (OSError, AttributeError):
+                    # AttributeError: stale .so missing a newer export —
+                    # fall through to the next candidate / NumPy fallback.
                     continue
         return _lib
 
@@ -70,6 +72,15 @@ def _configure(lib: ctypes.CDLL) -> None:
     # int srml_cast_f64_to_f32(const double* src, int64_t n, float* dst, int n_threads)
     lib.srml_cast_f64_to_f32.restype = ctypes.c_int
     lib.srml_cast_f64_to_f32.argtypes = [c_p, c_i64, c_p, ctypes.c_int]
+    # int srml_concat_chunks_f64(const double** chunks, const int64_t* rows,
+    #                            int64_t n_chunks, int64_t n_cols, double* out,
+    #                            int n_threads)
+    lib.srml_concat_chunks_f64.restype = ctypes.c_int
+    lib.srml_concat_chunks_f64.argtypes = [c_p, c_p, c_i64, c_i64, c_p, ctypes.c_int]
+    lib.srml_abi_version.restype = ctypes.c_int
+    lib.srml_abi_version.argtypes = []
+    if lib.srml_abi_version() != 1:
+        raise OSError("libsrml_tpu ABI version mismatch")
 
 
 def _nthreads() -> int:
@@ -125,3 +136,33 @@ def cast_f64_to_f32(src: np.ndarray) -> Optional[np.ndarray]:
     if rc != 0:
         return None
     return dst
+
+
+def concat_chunks_f64(chunks) -> Optional[np.ndarray]:
+    """Threaded concat of a list of contiguous (rows_i, d) float64 blocks."""
+    lib = get_lib()
+    if lib is None or not chunks:
+        return None
+    arrs = [np.ascontiguousarray(c) for c in chunks]
+    if any(a.dtype != np.float64 or a.ndim != 2 for a in arrs):
+        return None
+    d = arrs[0].shape[1]
+    if any(a.shape[1] != d for a in arrs):
+        return None
+    n_total = sum(a.shape[0] for a in arrs)
+    out = np.empty((n_total, d), dtype=np.float64)
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs]
+    )
+    rows = np.asarray([a.shape[0] for a in arrs], dtype=np.int64)
+    rc = lib.srml_concat_chunks_f64(
+        ctypes.cast(ptrs, ctypes.c_void_p),
+        rows.ctypes.data_as(ctypes.c_void_p),
+        len(arrs),
+        d,
+        out.ctypes.data_as(ctypes.c_void_p),
+        _nthreads(),
+    )
+    if rc != 0:
+        return None
+    return out

@@ -59,9 +59,16 @@ def shard_rows(
     """
     n_true = x.shape[0]
     n_data = mesh.shape[DATA_AXIS]
-    x, mask = pad_rows(np.asarray(x), n_data)
-    if dtype is not None:
-        x = x.astype(dtype, copy=False)
+    x = np.asarray(x)
+    if dtype is not None and x.dtype != np.dtype(dtype):
+        if x.dtype == np.float64 and np.dtype(dtype) == np.float32:
+            from spark_rapids_ml_tpu.bridge import native as _native
+
+            cast = _native.cast_f64_to_f32(x)  # threaded native cast
+            x = cast if cast is not None else x.astype(np.float32)
+        else:
+            x = x.astype(dtype)
+    x, mask = pad_rows(x, n_data)
     xs = jax.device_put(x, row_sharding(mesh, x.ndim))
     ms = jax.device_put(mask, row_sharding(mesh, 1)) if with_mask else None
     return xs, ms, n_true
