@@ -98,6 +98,12 @@ def flatten_ragged(values: np.ndarray, offsets: np.ndarray, n_cols: int) -> Opti
     if lib is None:
         return None
     n_rows = len(offsets) - 1
+    if n_rows < 0:
+        return None
+    # Bounds check here on the host: the native side never sees the values
+    # length, and a corrupt offsets buffer must not become an OOB memcpy.
+    if n_rows > 0 and (int(offsets[0]) < 0 or int(offsets[-1]) > values.size):
+        return None
     if values.dtype == np.float64:
         fn = lib.srml_flatten_list_f64
         out = np.empty((n_rows, n_cols), dtype=np.float64)

@@ -52,6 +52,11 @@ _DEFAULTS: Dict[str, Any] = {
     "tracing": _env("TRACING", False, _as_bool),
     # Use Pallas kernels for hot ops (Gram, pairwise distance) on TPU.
     "use_pallas": _env("USE_PALLAS", False, _as_bool),
+    # Feature-sharded Gram algorithm: "allgather" (one ICI all_gather of the
+    # full feature width per device) or "ring" (ppermute pipeline — one
+    # block in flight, for feature dims too large to gather). "auto" =
+    # allgather (ring wins when m_local*d doesn't fit alongside the data).
+    "gram_algorithm": _env("GRAM_ALGORITHM", "auto", str),
     # Where the d×d eigendecomposition finalize runs: "auto" = on-device for
     # CPU meshes, host LAPACK (float64) for TPU ("device"/"host" force it).
     # The Gram reduction — the part that scales with data — always runs on

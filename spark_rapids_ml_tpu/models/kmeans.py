@@ -62,6 +62,15 @@ class KMeansSolution(NamedTuple):
     n_rows: int
 
 
+class KMeansSummary(NamedTuple):
+    """Spark's KMeansSummary shape: trainingCost + iteration count."""
+
+    trainingCost: float
+    numIter: int
+    k: int
+    n_rows: int
+
+
 # ---------------------------------------------------------------------------
 # Init
 # ---------------------------------------------------------------------------
@@ -96,21 +105,58 @@ def _random_init(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _pallas_assign_applicable(m_local: int, k: int, d: int, cd) -> bool:
+    """Fused Pallas assignment path: TPU backend, f32, tile-divisible, and a
+    feature width whose (block_m, d) tile fits VMEM."""
+    if not config.get("use_pallas"):
+        return False
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+    except RuntimeError:  # pragma: no cover
+        return False
+    bm = min(1024, m_local)
+    bk = min(128, k)
+    return (
+        jnp.dtype(cd) == jnp.float32
+        and d <= 512
+        and m_local % bm == 0
+        and k % bk == 0
+    )
+
+
 @functools.lru_cache(maxsize=32)
-def _lloyd_fn(mesh: Mesh, k: int, max_iter: int, tol: float, cd: str, ad: str):
+def _lloyd_fn(
+    mesh: Mesh, k: int, max_iter: int, tol: float, cd: str, ad: str, use_pallas: bool = False
+):
+    # `use_pallas` keys the cache; the trace below re-reads config.
     compute_dtype = jnp.dtype(cd)
     accum_dtype = jnp.dtype(ad)
 
     def lloyd_shard(x, mask, centers0):
         xc = x.astype(compute_dtype)
         maskc = mask.astype(accum_dtype)
+        pallas_assign = _pallas_assign_applicable(
+            x.shape[0], k, x.shape[1], compute_dtype
+        )
 
         def assign_and_update(centers):
-            d2 = sq_euclidean(
-                xc, centers.astype(compute_dtype), accum_dtype=accum_dtype
-            )
-            assign = jnp.argmin(d2, axis=1)
-            min_d2 = jnp.min(d2, axis=1)
+            if pallas_assign:
+                from spark_rapids_ml_tpu.ops.pallas_kernels import (
+                    assign_min_dist_pallas,
+                )
+
+                assign, part_d = assign_min_dist_pallas(
+                    xc, centers.astype(compute_dtype)
+                )
+                x2 = jnp.sum(jnp.square(xc.astype(accum_dtype)), axis=1)
+                min_d2 = jnp.maximum(part_d + x2, 0.0)
+            else:
+                d2 = sq_euclidean(
+                    xc, centers.astype(compute_dtype), accum_dtype=accum_dtype
+                )
+                assign = jnp.argmin(d2, axis=1)
+                min_d2 = jnp.min(d2, axis=1)
             onehot = (
                 jax.nn.one_hot(assign, k, dtype=compute_dtype)
                 * maskc[:, None].astype(compute_dtype)
@@ -186,6 +232,7 @@ def fit_kmeans(
             float(tol),
             config.get("compute_dtype"),
             config.get("accum_dtype"),
+            use_pallas=bool(config.get("use_pallas")),
         )
         centers, cost, n_iter = jax.device_get(
             fn(xs, mask, jnp.asarray(centers0))
@@ -261,6 +308,9 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
         model.uid = self.uid
         model._training_cost = sol.cost
         model._n_iter = sol.n_iter
+        model._summary = KMeansSummary(
+            trainingCost=sol.cost, numIter=sol.n_iter, k=self.getK(), n_rows=sol.n_rows
+        )
         self._copy_params_to(model)
         return model
 
@@ -275,7 +325,16 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
         self.centers = None if centers is None else np.asarray(centers)
         self._training_cost: Optional[float] = None
         self._n_iter: Optional[int] = None
+        self._summary: Optional[KMeansSummary] = None
         self._predict_cache: dict = {}
+
+    @property
+    def summary(self) -> Optional[KMeansSummary]:
+        return self._summary
+
+    @property
+    def hasSummary(self) -> bool:
+        return self._summary is not None
 
     def clusterCenters(self) -> np.ndarray:
         return self.centers
@@ -295,6 +354,7 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
         self.centers = source.centers
         self._training_cost = source._training_cost
         self._n_iter = source._n_iter
+        self._summary = getattr(source, "_summary", None)
         self._predict_cache = {}
 
     def _predictor(self):

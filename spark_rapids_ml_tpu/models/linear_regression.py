@@ -51,15 +51,28 @@ from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
+class LinearRegressionTrainingSummary(NamedTuple):
+    """Training metrics computed FROM THE FIT STATISTICS — zero extra data
+    passes (RSS/R²/RMSE are closed forms over the normal-equation moments,
+    unlike Spark MLlib which re-scans the data for its summary)."""
+
+    rmse: float
+    r2: float
+    rss: float
+    tss: float
+    n_rows: int
+
+
 class LinearSolution(NamedTuple):
     coefficients: np.ndarray  # (d,)
     intercept: float
     n_rows: int
+    summary: Optional[LinearRegressionTrainingSummary] = None
 
 
 @functools.lru_cache(maxsize=32)
 def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str):
-    """One fused sharded pass: (XᵀX, Xᵀy, Σx, Σy, n)."""
+    """One fused sharded pass: (XᵀX, Xᵀy, Σx, Σy, Σy², n)."""
     compute_dtype = jnp.dtype(cd)
     accum_dtype = jnp.dtype(ad)
 
@@ -75,16 +88,17 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str):
         )[:, 0]
         sx = jnp.sum(xc.astype(accum_dtype), axis=0)
         sy = jnp.sum(yc)
+        syy = jnp.sum(yc * yc)
         n = jnp.sum(mask.astype(accum_dtype))
         return tuple(
-            jax.lax.psum(v, DATA_AXIS) for v in (xtx, xty, sx, sy, n)
+            jax.lax.psum(v, DATA_AXIS) for v in (xtx, xty, sx, sy, syy, n)
         )
 
     f = jax.shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()),
     )
     return jax.jit(f)
 
@@ -136,7 +150,8 @@ def _solve_fn(
 ):
     """Jitted finalize: stats -> (coefficients, intercept)."""
 
-    def solve(xtx, xty, sx, sy, n):
+    def solve(xtx, xty, sx, sy, syy, n):
+        del syy  # summary-only statistic
         n = jnp.maximum(n, 1.0)
         if fit_intercept:
             mx = sx / n
@@ -189,10 +204,31 @@ def fit_linear_regression(
             bool(fit_intercept), float(reg), float(elastic_net), int(max_iter), float(tol)
         )(*stats)
         w, b = jax.device_get((w, b))
-    return LinearSolution(
-        coefficients=np.asarray(w, dtype=np.float64),
-        intercept=float(b),
+    w = np.asarray(w, dtype=np.float64)
+    b = float(b)
+    xtx, xty, sx, sy, syy, n = (np.asarray(s, dtype=np.float64) for s in stats)
+    n = float(n)
+    # Closed-form training metrics from the moments (no second data pass):
+    # RSS = Σy² − 2(wᵀXᵀy + bΣy) + wᵀXᵀXw + 2b·wᵀΣx + b²n.
+    rss = max(
+        float(
+            syy - 2.0 * (w @ xty + b * sy) + w @ xtx @ w + 2.0 * b * (w @ sx) + b * b * n
+        ),
+        0.0,  # clamp: low-precision compute can round a perfect fit negative
+    )
+    tss = float(syy - sy * sy / max(n, 1.0))
+    summary = LinearRegressionTrainingSummary(
+        rmse=float(np.sqrt(rss / max(n, 1.0))),
+        r2=float(1.0 - rss / tss) if tss > 0 else 0.0,
+        rss=rss,
+        tss=tss,
         n_rows=n_true,
+    )
+    return LinearSolution(
+        coefficients=w,
+        intercept=b,
+        n_rows=n_true,
+        summary=summary,
     )
 
 
@@ -257,6 +293,7 @@ class LinearRegression(Estimator, _LinearRegressionParams, MLWritable, MLReadabl
             coefficients=sol.coefficients, intercept=sol.intercept
         )
         model.uid = self.uid
+        model._summary = sol.summary
         self._copy_params_to(model)
         return model
 
@@ -268,6 +305,13 @@ class LinearRegressionModel(Model, _LinearRegressionParams, MLWritable, MLReadab
         super().__init__(uid=uid)
         self.coefficients = None if coefficients is None else np.asarray(coefficients)
         self.intercept = float(intercept)
+        self._summary: Optional[LinearRegressionTrainingSummary] = None
+
+    @property
+    def summary(self) -> Optional[LinearRegressionTrainingSummary]:
+        """Training metrics (rmse, r2, ...), Spark's model.summary shape.
+        None after persistence reload (metrics are training-time only)."""
+        return self._summary
 
     def _model_data(self):
         return {
@@ -286,6 +330,7 @@ class LinearRegressionModel(Model, _LinearRegressionParams, MLWritable, MLReadab
     def _copy_extra_state(self, source):
         self.coefficients = source.coefficients
         self.intercept = source.intercept
+        self._summary = getattr(source, "_summary", None)
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         x = np.asarray(x)

@@ -46,6 +46,14 @@ from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
+class LogisticTrainingSummary(NamedTuple):
+    """Final objective + iterations, Spark's training-summary shape."""
+
+    loss: Optional[float]
+    numIter: int
+    n_rows: int
+
+
 class LogisticSolution(NamedTuple):
     coefficients: np.ndarray  # (d,) binary or (c, d) multinomial
     intercept: np.ndarray  # scalar (binary) or (c,)
@@ -316,6 +324,9 @@ class LogisticRegression(Estimator, _LogisticRegressionParams, MLWritable, MLRea
             coefficients=sol.coefficients, intercept=sol.intercept
         )
         model.uid = self.uid
+        model._summary = LogisticTrainingSummary(
+            loss=sol.loss, numIter=sol.n_iter, n_rows=sol.n_rows
+        )
         self._copy_params_to(model)
         return model
 
@@ -327,6 +338,11 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
         super().__init__(uid=uid)
         self.coefficients = None if coefficients is None else np.asarray(coefficients)
         self.intercept = None if intercept is None else np.asarray(intercept)
+        self._summary: Optional[LogisticTrainingSummary] = None
+
+    @property
+    def summary(self) -> Optional[LogisticTrainingSummary]:
+        return self._summary
 
     @property
     def numClasses(self) -> int:
@@ -352,6 +368,7 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
     def _copy_extra_state(self, source):
         self.coefficients = source.coefficients
         self.intercept = source.intercept
+        self._summary = getattr(source, "_summary", None)
 
     def predict_proba(self, x: np.ndarray) -> np.ndarray:
         x = np.asarray(x, dtype=np.float64)

@@ -93,7 +93,12 @@ def _fit_fn(
     cd: str,
     ad: str,
     fuse_finalize: bool = True,
+    gram_algo: str = "auto",
+    use_pallas: bool = False,
 ):
+    # `use_pallas` is unused in the body but MUST be in the cache key:
+    # local_stats reads config.use_pallas at trace time, so a config flip
+    # has to miss the cache and retrace (same reason cd/ad are keys).
     """Compile the fit (stats + psum [+ eig finalize]) once per config.
 
     ``cd``/``ad`` (compute/accum dtype names) are part of the cache key so a
@@ -104,13 +109,22 @@ def _fit_fn(
 
     def fit(x, mask):
         if two_d:
+            if gram_algo == "ring":
+                shard_fn = functools.partial(
+                    gram_ops._stats_shard_ring,
+                    compute_dtype=cd,
+                    accum_dtype=ad,
+                    n_model=mesh.shape[MODEL_AXIS],
+                )
+            else:
+                shard_fn = lambda xb, mb: gram_ops._stats_shard_2d(xb, mb, cd, ad)
             stats = jax.shard_map(
-                lambda xb, mb: gram_ops._stats_shard_2d(xb, mb, cd, ad),
+                shard_fn,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
                 out_specs=(P(), P(), P(MODEL_AXIS, None)),
                 # count/colsum are value-replicated over `model` after the
-                # all_gather, which VMA inference can't prove statically.
+                # gather/ring, which VMA inference can't prove statically.
                 check_vma=False,
             )
         else:
@@ -176,6 +190,8 @@ def fit_pca(
             config.get("compute_dtype"),
             config.get("accum_dtype"),
             fuse_finalize=not host_finalize,
+            gram_algo=config.get("gram_algorithm"),
+            use_pallas=bool(config.get("use_pallas")),
         )
         out = fit(xs, mask)
     with trace_span("eig finalize"):

@@ -37,6 +37,25 @@ def _dtypes():
     return jnp.dtype(config.get("compute_dtype")), jnp.dtype(config.get("accum_dtype"))
 
 
+def _pallas_gram_applicable(shape, cd, ad) -> bool:
+    """Pallas Gram path: TPU backend, f32 in/accum, tile-divisible shapes."""
+    if not config.get("use_pallas"):
+        return False
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:  # pragma: no cover
+        return False
+    if backend == "cpu":
+        return False
+    n, d = shape
+    return (
+        jnp.dtype(cd) == jnp.float32
+        and jnp.dtype(ad) == jnp.float32
+        and n % 512 == 0
+        and d % 256 == 0
+    )
+
+
 def local_stats(
     x: jax.Array,
     mask: Optional[jax.Array] = None,
@@ -47,23 +66,31 @@ def local_stats(
 
     The GEMM runs in ``compute_dtype`` (bfloat16 engages the MXU at full
     rate) and accumulates in ``accum_dtype`` via ``preferred_element_type``.
+    With ``config.use_pallas`` on a TPU backend and tile-divisible shapes,
+    the Gram uses the hand-tiled Pallas kernel (mask fused into the load).
     """
     cd, ad = _dtypes()
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else cd
     ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
     xc = x.astype(cd)
     if mask is not None:
-        xc = xc * mask.astype(cd)[:, None]
+        xm = xc * mask.astype(cd)[:, None]
         count = jnp.sum(mask.astype(ad))
     else:
+        xm = xc
         count = jnp.asarray(x.shape[0], dtype=ad)
-    colsum = jnp.sum(xc.astype(ad), axis=0)
-    gram = jax.lax.dot_general(
-        xc,
-        xc,
-        (((0,), (0,)), ((), ())),  # contract over rows: xᵀx
-        preferred_element_type=ad,
-    )
+    colsum = jnp.sum(xm.astype(ad), axis=0)
+    if mask is not None and _pallas_gram_applicable(x.shape, cd, ad):
+        from spark_rapids_ml_tpu.ops.pallas_kernels import gram_pallas
+
+        gram = gram_pallas(xc, mask.astype(cd))
+    else:
+        gram = jax.lax.dot_general(
+            xm,
+            xm,
+            (((0,), (0,)), ((), ())),  # contract over rows: xᵀx
+            preferred_element_type=ad,
+        )
     return count, colsum, gram
 
 
@@ -127,6 +154,71 @@ def sharded_stats_2d(mesh: Mesh, compute_dtype=None, accum_dtype=None):
         out_specs=(P(), P(), P(MODEL_AXIS, None)),
         # count/colsum are value-replicated over `model` after the
         # all_gather, which VMA inference can't prove statically.
+        check_vma=False,
+    )
+    return jax.jit(f)
+
+
+def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
+    """Ring-collective 2-D sharded stats (the ring-attention pattern applied
+    to the Gram): instead of all_gather-ing the full feature width onto
+    every device (peak memory m_local×d, _stats_shard_2d), feature blocks
+    rotate around the ``model``-axis ring via ``lax.ppermute``. Each step
+    computes one (d_local, d_local) off-diagonal Gram block while the next
+    block is in flight on ICI; peak extra memory is one block, and total
+    comm equals the all_gather but pipelined. This is the long-feature
+    analogue of sequence parallelism (SURVEY.md §5 "long-context": the
+    reference has no such axis; here it is first-class).
+    """
+    cd, ad = _dtypes()
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else cd
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
+    xc = x.astype(cd) * mask.astype(cd)[:, None]
+    d_local = x.shape[1]
+    count = jax.lax.psum(jnp.sum(mask.astype(ad)), DATA_AXIS)
+    my_colsum = jnp.sum(xc.astype(ad), axis=0)  # (d_local,)
+    colsum = jax.lax.all_gather(my_colsum, MODEL_AXIS, axis=0, tiled=True)  # (d,) tiny
+    colsum = jax.lax.psum(colsum, DATA_AXIS)
+    idx = jax.lax.axis_index(MODEL_AXIS)
+    perm = [(i, (i + 1) % n_model) for i in range(n_model)]
+
+    def block_at(s, slab, held):
+        block = jax.lax.dot_general(
+            xc, held, (((0,), (0,)), ((), ())), preferred_element_type=ad
+        )  # (d_local, d_local): G[my_block, held_block]
+        col = (((idx - s) % n_model) * d_local).astype(jnp.int32)
+        return jax.lax.dynamic_update_slice(slab, block, (jnp.int32(0), col))
+
+    def body(s, carry):
+        held, slab = carry
+        slab = block_at(s, slab, held)
+        held = jax.lax.ppermute(held, MODEL_AXIS, perm)
+        return held, slab
+
+    slab0 = jnp.zeros((d_local, n_model * d_local), dtype=ad)
+    # n_model-1 (compute + permute) steps, then the final block without the
+    # last permute — its result would be discarded, and the block is the
+    # big (m_local, d_local) buffer this path exists to avoid moving.
+    held, slab = jax.lax.fori_loop(0, n_model - 1, body, (xc, slab0))
+    slab = block_at(n_model - 1, slab, held)
+    gram_slab = jax.lax.psum(slab, DATA_AXIS)
+    return count, colsum, gram_slab
+
+
+def sharded_stats_ring(mesh: Mesh, compute_dtype=None, accum_dtype=None):
+    """fn(x_2dsharded, mask) -> (count repl, colsum repl, gram model-sharded),
+    computed with the ppermute ring instead of all_gather."""
+    n_model = mesh.shape[MODEL_AXIS]
+    f = jax.shard_map(
+        functools.partial(
+            _stats_shard_ring,
+            compute_dtype=compute_dtype,
+            accum_dtype=accum_dtype,
+            n_model=n_model,
+        ),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(MODEL_AXIS, None)),
         check_vma=False,
     )
     return jax.jit(f)

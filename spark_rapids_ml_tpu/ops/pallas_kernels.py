@@ -1,0 +1,166 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's hand-written device code is a Thrust sign-flip kernel and
+cuBLAS GEMM calls (rapidsml_jni.cu). On TPU, XLA already fuses the
+mask-multiply + GEMM + accumulate chain well, so Pallas here targets the
+two places hand-tiling pays:
+
+* ``gram_pallas`` — tiled XᵀX with the mask fused into the load (one HBM
+  pass; out-of-VMEM tiles stream through a (bn, bd) grid). Grid is
+  (d/bd, d/bd, n/bn) with the row dimension innermost ("arbitrary"
+  semantics) so each output tile accumulates in VMEM across row steps.
+* ``assign_min_dist_pallas`` — KMeans assignment: pairwise distance tile +
+  running argmin fused, never materializing the (m, k) distance matrix in
+  HBM (the XLA path writes it out then argmins it back in).
+
+Both are gated behind ``config.use_pallas`` with the XLA path as the
+default; parity is tested in interpret mode on CPU (tests/test_pallas.py)
+so the kernels stay correct even when no TPU is attached.
+
+See /opt/skills/guides/pallas_guide.md for the tiling constraints used
+here (f32 min tile (8, 128); MXU 128×128).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Tiled Gram: G = (X·mask)ᵀ (X·mask), accumulated in float32
+# ---------------------------------------------------------------------------
+
+
+def _gram_kernel(x_i_ref, x_j_ref, mask_ref, o_ref):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    m = mask_ref[:]  # (bn,)
+    xi = x_i_ref[:] * m[:, None]
+    xj = x_j_ref[:] * m[:, None]
+    o_ref[:] += jax.lax.dot_general(
+        xi, xj, (((0,), (0,)), ((), ())), preferred_element_type=o_ref.dtype
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
+def gram_pallas(
+    x: jax.Array,
+    mask: jax.Array,
+    block_n: int = 512,
+    block_d: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Masked Gram XᵀX of an (n, d) block, float32 accumulate.
+
+    n must divide block_n and d divide block_d (callers pad; shard_rows
+    already zero-pads rows and the mask kills padding contributions).
+    """
+    n, d = x.shape
+    bn = min(block_n, n)
+    bd = min(block_d, d)
+    if n % bn or d % bd:
+        raise ValueError(f"shape ({n},{d}) not divisible by blocks ({bn},{bd})")
+    grid = (d // bd, d // bd, n // bn)
+    return pl.pallas_call(
+        _gram_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, bd), lambda i, j, kk: (kk, i)),
+            pl.BlockSpec((bn, bd), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((bn,), lambda i, j, kk: (kk,)),
+        ],
+        out_specs=pl.BlockSpec((bd, bd), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((d, d), jnp.float32),
+        compiler_params=dict(
+            mosaic=dict(dimension_semantics=("parallel", "parallel", "arbitrary"))
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(x, x, mask)  # x twice: row-tile (kk, i) and (kk, j) views of the same array
+
+
+# ---------------------------------------------------------------------------
+# Fused KMeans assignment: argmin_k ||x - c_k||² without an (m, k) HBM array
+# ---------------------------------------------------------------------------
+
+
+def _assign_kernel(x_ref, c_ref, c2_ref, best_d_ref, best_i_ref):
+    kk = pl.program_id(1)
+
+    @pl.when(kk == 0)
+    def _init():
+        best_d_ref[:] = jnp.full_like(best_d_ref, jnp.inf)
+        best_i_ref[:] = jnp.zeros_like(best_i_ref)
+
+    x = x_ref[:]  # (bm, d)
+    c = c_ref[:]  # (bk, d)
+    c2 = c2_ref[:]  # (bk,)
+    # ||x-c||² up to the query-constant ||x||²: c² − 2xc (argmin-invariant).
+    xc = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    d2 = c2[None, :] - 2.0 * xc  # (bm, bk)
+    local_best = jnp.min(d2, axis=1)
+    bk = c.shape[0]
+    local_idx = jnp.argmin(d2, axis=1).astype(jnp.int32) + kk * bk
+    improved = local_best < best_d_ref[:]
+    best_i_ref[:] = jnp.where(improved, local_idx, best_i_ref[:])
+    best_d_ref[:] = jnp.where(improved, local_best, best_d_ref[:])
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_k", "interpret"))
+def assign_min_dist_pallas(
+    x: jax.Array,
+    centers: jax.Array,
+    block_m: int = 1024,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """(assignments (m,), partial_min_d2 (m,)) for KMeans, fused tile-wise.
+
+    Returned distances omit the +‖x‖² query constant (argmin-invariant);
+    callers needing true distances add it back.
+    """
+    m, d = x.shape
+    k = centers.shape[0]
+    bm = min(block_m, m)
+    bk = min(block_k, k)
+    if m % bm or k % bk:
+        raise ValueError(f"shape m={m},k={k} not divisible by blocks ({bm},{bk})")
+    c2 = jnp.sum(jnp.square(centers.astype(jnp.float32)), axis=1)
+    grid = (m // bm, k // bk)
+    best_d, best_i = pl.pallas_call(
+        _assign_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, kk: (i, 0)),
+            pl.BlockSpec((bk, d), lambda i, kk: (kk, 0)),
+            pl.BlockSpec((bk,), lambda i, kk: (kk,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm,), lambda i, kk: (i,)),
+            pl.BlockSpec((bm,), lambda i, kk: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m,), jnp.float32),
+            jax.ShapeDtypeStruct((m,), jnp.int32),
+        ],
+        compiler_params=dict(
+            mosaic=dict(dimension_semantics=("parallel", "arbitrary"))
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(x, centers, c2)
+    return best_i, best_d

@@ -1,0 +1,97 @@
+"""Multi-host distributed runtime initialization.
+
+The reference's "communication backend" is Spark RPC carrying serialized
+matrices (SURVEY.md §2.3) — it never initializes a collective runtime
+because it doesn't have one. This framework's backend is XLA collectives
+over ICI (intra-slice) and DCN (cross-slice/host); what needs managing is
+the multi-process JAX runtime: every host must call
+``jax.distributed.initialize`` with a shared coordinator so
+``jax.devices()`` spans the pod and one ``shard_map`` program runs SPMD
+across all hosts.
+
+``initialize_cluster`` wraps that with environment autodetection:
+* On Cloud TPU pods, jax autodetects everything (no arguments needed).
+* Under Spark, executors carry rank info in env vars; pass
+  ``coordinator_address`` of executor 0.
+* Single-process (one host, the tests, local mode): no-op.
+
+After initialization, ``global_mesh()`` builds the (data, model) mesh over
+ALL devices in the job — the same mesh code as single-host, which is the
+point: SURVEY.md §2.3's "one pmap across a TPU pod" with zero algorithm
+changes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+_logger = get_logger(__name__)
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize_cluster(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Initialize the multi-host JAX runtime; returns this process's index.
+
+    Safe to call when single-process (returns 0 without touching the
+    runtime). Arguments default from env vars (SRML_TPU_COORDINATOR,
+    SRML_TPU_NUM_PROCS, SRML_TPU_PROC_ID) so a Spark executor launcher can
+    configure workers without code changes.
+    """
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get("SRML_TPU_COORDINATOR")
+    num_processes = num_processes or _env_int("SRML_TPU_NUM_PROCS")
+    process_id = process_id if process_id is not None else _env_int("SRML_TPU_PROC_ID")
+
+    if coordinator_address is None and num_processes in (None, 1):
+        # Single process — on Cloud TPU pods jax.distributed.initialize()
+        # with no args would autodetect, but calling it single-host is a
+        # no-op need; skip to keep local/test runs hermetic.
+        _initialized = True
+        return 0
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    _logger.info(
+        "distributed runtime up: process %s/%s, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return jax.process_index()
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def global_mesh(model: int = 1):
+    """(data, model) mesh over every device in the job (all hosts)."""
+    return make_mesh(model=model)
+
+
+def process_local_rows(n_rows: int) -> tuple:
+    """[start, stop) row range this process should feed, for host-sharded
+    data loading: each host only materializes its slice."""
+    p = jax.process_index()
+    count = jax.process_count()
+    per = (n_rows + count - 1) // count
+    return min(p * per, n_rows), min((p + 1) * per, n_rows)

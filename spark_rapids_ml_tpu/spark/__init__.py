@@ -1,0 +1,48 @@
+"""Apache Spark integration shell — the reference's L6/L0 layers, TPU-style.
+
+The reference integrates with Spark three ways (SURVEY.md §1):
+1. a user-facing estimator namespace (`com.nvidia.spark.ml.feature.PCA`),
+2. the spark-rapids SQLPlugin columnar data plane (`ColumnarRdd`),
+3. GPU resource scheduling (discovery script + `spark.task.resource.gpu.*`,
+   README.md:103-113).
+
+The TPU equivalents here:
+1. ``spark_rapids_ml_tpu.spark.SparkPCA`` (and siblings) wrap the core
+   estimators to accept PySpark DataFrames with an ArrayType features
+   column — the same one-import-change user contract as the reference.
+2. The data plane is Arrow: DataFrame partitions convert to Arrow batches
+   on the executor and feed the TPU host process (bridge/arrow.py); local
+   mode collects via Spark's Arrow path directly.
+3. Resource scheduling: ``discovery.write_discovery_script`` emits the
+   ``spark.resource.discoveryScript``-compatible TPU probe, and
+   ``conf.tpu_session_conf`` builds the spark-submit conf dict
+   (``spark.task.resource.tpu.amount`` etc.) mirroring the reference's
+   GPU recipe.
+
+pyspark is an optional dependency: everything importable without it;
+DataFrame entry points raise a clear error if pyspark is absent.
+"""
+
+from spark_rapids_ml_tpu.spark.conf import tpu_session_conf
+from spark_rapids_ml_tpu.spark.discovery import (
+    discovery_payload,
+    write_discovery_script,
+)
+from spark_rapids_ml_tpu.spark.estimator import (
+    SparkPCA,
+    SparkKMeans,
+    SparkLinearRegression,
+    SparkLogisticRegression,
+    SparkNearestNeighbors,
+)
+
+__all__ = [
+    "tpu_session_conf",
+    "discovery_payload",
+    "write_discovery_script",
+    "SparkPCA",
+    "SparkKMeans",
+    "SparkLinearRegression",
+    "SparkLogisticRegression",
+    "SparkNearestNeighbors",
+]

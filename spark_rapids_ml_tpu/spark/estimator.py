@@ -1,0 +1,193 @@
+"""PySpark DataFrame adapters for the core estimators.
+
+The reference's user contract: change one import, keep the Spark ML code
+(`new com.nvidia.spark.ml.feature.PCA().setInputCol(...).fit(df)`,
+reference PCA.scala:27-37, README.md:27-37 — with the features column as
+ArrayType rather than Vector). These wrappers reproduce that contract for
+PySpark: ``SparkPCA().setInputCol("features").setK(3).fit(spark_df)``.
+
+Data path: the DataFrame's relevant columns are exchanged as Arrow
+(``spark.sql.execution.arrow.*``), flattened by the columnar bridge, and
+fed to the sharded TPU fit. ``transform`` runs the model on Arrow batches
+via ``mapInArrow`` when available (keeps the pipeline distributed and
+lazy, one batch per executor task — the analogue of the reference's
+columnar UDF, RapidsPCA.scala:128-161), falling back to a collect-based
+path for old PySpark.
+
+pyspark is optional: import of this module never requires it; calling
+``fit``/``transform`` with a Spark DataFrame does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _pyspark():
+    try:
+        import pyspark  # noqa: F401
+        from pyspark.sql import DataFrame
+
+        return DataFrame
+    except ImportError:
+        return None
+
+
+def _is_spark_df(dataset: Any) -> bool:
+    df_cls = _pyspark()
+    return df_cls is not None and isinstance(dataset, df_cls)
+
+
+def _check_not_orphan_spark_df(dataset: Any) -> None:
+    """Raise the promised clear error for Spark-shaped datasets when
+    pyspark is missing (instead of an opaque core-estimator failure)."""
+    if _pyspark() is None and (
+        hasattr(dataset, "sparkSession")
+        or type(dataset).__module__.split(".")[0] == "pyspark"
+    ):
+        raise ImportError(
+            "pyspark is not installed; Spark* estimators need it for "
+            "DataFrame inputs. Use the core estimators "
+            "(spark_rapids_ml_tpu.PCA etc.) with arrow/pandas/numpy data."
+        )
+
+
+def _df_to_arrow(df, columns):
+    """Spark DataFrame -> pyarrow.Table restricted to ``columns``."""
+    import pyarrow as pa
+
+    selected = df.select(*columns)
+    # Spark 4 / recent 3.x: native Arrow collect.
+    if hasattr(selected, "toArrow"):
+        return selected.toArrow()
+    pdf = selected.toPandas()
+    return pa.Table.from_pandas(pdf, preserve_index=False)
+
+
+class _SparkAdapter:
+    """Wraps a core estimator class with Spark DataFrame in/out.
+
+    Non-Spark datasets pass straight through to the core estimator, so the
+    Spark wrapper is a superset of the core API.
+    """
+
+    _core_cls = None  # override
+    _model_attr = "model"
+
+    def __init__(self, **kwargs):
+        self._core = type(self)._core_cls(**kwargs)
+
+    def __getattr__(self, name):
+        # Fluent setters return self (the wrapper), others pass through.
+        attr = getattr(self._core, name)
+        if callable(attr) and name.startswith("set"):
+            def fluent(*a, **kw):
+                attr(*a, **kw)
+                return self
+
+            return fluent
+        return attr
+
+    def fit(self, dataset):
+        if _is_spark_df(dataset):
+            cols = self._input_columns()
+            table = _df_to_arrow(dataset, cols)
+            core_model = self._core.fit(table)
+        else:
+            _check_not_orphan_spark_df(dataset)
+            core_model = self._core.fit(dataset)
+        return _SparkModelAdapter(core_model)
+
+    def _input_columns(self):
+        cols = []
+        for name in ("inputCol", "featuresCol"):
+            if self._core.hasParam(name) and self._core.isDefined(
+                self._core.getParam(name)
+            ):
+                cols.append(self._core.getOrDefault(name))
+        for name in ("labelCol",):
+            if self._core.hasParam(name) and self._core.isDefined(
+                self._core.getParam(name)
+            ):
+                cols.append(self._core.getOrDefault(name))
+        return cols
+
+
+class _SparkModelAdapter:
+    """Wraps a fitted core Model with Spark DataFrame transform."""
+
+    def __init__(self, core_model):
+        self._core = core_model
+
+    def __getattr__(self, name):
+        return getattr(self._core, name)
+
+    def transform(self, dataset):
+        if not _is_spark_df(dataset):
+            _check_not_orphan_spark_df(dataset)
+            return self._core.transform(dataset)
+        import pyarrow as pa
+
+        core = self._core
+        out_field = None
+        for name in ("outputCol", "predictionCol"):
+            if core.hasParam(name) and core.isDefined(core.getParam(name)):
+                out_field = core.getOrDefault(name)
+                break
+
+        if hasattr(dataset, "mapInArrow"):
+            # Distributed, lazy: one Arrow batch per executor partition —
+            # the columnar-UDF analogue (RapidsPCA.scala:128-161).
+
+            def transform_batches(batches):
+                for batch in batches:
+                    table = pa.Table.from_batches([batch])
+                    out = core.transform(table)
+                    yield from out.to_batches()
+
+            sample = _df_to_arrow(dataset.limit(1), dataset.columns)
+            out_sample = core.transform(sample)
+            from pyspark.sql.pandas.types import from_arrow_schema
+
+            schema = from_arrow_schema(out_sample.schema)
+            return dataset.mapInArrow(transform_batches, schema)
+
+        # Fallback: collect → transform → recreate (local mode only).
+        table = _df_to_arrow(dataset, dataset.columns)
+        out = core.transform(table)
+        spark = dataset.sparkSession
+        return spark.createDataFrame(out.to_pandas())
+
+
+def _make_wrapper(name, core_cls, doc):
+    cls = type(name, (_SparkAdapter,), {"_core_cls": core_cls, "__doc__": doc})
+    return cls
+
+
+from spark_rapids_ml_tpu.models.kmeans import KMeans as _KMeans
+from spark_rapids_ml_tpu.models.knn import NearestNeighbors as _NearestNeighbors
+from spark_rapids_ml_tpu.models.linear_regression import (
+    LinearRegression as _LinearRegression,
+)
+from spark_rapids_ml_tpu.models.logistic_regression import (
+    LogisticRegression as _LogisticRegression,
+)
+from spark_rapids_ml_tpu.models.pca import PCA as _PCA
+
+SparkPCA = _make_wrapper(
+    "SparkPCA", _PCA, "PCA over PySpark DataFrames (ArrayType features column)."
+)
+SparkKMeans = _make_wrapper(
+    "SparkKMeans", _KMeans, "KMeans over PySpark DataFrames."
+)
+SparkLinearRegression = _make_wrapper(
+    "SparkLinearRegression", _LinearRegression, "LinearRegression over PySpark DataFrames."
+)
+SparkLogisticRegression = _make_wrapper(
+    "SparkLogisticRegression", _LogisticRegression, "LogisticRegression over PySpark DataFrames."
+)
+SparkNearestNeighbors = _make_wrapper(
+    "SparkNearestNeighbors", _NearestNeighbors, "Exact KNN over PySpark DataFrames."
+)

@@ -1,0 +1,52 @@
+"""Host-side failure handling for the data-feeding path.
+
+The reference delegates all fault tolerance to Spark task retry — its
+map/reduce stages are pure and recompute-safe (SURVEY.md §5 "failure
+detection"). In this framework the equivalents are:
+
+* the sharded fit programs are pure functions of their inputs (recompute-
+  safe by construction — rerunning a failed fit is always sound);
+* the host-side feeding loop (Arrow IO, host→device transfer) is the part
+  that sees transient failures (storage hiccups, preemptions), handled
+  here with bounded retries + backoff.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+_logger = get_logger(__name__)
+
+T = TypeVar("T")
+
+
+def with_retries(
+    fn: Callable[[], T],
+    max_attempts: int = 3,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, IOError),
+    base_delay_s: float = 0.5,
+    backoff: float = 2.0,
+) -> T:
+    """Run ``fn`` with bounded retries and exponential backoff.
+
+    Analogous to ``spark.task.maxFailures`` for the host feeding loop;
+    only exceptions in ``retry_on`` are retried, everything else raises
+    immediately (a deterministic error will not fix itself).
+    """
+    attempt = 0
+    delay = base_delay_s
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            if attempt >= max_attempts:
+                raise
+            _logger.warning(
+                "retryable failure (attempt %d/%d): %s", attempt, max_attempts, e
+            )
+            time.sleep(delay)
+            delay *= backoff

@@ -1,0 +1,42 @@
+"""Pallas kernel parity tests (interpret mode — no TPU needed)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ops.pallas_kernels import assign_min_dist_pallas, gram_pallas
+
+
+def test_gram_parity(rng):
+    n, d = 1024, 256
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    mask = np.ones((n,), dtype=np.float32)
+    mask[-37:] = 0.0  # padding rows
+    out = np.asarray(gram_pallas(x, mask, block_n=256, block_d=128, interpret=True))
+    xm = x * mask[:, None]
+    ref = xm.T @ xm
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-2)
+
+
+def test_gram_block_validation(rng):
+    x = rng.normal(size=(100, 64)).astype(np.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        gram_pallas(x, np.ones(100, np.float32), block_n=64, block_d=64, interpret=True)
+
+
+def test_assign_parity(rng):
+    m, d, k = 512, 32, 128
+    x = rng.normal(size=(m, d)).astype(np.float32)
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    idx, part_d = assign_min_dist_pallas(
+        x, centers, block_m=128, block_k=64, interpret=True
+    )
+    d2 = (
+        np.sum(x**2, 1)[:, None]
+        - 2 * x @ centers.T
+        + np.sum(centers**2, 1)[None, :]
+    )
+    ref_idx = np.argmin(d2, axis=1)
+    np.testing.assert_array_equal(np.asarray(idx), ref_idx)
+    # partial distance + ||x||^2 == true min distance
+    full = np.asarray(part_d) + np.sum(x**2, 1)
+    np.testing.assert_allclose(full, d2.min(axis=1), rtol=1e-4, atol=1e-2)

@@ -1,0 +1,88 @@
+"""Spark integration shell tests (the parts that don't require pyspark)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.spark import (
+    SparkKMeans,
+    SparkPCA,
+    discovery_payload,
+    tpu_session_conf,
+    write_discovery_script,
+)
+
+
+def test_conf_builder():
+    conf = tpu_session_conf(
+        executor_tpus=4, tasks_per_tpu=8, discovery_script="/opt/tpu_disc.sh"
+    )
+    assert conf["spark.executor.resource.tpu.amount"] == "4"
+    assert conf["spark.task.resource.tpu.amount"] == "0.125"
+    assert conf["spark.worker.resource.tpu.discoveryScript"] == "/opt/tpu_disc.sh"
+    assert conf["spark.sql.execution.arrow.pyspark.enabled"] == "true"
+
+
+def test_discovery_payload_shape():
+    payload = discovery_payload()
+    assert payload["name"] == "tpu"
+    assert isinstance(payload["addresses"], list)
+
+
+def test_discovery_script_executable(tmp_path):
+    path = write_discovery_script(str(tmp_path / "tpu_disc.sh"))
+    assert os.access(path, os.X_OK)
+    content = open(path).read()
+    assert "spark_rapids_ml_tpu.spark.discovery" in content
+
+
+def test_discovery_module_prints_json():
+    # The script execs `python -m spark_rapids_ml_tpu.spark.discovery`;
+    # its stdout must be exactly one JSON object (Spark parses it).
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_ml_tpu.spark.discovery"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    payload = json.loads(out.stdout.strip())
+    assert payload["name"] == "tpu"
+
+
+def test_wrapper_passthrough_non_spark(rng, mesh8):
+    # Without pyspark, the Spark wrappers must still work on host data
+    # (superset contract) and expose fluent setters + model attrs.
+    x = rng.normal(size=(200, 8))
+    pca = SparkPCA(mesh=mesh8).setK(2).setInputCol("features")
+    model = pca.fit({"features": x})
+    assert model.pc.shape == (8, 2)
+    out = model.transform({"features": x})
+    assert out["pca_features"].shape == (200, 2)
+
+    km = SparkKMeans(mesh=mesh8).setK(3)
+    kmodel = km.fit({"features": x})
+    assert kmodel.clusterCenters().shape == (3, 8)
+
+
+def test_wrapper_spark_df_requires_pyspark():
+    # A Spark-shaped dataset (duck-typed) without pyspark installed must
+    # produce the promised clear ImportError, not an opaque core failure.
+    from spark_rapids_ml_tpu.spark import estimator as est
+
+    if est._pyspark() is not None:  # pragma: no cover - image has no pyspark
+        pytest.skip("pyspark installed; gate not triggerable")
+
+    class FakeSparkDF:
+        sparkSession = object()
+
+    with pytest.raises(ImportError, match="pyspark"):
+        SparkPCA().setK(2).fit(FakeSparkDF())
+    with pytest.raises(ImportError, match="pyspark"):
+        SparkPCA(). setK(2).fit({"features": np.ones((10, 4))}).transform(FakeSparkDF())
