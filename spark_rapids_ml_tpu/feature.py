@@ -1,0 +1,12 @@
+"""Drop-in feature-transformer namespace.
+
+The reference's public entry point is ``com.nvidia.spark.ml.feature.PCA``
+(reference PCA.scala:27-37) — a thin alias namespace so user code changes
+only the import. This module is the same shim for Python:
+
+    from spark_rapids_ml_tpu.feature import PCA
+"""
+
+from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+
+__all__ = ["PCA", "PCAModel"]

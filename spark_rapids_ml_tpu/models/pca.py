@@ -216,16 +216,28 @@ def fit_pca_stream(
     n_cols: int,
     mean_center: bool = True,
     mesh: Optional[Mesh] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 16,
 ) -> PCASolution:
     """Fit PCA over a stream of host row-batches (dataset ≫ HBM).
 
     The accumulator state lives on device; each batch is row-sharded,
     reduced with psum, and folded in with buffer donation. This is the
     scale path for BASELINE.json config #2 (100M×2048).
+
+    With ``checkpoint_path``, the O(d²) accumulator is atomically persisted
+    every ``checkpoint_every`` batches and the fit RESUMES from it if the
+    file exists: callers re-supply the same batch iterator and already-
+    consumed batches are skipped. (Preemption safety the reference lacks —
+    SURVEY.md §5 "failure detection".)
     """
     if not 0 < k <= n_cols:
         # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60
         raise ValueError(f"k = {k} out of range (0, n = {n_cols}]")
+    if checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    from spark_rapids_ml_tpu.core import checkpoint as ckpt
+
     mesh = mesh or default_mesh()
     update = gram_ops.streaming_update(mesh)
     state = gram_ops.init_stats(n_cols)
@@ -233,14 +245,48 @@ def fit_pca_stream(
     sharding = row_sharding(mesh)
     mask_sharding = row_sharding(mesh, ndim=1)
     n_true = 0
+    skip_batches = 0
+    if checkpoint_path:
+        restored = ckpt.load_state(checkpoint_path)
+        if restored is not None:
+            arrays, meta = restored
+            if meta.get("n_cols") != n_cols:
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path} is for n_cols="
+                    f"{meta.get('n_cols')}, not {n_cols}"
+                )
+            state = (
+                jnp.asarray(arrays["count"]),
+                jnp.asarray(arrays["colsum"]),
+                jnp.asarray(arrays["gram"]),
+            )
+            n_true = int(meta["n_rows"])
+            skip_batches = int(meta["n_batches"])
     with trace_span("compute cov"):
-        for batch in batches:
+        for i, batch in enumerate(batches):
+            if i < skip_batches:
+                continue
             batch = np.asarray(batch)
             n_true += batch.shape[0]
             xb, mb = pad_rows(batch, n_data)
             xs = jax.device_put(xb, sharding)
             ms = jax.device_put(mb, mask_sharding)
             state = update(state, xs, ms)
+            if checkpoint_path and (i + 1) % checkpoint_every == 0:
+                count, colsum, g = jax.device_get(state)
+                ckpt.save_state(
+                    checkpoint_path,
+                    {"count": count, "colsum": colsum, "gram": g},
+                    {"n_rows": n_true, "n_batches": i + 1, "n_cols": n_cols},
+                )
+    if checkpoint_path:
+        # Success: remove the checkpoint so a FUTURE fit against the same
+        # path starts fresh instead of silently merging this run's
+        # accumulator into different data.
+        import os
+
+        if os.path.exists(checkpoint_path):
+            os.unlink(checkpoint_path)
     count, colsum, g = state
     with trace_span("eig finalize"):
         if _use_host_finalize(mesh):

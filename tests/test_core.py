@@ -1,0 +1,257 @@
+"""Core framework unit tests: Params contract, dataset abstraction,
+config, checkpointing, profiling spans."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.core.dataset import as_column, as_matrix, num_rows, with_column
+from spark_rapids_ml_tpu.core.params import (
+    Params,
+    ParamDecl,
+    TypeConverters,
+)
+
+
+class Toy(Params):
+    _uid_prefix = "Toy"
+    alpha = ParamDecl("alpha", "a float knob", TypeConverters.toFloat)
+    n = ParamDecl("n", "an int knob", TypeConverters.toInt)
+    name = ParamDecl("name", "a string knob", TypeConverters.toString)
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+        self.setDefault(alpha=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Params contract (ParamsSuite.checkParams analogue)
+# ---------------------------------------------------------------------------
+
+
+def test_param_defaults_and_set():
+    t = Toy()
+    assert t.getOrDefault("alpha") == 0.5
+    assert not t.isSet(t.alpha) and t.hasDefault(t.alpha) and t.isDefined(t.alpha)
+    t._set(alpha=0.9)
+    assert t.getOrDefault(t.alpha) == 0.9 and t.isSet(t.alpha)
+    t.clear(t.alpha)
+    assert t.getOrDefault(t.alpha) == 0.5
+
+
+def test_param_type_conversion():
+    t = Toy()
+    t._set(n=5.0)  # lossless float -> int ok
+    assert t.getOrDefault("n") == 5
+    with pytest.raises(TypeError):
+        t._set(n=5.5)
+    with pytest.raises(TypeError):
+        t._set(n=True)
+    with pytest.raises(TypeError):
+        t._set(name=42)
+
+
+def test_param_unknown_name():
+    t = Toy()
+    with pytest.raises(AttributeError):
+        t.getParam("bogus")
+    assert not t.hasParam("bogus")
+    assert t.hasParam("alpha")
+
+
+def test_param_undefined_get_raises():
+    t = Toy()
+    with pytest.raises(KeyError):
+        t.getOrDefault("n")
+
+
+def test_copy_preserves_uid_and_values():
+    t = Toy()
+    t._set(n=3)
+    c = t.copy()
+    assert c.uid == t.uid and c.getOrDefault("n") == 3
+    c._set(n=4)
+    assert t.getOrDefault("n") == 3  # independent maps
+
+
+def test_copy_with_extra():
+    t = Toy()
+    c = t.copy({t.alpha: 0.1})
+    assert c.getOrDefault("alpha") == 0.1 and t.getOrDefault("alpha") == 0.5
+
+
+def test_explain_params():
+    t = Toy()
+    text = t.explainParams()
+    assert "alpha" in text and "default: 0.5" in text and "undefined" in text
+
+
+def test_uids_unique():
+    assert Toy().uid != Toy().uid
+    assert Toy().uid.startswith("Toy_")
+
+
+# ---------------------------------------------------------------------------
+# Dataset abstraction
+# ---------------------------------------------------------------------------
+
+
+def test_dataset_numpy():
+    x = np.ones((4, 3))
+    assert num_rows(x) == 4
+    np.testing.assert_array_equal(as_matrix(x), x)
+    with pytest.raises(TypeError):
+        as_column(x, "label")
+
+
+def test_dataset_dict():
+    ds = {"features": np.ones((4, 3)), "label": np.arange(4.0)}
+    assert num_rows(ds) == 4
+    assert as_matrix(ds, "features").shape == (4, 3)
+    np.testing.assert_array_equal(as_column(ds, "label"), np.arange(4.0))
+    out = with_column(ds, "pred", np.zeros(4))
+    assert "pred" in out and "pred" not in ds
+
+
+def test_dataset_dict_object_vectors():
+    ds = {"features": np.array([np.arange(3.0), np.arange(3.0) + 1], dtype=object)}
+    m = as_matrix(ds, "features")
+    assert m.shape == (2, 3)
+
+
+def test_dataset_pandas():
+    pd = pytest.importorskip("pandas")
+    df = pd.DataFrame({"features": [np.arange(3.0), np.arange(3.0) + 1], "y": [0.0, 1.0]})
+    assert num_rows(df) == 2
+    assert as_matrix(df, "features").shape == (2, 3)
+    out = with_column(df, "vec_out", np.ones((2, 2)))
+    assert len(out["vec_out"][0]) == 2
+
+
+def test_dataset_arrow_roundtrip():
+    pa = pytest.importorskip("pyarrow")
+    from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
+
+    t = pa.table({"features": matrix_to_list_column(np.ones((5, 2)))})
+    assert num_rows(t) == 5
+    out = with_column(t, "out", np.zeros((5, 3)))
+    assert out.column("out").type.list_size == 3
+    # replacing an existing column
+    out2 = with_column(out, "out", np.zeros((5, 4)))
+    assert out2.column("out").type.list_size == 4
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+def test_config_unknown_key():
+    with pytest.raises(KeyError):
+        config.get("bogus_key")
+    with pytest.raises(KeyError):
+        config.set("bogus_key", 1)
+
+
+def test_config_option_restores_on_error():
+    before = config.get("tracing")
+    with pytest.raises(RuntimeError):
+        with config.option("tracing", not before):
+            raise RuntimeError("boom")
+    assert config.get("tracing") == before
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from spark_rapids_ml_tpu.core.checkpoint import load_state, save_state
+
+    path = str(tmp_path / "ck.npz")
+    assert load_state(path) is None
+    save_state(path, {"g": np.eye(3)}, {"n_rows": 7})
+    arrays, meta = load_state(path)
+    np.testing.assert_array_equal(arrays["g"], np.eye(3))
+    assert meta == {"n_rows": 7}
+
+
+def _interrupted(batches, stop_at):
+    for i, b in enumerate(batches):
+        if i == stop_at:
+            raise KeyboardInterrupt("preempted")
+        yield b
+
+
+def test_stream_fit_checkpoint_resume(rng, mesh8, tmp_path):
+    import os
+
+    from spark_rapids_ml_tpu.models.pca import fit_pca, fit_pca_stream
+
+    x = rng.normal(size=(512, 10))
+    batches = [x[i : i + 64] for i in range(0, 512, 64)]
+    path = str(tmp_path / "stream.npz")
+    # Simulate preemption after 5 of 8 batches (checkpoints at 2 and 4).
+    with pytest.raises(KeyboardInterrupt):
+        fit_pca_stream(_interrupted(batches, 5), k=3, n_cols=10, mesh=mesh8,
+                       checkpoint_path=path, checkpoint_every=2)
+    assert os.path.exists(path)
+    # Resume with the full stream: must equal the uninterrupted fit.
+    a = fit_pca_stream(batches, k=3, n_cols=10, mesh=mesh8,
+                       checkpoint_path=path, checkpoint_every=2)
+    assert a.n_rows == 512
+    c = fit_pca(x, k=3, mesh=mesh8)
+    np.testing.assert_allclose(a.pc, c.pc, atol=1e-8)
+    # Success removes the checkpoint so a future fit starts fresh
+    # (regression: stale state must never merge into different data).
+    assert not os.path.exists(path)
+    b = fit_pca_stream(batches, k=3, n_cols=10, mesh=mesh8,
+                       checkpoint_path=path, checkpoint_every=2)
+    np.testing.assert_allclose(a.pc, b.pc, atol=1e-10)
+
+
+def test_stream_checkpoint_mismatched_cols(rng, mesh8, tmp_path):
+    from spark_rapids_ml_tpu.models.pca import fit_pca_stream
+
+    x = rng.normal(size=(128, 10))
+    batches = [x[:64], x[64:]]
+    path = str(tmp_path / "stream.npz")
+    with pytest.raises(KeyboardInterrupt):
+        fit_pca_stream(_interrupted(batches, 1), k=2, n_cols=10, mesh=mesh8,
+                       checkpoint_path=path, checkpoint_every=1)
+    with pytest.raises(ValueError, match="n_cols"):
+        fit_pca_stream([x[:, :8]], k=2, n_cols=8, mesh=mesh8,
+                       checkpoint_path=path)
+
+
+def test_stream_checkpoint_every_validation(rng, mesh8, tmp_path):
+    from spark_rapids_ml_tpu.models.pca import fit_pca_stream
+
+    x = rng.normal(size=(64, 10))
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        fit_pca_stream([x], k=2, n_cols=10, mesh=mesh8,
+                       checkpoint_path=str(tmp_path / "c.npz"),
+                       checkpoint_every=0)
+
+
+# ---------------------------------------------------------------------------
+# Profiling spans
+# ---------------------------------------------------------------------------
+
+
+def test_trace_span_timer():
+    from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+    with trace_span("unit test span") as t:
+        pass
+    assert t.elapsed is not None and t.elapsed >= 0
+
+
+def test_trace_span_with_tracing_enabled():
+    from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+    with config.option("tracing", True):
+        with trace_span("annotated span") as t:
+            pass
+    assert t.elapsed is not None
