@@ -63,6 +63,11 @@ _DEFAULTS: Dict[str, Any] = {
     # device; eigh on TPU is an iterative algorithm XLA executes poorly for
     # large d, while the d×d Gram is tiny to fetch.
     "finalize": _env("FINALIZE", "auto", str),
+    # Eigensolver for the finalize: "full" = exact d×d eigh (host LAPACK on
+    # TPU per `finalize`), "randomized" = on-device blocked subspace
+    # iteration (Halko-style; MXU matmuls only, nothing but (d, k+p) panels
+    # factorized — the TPU-fast path for large d with decaying spectra).
+    "solver": _env("SOLVER", "full", str),
 }
 
 _lock = threading.Lock()

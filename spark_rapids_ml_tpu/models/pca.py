@@ -43,7 +43,11 @@ from spark_rapids_ml_tpu.core.params import (
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops import gram as gram_ops
-from spark_rapids_ml_tpu.ops.eigh import pca_from_gram, pca_from_gram_host
+from spark_rapids_ml_tpu.ops.eigh import (
+    pca_from_gram,
+    pca_from_gram_host,
+    pca_from_gram_randomized,
+)
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -95,6 +99,7 @@ def _fit_fn(
     fuse_finalize: bool = True,
     gram_algo: str = "auto",
     use_pallas: bool = False,
+    solver: str = "full",
 ):
     # `use_pallas` is unused in the body but MUST be in the cache key:
     # local_stats reads config.use_pallas at trace time, so a config flip
@@ -138,10 +143,26 @@ def _fit_fn(
         if not fuse_finalize:
             return count, colsum, g
         g, mean = gram_ops.finalize_gram(count, colsum, g, mean_center)
-        pc, ev, s = pca_from_gram(g, k)
+        if solver == "randomized":
+            pc, ev, s = pca_from_gram_randomized(g, k)
+        else:
+            pc, ev, s = pca_from_gram(g, k)
         return pc, ev, s, mean, count
 
     return jax.jit(fit)
+
+
+_SOLVERS = ("full", "randomized")
+
+
+def _resolve_solver(solver: Optional[str]) -> str:
+    """None/"auto" → config ``solver``; otherwise validate explicitly —
+    a typo must not silently select the slow exact path."""
+    if solver is None or solver == "auto":
+        solver = config.get("solver")
+    if solver not in _SOLVERS:
+        raise ValueError(f"solver must be one of {_SOLVERS} or 'auto', got {solver!r}")
+    return solver
 
 
 def _finalize_on_host(count, colsum, gram, mean_center: bool, k: int):
@@ -162,10 +183,17 @@ def fit_pca(
     k: int,
     mean_center: bool = True,
     mesh: Optional[Mesh] = None,
+    solver: Optional[str] = None,
 ) -> PCASolution:
     """Fit PCA on a host matrix, sharding rows (and features if the mesh has a
-    model axis > 1) across the mesh."""
+    model axis > 1) across the mesh.
+
+    ``solver``: None → config ``solver``; "full" = exact eigh finalize
+    (host LAPACK on TPU), "randomized" = on-device subspace iteration
+    (:func:`...ops.eigh.pca_from_gram_randomized`).
+    """
     mesh = mesh or default_mesh()
+    solver = _resolve_solver(solver)
     d = x.shape[1]
     if not 0 < k <= d:
         # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60
@@ -181,7 +209,7 @@ def fit_pca(
             mask = jax.device_put(mask_np, NamedSharding(mesh, P(DATA_AXIS)))
         else:
             xs, mask, n_true = shard_rows(x, mesh)
-        host_finalize = _use_host_finalize(mesh)
+        host_finalize = _use_host_finalize(mesh) and solver != "randomized"
         fit = _fit_fn(
             mesh,
             k,
@@ -192,6 +220,7 @@ def fit_pca(
             fuse_finalize=not host_finalize,
             gram_algo=config.get("gram_algorithm"),
             use_pallas=bool(config.get("use_pallas")),
+            solver=solver,
         )
         out = fit(xs, mask)
     with trace_span("eig finalize"):
@@ -218,6 +247,7 @@ def fit_pca_stream(
     mesh: Optional[Mesh] = None,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 16,
+    solver: Optional[str] = None,
 ) -> PCASolution:
     """Fit PCA over a stream of host row-batches (dataset ≫ HBM).
 
@@ -236,6 +266,7 @@ def fit_pca_stream(
         raise ValueError(f"k = {k} out of range (0, n = {n_cols}]")
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    solver = _resolve_solver(solver)  # fail fast, before consuming batches
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
 
     mesh = mesh or default_mesh()
@@ -289,11 +320,14 @@ def fit_pca_stream(
             os.unlink(checkpoint_path)
     count, colsum, g = state
     with trace_span("eig finalize"):
-        if _use_host_finalize(mesh):
+        if _use_host_finalize(mesh) and solver != "randomized":
             pc, ev, s, mean, _ = _finalize_on_host(count, colsum, g, mean_center, k)
         else:
+            finalize_fn = (
+                pca_from_gram_randomized if solver == "randomized" else pca_from_gram
+            )
             finalize = jax.jit(
-                lambda c, cs, gg: pca_from_gram(
+                lambda c, cs, gg: finalize_fn(
                     gram_ops.finalize_gram(c, cs, gg, mean_center)[0], k
                 )
             )
@@ -324,16 +358,32 @@ class _PCAParams(HasInputCol, HasOutputCol):
         TypeConverters.toBoolean,
     )
 
+    solver = ParamDecl(
+        "solver",
+        'eigensolver for the finalize: "auto" (config), "full" (exact '
+        'eigh), or "randomized" (on-device subspace iteration — the '
+        "TPU-fast path for large feature dims with decaying spectra)",
+        TypeConverters.toString,
+    )
+
     def __init__(self, uid=None):
         super().__init__(uid=uid)
         # default true — RapidsPCA.scala:45-46
-        self.setDefault(meanCentering=True, inputCol="features", outputCol="pca_features")
+        self.setDefault(
+            meanCentering=True,
+            inputCol="features",
+            outputCol="pca_features",
+            solver="auto",
+        )
 
     def getK(self) -> int:
         return self.getOrDefault(self.k)
 
     def getMeanCentering(self) -> bool:
         return self.getOrDefault(self.meanCentering)
+
+    def getSolver(self) -> str:
+        return self.getOrDefault(self.solver)
 
 
 class PCA(Estimator, _PCAParams, MLWritable, MLReadable):
@@ -356,16 +406,21 @@ class PCA(Estimator, _PCAParams, MLWritable, MLReadable):
     def setMeanCentering(self, value: bool) -> "PCA":
         return self._set(meanCentering=value)
 
+    def setSolver(self, value: str) -> "PCA":
+        return self._set(solver=value)
+
     def _copy_extra_state(self, source):
         self._mesh = getattr(source, "_mesh", None)
 
     def _fit(self, dataset) -> "PCAModel":
         x = as_matrix(dataset, self.getInputCol())
+        est_solver = self.getSolver()
         sol = fit_pca(
             x,
             k=self.getK(),
             mean_center=self.getMeanCentering(),
             mesh=self._mesh,
+            solver=None if est_solver == "auto" else est_solver,
         )
         model = PCAModel(
             pc=sol.pc,

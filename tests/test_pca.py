@@ -168,6 +168,63 @@ def test_host_finalize_parity(data, mesh8):
     np.testing.assert_allclose(a.explained_variance, b.explained_variance, atol=1e-10)
 
 
+def test_randomized_solver_matches_full(data, mesh8):
+    # The on-device subspace-iteration solver must recover the same top-k
+    # subspace as the exact eigh on decaying-spectrum data (the regime it
+    # exists for), including explained variance (tail estimated via trace).
+    k = 4
+    a = fit_pca(data, k=k, mesh=mesh8, solver="full")
+    b = fit_pca(data, k=k, mesh=mesh8, solver="randomized")
+    np.testing.assert_allclose(np.abs(a.pc), np.abs(b.pc), atol=1e-6)
+    np.testing.assert_allclose(
+        a.explained_variance, b.explained_variance, rtol=2e-2
+    )
+    np.testing.assert_allclose(a.mean, b.mean, atol=1e-8)
+
+
+def test_randomized_solver_truncated_subspace(rng, mesh8):
+    # d > k + oversample, so the solver runs genuinely rank-truncated:
+    # subspace iteration never sees the full spectrum and the trace-based
+    # tail estimate (n_tail > 0) feeds the explained-variance denominator.
+    n, d, k = 2000, 80, 4  # default oversample=32 → m=36 < d
+    basis = rng.normal(size=(d, d)) * np.logspace(0, -2, d)
+    x = rng.normal(size=(n, d)) @ basis
+    a = fit_pca(x, k=k, mesh=mesh8, solver="full")
+    b = fit_pca(x, k=k, mesh=mesh8, solver="randomized")
+    np.testing.assert_allclose(np.abs(a.pc), np.abs(b.pc), atol=1e-5)
+    # tail is approximated (concave upper bound on Σσ) → looser ev bound,
+    # and the estimate must err low, never high.
+    np.testing.assert_allclose(a.explained_variance, b.explained_variance, rtol=5e-2)
+    assert np.all(b.explained_variance <= a.explained_variance * 1.0 + 1e-12)
+
+
+def test_solver_validation(data, mesh8):
+    # A typo'd solver must raise, not silently pick the slow exact path.
+    with pytest.raises(ValueError):
+        fit_pca(data, k=3, mesh=mesh8, solver="randomised")
+    with pytest.raises(ValueError):
+        fit_pca_stream(
+            iter([data]), k=3, n_cols=data.shape[1], mesh=mesh8, solver="Full"
+        )
+
+
+def test_randomized_solver_estimator_param(data, mesh8):
+    k = 3
+    m_full = PCA(mesh=mesh8).setK(k).setSolver("full").fit({"features": data})
+    m_rand = PCA(mesh=mesh8).setK(k).setSolver("randomized").fit({"features": data})
+    np.testing.assert_allclose(np.abs(m_full.pc), np.abs(m_rand.pc), atol=1e-6)
+
+
+def test_randomized_solver_streaming(data, mesh8):
+    k = 3
+    ref = fit_pca(data, k=k, mesh=mesh8)
+    with config.option("solver", "randomized"):
+        sol = fit_pca_stream(
+            np.array_split(data, 4), k=k, n_cols=data.shape[1], mesh=mesh8
+        )
+    np.testing.assert_allclose(np.abs(ref.pc), np.abs(sol.pc), atol=1e-6)
+
+
 def test_k_validation(data, mesh8):
     with pytest.raises(ValueError):
         fit_pca(data, k=0, mesh=mesh8)
