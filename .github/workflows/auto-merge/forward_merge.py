@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Forward-merge release branch HEAD into BASE via a PR, auto-merging it.
+
+Policy-CI parity with the reference's auto-merge workflow (SURVEY.md §2.5);
+own implementation: stdlib-only. Flow: find-or-create the HEAD→BASE PR,
+then try to merge it; a merge conflict leaves the PR open for a human and
+exits non-zero so the failed run is visible.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+
+def api(method: str, url: str, token: str, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Authorization", f"Bearer {token}")
+    req.add_header("Accept", "application/vnd.github+json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def main() -> int:
+    token = os.environ["GITHUB_TOKEN"]
+    repo = os.environ["REPO"]
+    head, base = os.environ["HEAD"], os.environ["BASE"]
+    root = f"https://api.github.com/repos/{repo}"
+
+    status, prs = api(
+        "GET", f"{root}/pulls?state=open&head={repo.split('/')[0]}:{head}&base={base}",
+        token,
+    )
+    if status == 200 and prs:
+        pr = prs[0]
+        print(f"reusing open forward PR #{pr['number']}")
+    else:
+        status, pr = api(
+            "POST",
+            f"{root}/pulls",
+            token,
+            {
+                "title": f"[auto-merge] {head} to {base}",
+                "head": head,
+                "base": base,
+                "body": f"auto-forward of merged changes from {head} to {base}",
+                "maintainer_can_modify": True,
+            },
+        )
+        if status == 422:  # no diff between branches — nothing to forward
+            print(f"nothing to forward: {pr.get('errors')}")
+            return 0
+        if status != 201:
+            print(f"PR creation failed ({status}): {pr}")
+            return 1
+        print(f"opened forward PR #{pr['number']}")
+
+    status, merged = api(
+        "PUT", f"{root}/pulls/{pr['number']}/merge", token, {"merge_method": "merge"}
+    )
+    if status == 200:
+        print(f"merged forward PR #{pr['number']}")
+        return 0
+    print(
+        f"could not auto-merge PR #{pr['number']} ({status}): {merged.get('message')} "
+        "— resolve conflicts manually"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Fail unless every commit in the PR carries a DCO `Signed-off-by:` trailer.
+
+Policy-CI parity with the reference's signoff checker (SURVEY.md §2.5); own
+implementation: stdlib-only, reads the PR commit list from the GitHub API.
+"""
+
+import json
+import os
+import re
+import sys
+import urllib.request
+
+SIGNOFF = re.compile(r"^Signed-off-by: .+ <.+@.+>$", re.MULTILINE)
+
+
+def api(url: str, token: str):
+    req = urllib.request.Request(url)
+    req.add_header("Authorization", f"Bearer {token}")
+    req.add_header("Accept", "application/vnd.github+json")
+    with urllib.request.urlopen(req) as resp:
+        return json.load(resp)
+
+
+def main() -> int:
+    token = os.environ["GITHUB_TOKEN"]
+    repo = os.environ["REPO"]
+    pr = os.environ["PR_NUMBER"]
+    commits = []
+    page = 1
+    while True:
+        batch = api(
+            f"https://api.github.com/repos/{repo}/pulls/{pr}/commits"
+            f"?per_page=100&page={page}",
+            token,
+        )
+        commits.extend(batch)
+        if len(batch) < 100:
+            break
+        page += 1
+    missing = [
+        c["sha"][:12]
+        for c in commits
+        if not SIGNOFF.search(c["commit"]["message"])
+    ]
+    if missing:
+        print(f"commits missing Signed-off-by: {', '.join(missing)}")
+        print("sign your work: git commit -s (see CONTRIBUTING.md)")
+        return 1
+    print(f"all {len(commits)} commits signed off")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,7 +91,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"pca_fit_rows_per_sec_per_chip_d{D}_k{K}",
+                "metric": f"pca_fit_streaming_rows_per_sec_per_chip_d{D}_k{K}",
                 "value": round(rows_per_sec_per_chip, 1),
                 "unit": "rows/s/chip",
                 "vs_baseline": round(rows_per_sec_per_chip / A100_CUML_ROWS_PER_SEC, 4),

@@ -1,0 +1,46 @@
+"""Per-config benchmark suite for the BASELINE.json workloads.
+
+``bench.py`` at the repo root is the recorded headline (PCA.fit streaming
+throughput); the scripts here cover the remaining BASELINE.json configs —
+PCA transform latency, KMeans, LinearRegression/LogisticRegression normal
+equations, and IVF-Flat approximate KNN. Each prints ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}``; shapes are scaled to a
+single chip's HBM (the multi-chip story is sharding-tested in tests/ and
+dry-run-compiled via __graft_entry__.dryrun_multichip) and every script has
+``SRML_BENCH_*`` env knobs for smoke-testing on small hosts.
+
+``vs_baseline`` denominators are analytic A100 estimates (GEMM-bound at
+~110 TFLOP/s sustained TF32, the same convention as bench.py's module
+docstring) — the reference repo publishes no numbers (BASELINE.md).
+"""
+
+import json
+import os
+
+
+def setup_platform() -> None:
+    """Honor SRML_BENCH_PLATFORM=cpu for smoke runs.
+
+    The TPU image's sitecustomize sets ``jax.config.jax_platforms``
+    directly, which beats a ``JAX_PLATFORMS`` env var — only a config
+    update before the first backend touch overrides it. Call this at the
+    top of every bench ``main()``.
+    """
+    plat = os.environ.get("SRML_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 4),
+                "unit": unit,
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )

@@ -1,0 +1,77 @@
+"""IVF-Flat approximate-KNN query throughput — BASELINE.json config #5
+(10M×768 SBERT-class embeddings; scaled to one chip's HBM here).
+
+Builds the IVF-Flat index (`models.knn.build_ivf_flat`: KMeans coarse
+quantizer + padded inverted lists), then times batched queries
+(`_ivf_query_fn`: centroid GEMM → top-nprobe probe → per-list distance
+GEMMs → top-k), reporting queries/s/chip.
+
+Baseline: probing nprobe/nlist of the base ≈ n·nprobe/nlist rows/query at
+2·d flops each → 48 MFLOP/query here; an A100 IVF-Flat at this recall
+point sustains ~2e5 q/s (RAFT-class, bandwidth-limited — rough published
+ballpark, the reference repo itself publishes nothing, BASELINE.md).
+"""
+
+import os
+import sys
+import time
+
+if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+D = int(os.environ.get("SRML_BENCH_D", 768))
+N_BASE = int(os.environ.get("SRML_BENCH_BASE_ROWS", 1 << 20))  # 1M×768 = 3.2 GB
+N_QUERY = int(os.environ.get("SRML_BENCH_QUERIES", 4096))
+K = int(os.environ.get("SRML_BENCH_K", 10))
+NLIST = int(os.environ.get("SRML_BENCH_NLIST", 1024))
+NPROBE = int(os.environ.get("SRML_BENCH_NPROBE", 32))
+
+A100_QUERIES_PER_SEC = 2e5
+
+
+def main() -> None:
+    from benchmarks import setup_platform
+
+    setup_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks import emit
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.knn import _ivf_query_fn, build_ivf_flat
+
+    config.set("compute_dtype", "bfloat16")
+    config.set("accum_dtype", "float32")
+
+    n_chips = len(jax.devices())
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(N_BASE, D)).astype(np.float32)
+    queries = jnp.asarray(rng.normal(size=(N_QUERY, D)), dtype=jnp.float32)
+
+    index = build_ivf_flat(base, nlist=NLIST, seed=0)
+    dev = [
+        jnp.asarray(index.centroids, dtype=jnp.float32),
+        jnp.asarray(index.lists, dtype=jnp.float32),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+    ]
+    query = _ivf_query_fn(K, NPROBE, "bfloat16", "float32")
+    jax.block_until_ready(query(*dev, queries))  # compile + warm
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        dists, ids = jax.block_until_ready(query(*dev, queries))
+    dt = (time.perf_counter() - t0) / reps
+    assert np.all(np.asarray(ids) >= 0)
+    emit(
+        f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}_k{K}_nprobe{NPROBE}",
+        N_QUERY / dt / n_chips,
+        "queries/s/chip",
+        (N_QUERY / dt / n_chips) / A100_QUERIES_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

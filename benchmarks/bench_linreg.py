@@ -1,0 +1,72 @@
+"""LinearRegression normal-equations throughput — BASELINE.json config #4
+(Gram-matrix psum; the Criteo-scale shape class, d ≈ 1k dense).
+
+Times the moment-accumulation hot loop (`_normal_eq_stats_fn`: fused
+XᵀX / Xᵀy / Σx / Σy / Σy² with psum) on device-resident data — the same
+partition-Gram pattern as PCA (SURVEY.md §7.6: "literally the PCA
+reduction with an extra Xᵀy psum"). The d×d solve is a fixed cost
+amortized over the dataset and excluded (measured in tests).
+
+Baseline: Gram is 2·d² flops/row; A100 at ~110 TFLOP/s → 110e12/(2·1024²)
+≈ 52.5e6 rows/s. vs_baseline >= 0.5 matches the north-star "within 2×".
+"""
+
+import os
+import sys
+import time
+
+if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+D = int(os.environ.get("SRML_BENCH_D", 1024))
+ROWS = int(os.environ.get("SRML_BENCH_BATCH_ROWS", 1 << 19))  # 524288×1024 = 2.1 GB
+REPS = int(os.environ.get("SRML_BENCH_REPS", 16))
+
+A100_ROWS_PER_SEC = 110e12 / (2 * D * D)
+
+
+def main() -> None:
+    from benchmarks import setup_platform
+
+    setup_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks import emit
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.linear_regression import _normal_eq_stats_fn
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    config.set("compute_dtype", "bfloat16")
+    config.set("accum_dtype", "float32")
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh(model=1)
+    x = jax.random.normal(jax.random.key(0), (ROWS, D), dtype=jnp.float32)
+    y = jax.random.normal(jax.random.key(1), (ROWS,), dtype=jnp.float32)
+    if n_chips > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+        y = jax.device_put(y, NamedSharding(mesh, P("data")))
+    mask = jnp.ones((ROWS,), dtype=jnp.float32)
+
+    stats = _normal_eq_stats_fn(mesh, "bfloat16", "float32")
+    jax.block_until_ready(stats(x, y, mask))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = jax.block_until_ready(stats(x, y, mask))
+    dt = (time.perf_counter() - t0) / REPS
+    assert np.isfinite(float(out[5]))
+    emit(
+        f"linreg_normal_eq_rows_per_sec_per_chip_d{D}",
+        ROWS / dt / n_chips,
+        "rows/s/chip",
+        (ROWS / dt / n_chips) / A100_ROWS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""LogisticRegression Newton-step throughput — BASELINE.json config #4
+(normal-equations-class Gram psum, IRLS flavor).
+
+Times the binomial Newton fit (`_newton_fn`: per-iteration predict +
+weighted Gram Hessian + psum + d×d solve) for a fixed iteration count on
+device-resident data, reporting row-iterations/s/chip.
+
+Baseline: each Newton iteration is Hessian-Gram-bound at ~2·d² flops/row;
+A100 at ~110 TFLOP/s → 110e12/(2·1024²) ≈ 52.5e6 row-iters/s.
+vs_baseline >= 0.5 matches the north-star "within 2×".
+"""
+
+import os
+import sys
+import time
+
+if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+D = int(os.environ.get("SRML_BENCH_D", 1024))
+ROWS = int(os.environ.get("SRML_BENCH_BATCH_ROWS", 1 << 19))
+ITERS = int(os.environ.get("SRML_BENCH_ITERS", 8))
+
+A100_ROW_ITERS_PER_SEC = 110e12 / (2 * D * D)
+
+
+def main() -> None:
+    from benchmarks import setup_platform
+
+    setup_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks import emit
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.logistic_regression import _newton_fn
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    config.set("compute_dtype", "bfloat16")
+    config.set("accum_dtype", "float32")
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh(model=1)
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (ROWS, D), dtype=jnp.float32)
+    w_true = jax.random.normal(jax.random.key(1), (D,), dtype=jnp.float32) / np.sqrt(D)
+    y = (jax.nn.sigmoid(x @ w_true) > 0.5).astype(jnp.float64)
+    if n_chips > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+        y = jax.device_put(y, NamedSharding(mesh, P("data")))
+    mask = jnp.ones((ROWS,), dtype=jnp.float32)
+
+    # tol=0 → exactly ITERS Newton steps: throughput, not convergence.
+    fn = _newton_fn(mesh, 1e-4, True, ITERS, 0.0, "float32")
+    jax.block_until_ready(fn(x, y, mask))  # compile + warm
+    t0 = time.perf_counter()
+    w, b, n_iter, loss = jax.block_until_ready(fn(x, y, mask))
+    dt = time.perf_counter() - t0
+    iters_run = int(n_iter)
+    assert iters_run >= 1 and np.isfinite(float(loss))
+    emit(
+        f"logreg_newton_row_iters_per_sec_per_chip_d{D}",
+        ROWS * iters_run / dt / n_chips,
+        "row_iters/s/chip",
+        (ROWS * iters_run / dt / n_chips) / A100_ROW_ITERS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

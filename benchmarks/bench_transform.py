@@ -1,0 +1,66 @@
+"""PCA.transform p50 latency — the second BASELINE.json headline metric.
+
+The reference's transform re-uploads the PC matrix host→device on every
+batch (rapidsml_jni.cu:85 — flagged in SURVEY.md §3.2 as the optimization
+target); here the PC matrix is device-resident across batches and the
+per-batch work is one (batch, d) × (d, k) MXU GEMM.
+
+Baseline: an A100 cuML batch transform at 65536×2048 × 2048×32 is ~8.6
+GFLOP ≈ 0.08 ms of GEMM plus per-batch PC upload (~0.25 ms for 0.5 MB
+over PCIe effective ~2 GB/s with launch overhead) ≈ 0.35 ms. vs_baseline =
+baseline_p50 / our_p50 (higher is better, >1 beats the A100 path).
+"""
+
+import os
+import sys
+import time
+
+if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+BASELINE_P50_MS = 0.35
+
+D = int(os.environ.get("SRML_BENCH_D", 2048))
+K = int(os.environ.get("SRML_BENCH_K", 32))
+BATCH = int(os.environ.get("SRML_BENCH_BATCH_ROWS", 65536))
+CALLS = int(os.environ.get("SRML_BENCH_CALLS", 50))
+
+
+def main() -> None:
+    from benchmarks import setup_platform
+
+    setup_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks import emit
+
+    rng = np.random.default_rng(0)
+    pc = jnp.asarray(rng.normal(size=(D, K)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(BATCH, D)), dtype=jnp.float32)
+
+    @jax.jit
+    def transform(pc, x):
+        return jax.lax.dot_general(
+            x, pc, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    jax.block_until_ready(transform(pc, x))  # compile
+    lat = []
+    for _ in range(CALLS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(transform(pc, x))
+        lat.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(lat, 50))
+    emit(
+        f"pca_transform_p50_ms_batch{BATCH}_d{D}_k{K}",
+        p50,
+        "ms",
+        BASELINE_P50_MS / p50,
+    )
+
+
+if __name__ == "__main__":
+    main()

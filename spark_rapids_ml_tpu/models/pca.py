@@ -160,6 +160,8 @@ def _resolve_solver(solver: Optional[str]) -> str:
     a typo must not silently select the slow exact path."""
     if solver is None or solver == "auto":
         solver = config.get("solver")
+    if solver == "auto":  # config itself left at/reset to auto → exact path
+        solver = "full"
     if solver not in _SOLVERS:
         raise ValueError(f"solver must be one of {_SOLVERS} or 'auto', got {solver!r}")
     return solver
@@ -414,13 +416,12 @@ class PCA(Estimator, _PCAParams, MLWritable, MLReadable):
 
     def _fit(self, dataset) -> "PCAModel":
         x = as_matrix(dataset, self.getInputCol())
-        est_solver = self.getSolver()
         sol = fit_pca(
             x,
             k=self.getK(),
             mean_center=self.getMeanCentering(),
             mesh=self._mesh,
-            solver=None if est_solver == "auto" else est_solver,
+            solver=self.getSolver(),
         )
         model = PCAModel(
             pc=sol.pc,
