@@ -52,9 +52,12 @@ def main() -> int:
                 "maintainer_can_modify": True,
             },
         )
-        if status == 422:  # no diff between branches — nothing to forward
-            print(f"nothing to forward: {pr.get('errors')}")
+        if status == 422 and "No commits between" in json.dumps(pr):
+            print("nothing to forward (branches identical)")
             return 0
+        if status == 422:  # other validation error (e.g. BASE missing) is real
+            print(f"PR creation rejected (422): {pr.get('errors') or pr}")
+            return 1
         if status != 201:
             print(f"PR creation failed ({status}): {pr}")
             return 1

@@ -41,7 +41,10 @@ def main() -> int:
     missing = [
         c["sha"][:12]
         for c in commits
-        if not SIGNOFF.search(c["commit"]["message"])
+        # merge commits (>1 parent) are machine-generated — standard DCO
+        # checkers exempt them, and the auto-merge forward PRs rely on it
+        if len(c.get("parents", [])) <= 1
+        and not SIGNOFF.search(c["commit"]["message"])
     ]
     if missing:
         print(f"commits missing Signed-off-by: {', '.join(missing)}")

@@ -103,6 +103,38 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str):
     return jax.jit(f)
 
 
+def init_normal_eq_stats(n_cols: int, accum_dtype=None):
+    """Zero (XᵀX, Xᵀy, Σx, Σy, Σy², n) accumulator for streaming fits."""
+    ad = jnp.dtype(accum_dtype or config.get("accum_dtype"))
+    return (
+        jnp.zeros((n_cols, n_cols), dtype=ad),
+        jnp.zeros((n_cols,), dtype=ad),
+        jnp.zeros((n_cols,), dtype=ad),
+        jnp.zeros((), dtype=ad),
+        jnp.zeros((), dtype=ad),
+        jnp.zeros((), dtype=ad),
+    )
+
+
+def streaming_normal_eq_update(mesh: Mesh, compute_dtype=None, accum_dtype=None):
+    """Jitted (state, x_batch, y_batch, mask) -> state, donated in-place.
+
+    The LinearRegression analogue of the PCA streaming accumulator
+    (SURVEY.md §7.6: "literally the PCA reduction with an extra Xᵀy
+    psum") — for datasets ≫ HBM and for the data-plane daemon's
+    executor-fed batches."""
+    cd = compute_dtype or config.get("compute_dtype")
+    ad = accum_dtype or config.get("accum_dtype")
+    stats = _normal_eq_stats_fn(mesh, cd, ad)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, x, y, mask):
+        part = stats(x, y, mask)
+        return tuple(s + p for s, p in zip(state, part))
+
+    return update
+
+
 def _fista(a: jax.Array, b: jax.Array, l1: float, iters: int, tol: float) -> jax.Array:
     """min_w ½wᵀAw − bᵀw + l1‖w‖₁ via FISTA; A is PSD d×d on device.
 
@@ -199,6 +231,24 @@ def fit_linear_regression(
         stats = _normal_eq_stats_fn(
             mesh, config.get("compute_dtype"), config.get("accum_dtype")
         )(xs, ys, mask)
+    return finalize_normal_eq_stats(
+        stats, reg, elastic_net, fit_intercept, max_iter, tol, n_true
+    )
+
+
+def finalize_normal_eq_stats(
+    stats,
+    reg: float,
+    elastic_net: float,
+    fit_intercept: bool,
+    max_iter: int,
+    tol: float,
+    n_true: int,
+) -> LinearSolution:
+    """(XᵀX, Xᵀy, Σx, Σy, Σy², n) accumulator → LinearSolution.
+
+    Shared tail of batch and streaming fits — also the finalize entry
+    point for the data-plane daemon."""
     with trace_span("solve"):
         w, b = _solve_fn(
             bool(fit_intercept), float(reg), float(elastic_net), int(max_iter), float(tol)

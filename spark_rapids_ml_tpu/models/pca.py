@@ -320,7 +320,29 @@ def fit_pca_stream(
 
         if os.path.exists(checkpoint_path):
             os.unlink(checkpoint_path)
+    return finalize_pca_stats(state, k, mean_center, mesh, n_true, solver=solver)
+
+
+def finalize_pca_stats(
+    state: gram_ops.Stats,
+    k: int,
+    mean_center: bool,
+    mesh: Mesh,
+    n_true: int,
+    solver: Optional[str] = None,
+) -> PCASolution:
+    """(count, colsum, gram) accumulator → PCASolution.
+
+    Shared tail of the streaming fit — also the finalize entry point for
+    the data-plane daemon, which accumulates the same state from
+    executor-fed Arrow batches."""
+    solver = _resolve_solver(solver)
     count, colsum, g = state
+    n_cols = int(np.asarray(colsum).shape[0])
+    if not 0 < k <= n_cols:
+        # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60; without this
+        # the top-k slice silently clamps and returns fewer components
+        raise ValueError(f"k = {k} out of range (0, n = {n_cols}]")
     with trace_span("eig finalize"):
         if _use_host_finalize(mesh) and solver != "randomized":
             pc, ev, s, mean, _ = _finalize_on_host(count, colsum, g, mean_center, k)

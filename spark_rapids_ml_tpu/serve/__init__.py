@@ -1,0 +1,18 @@
+"""TPU-host data-plane daemon: the executor→TPU-host feeding path.
+
+The reference's data plane is the spark-rapids plugin's device-resident
+``ColumnarRdd`` (SURVEY.md §1 L1) — executors and the GPU share an address
+space, so partitions reach the math core zero-copy. TPU hosts have no such
+free ride from JVM executors (SURVEY.md §7 hard part (a)); the equivalent
+component is this daemon: a TCP server on the TPU host that accepts Arrow
+IPC record-batch streams from Spark tasks, flattens the vector column
+through the columnar bridge (native C++ path when available), and folds
+each batch into the on-device sharded accumulator — so the cluster-side
+"reduce" is the daemon's psum-backed streaming state, the role the
+reference's JVM ``RDD.reduce`` played (RapidsRowMatrix.scala:139).
+"""
+
+from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+
+__all__ = ["DataPlaneClient", "DataPlaneDaemon"]

@@ -1,0 +1,144 @@
+"""Client side of the data-plane protocol — what a Spark task runs.
+
+A task opens one connection, feeds its partition as one or more Arrow IPC
+frames, and closes; the driver (or any one caller) finalizes. Socket-level
+work only — no JAX on the executor side, mirroring how the reference keeps
+executors JVM-only and the math behind the JNI boundary.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serve import protocol
+
+
+class DataPlaneClient:
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+
+    # -- connection --------------------------------------------------------
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr, timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _roundtrip(self, req: Dict[str, Any], payload: Optional[bytes] = None):
+        sock = self._conn()
+        protocol.send_json(sock, req)
+        if payload is not None:
+            protocol.send_frame(sock, payload)
+        resp = protocol.recv_json(sock)
+        if resp is None:
+            raise ConnectionError("daemon closed the connection")
+        if not resp.get("ok", False):
+            raise RuntimeError(f"daemon error: {resp.get('error')}")
+        return resp, sock
+
+    # -- ops ---------------------------------------------------------------
+
+    def ping(self) -> bool:
+        resp, _ = self._roundtrip({"op": "ping"})
+        return bool(resp["ok"])
+
+    def feed(
+        self,
+        job: str,
+        data,
+        algo: str = "pca",
+        input_col: str = "features",
+        label_col: str = "label",
+        n_cols: Optional[int] = None,
+    ) -> int:
+        """Feed one batch. ``data``: an Arrow Table/RecordBatch, or an
+        (n, d) ndarray (optionally a (x, y) tuple for linreg). Returns the
+        job's total accumulated rows."""
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
+
+        if isinstance(data, tuple):
+            x, y = data
+            table = pa.table(
+                {
+                    input_col: matrix_to_list_column(np.asarray(x)),
+                    label_col: pa.array(np.asarray(y).reshape(-1)),
+                }
+            )
+        elif isinstance(data, np.ndarray):
+            table = pa.table({input_col: matrix_to_list_column(data)})
+        elif isinstance(data, pa.RecordBatch):
+            table = pa.Table.from_batches([data])
+        else:
+            table = data
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        resp, _ = self._roundtrip(
+            {
+                "op": "feed",
+                "job": job,
+                "algo": algo,
+                "input_col": input_col,
+                "label_col": label_col,
+                "n_cols": n_cols,
+            },
+            payload=sink.getvalue().to_pybytes(),
+        )
+        return int(resp["rows"])
+
+    def status(self, job: str) -> Dict[str, Any]:
+        resp, _ = self._roundtrip({"op": "status", "job": job})
+        return resp
+
+    def drop(self, job: str) -> bool:
+        resp, _ = self._roundtrip({"op": "drop", "job": job})
+        return bool(resp["dropped"])
+
+    def finalize(
+        self, job: str, params: Dict[str, Any], drop: bool = True
+    ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Finalize a job; returns (result arrays, total rows)."""
+        resp, sock = self._roundtrip(
+            {"op": "finalize", "job": job, "params": params, "drop": drop}
+        )
+        return protocol.recv_arrays(sock, resp), int(resp["rows"])
+
+    # -- conveniences ------------------------------------------------------
+
+    def finalize_pca(
+        self,
+        job: str,
+        k: int,
+        mean_center: bool = True,
+        solver: Optional[str] = None,
+    ) -> Dict[str, np.ndarray]:
+        arrays, _ = self.finalize(
+            job, {"k": k, "mean_center": mean_center, "solver": solver}
+        )
+        return arrays
+
+    def finalize_linreg(self, job: str, **params) -> Dict[str, np.ndarray]:
+        arrays, _ = self.finalize(job, params)
+        return arrays

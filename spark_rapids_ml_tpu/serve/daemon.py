@@ -1,0 +1,301 @@
+"""The TPU-host data-plane daemon (see package docstring for the role).
+
+Threading model: one acceptor thread + one thread per connection (Spark
+task). Concurrent feeds to the same job serialize on the job's lock around
+the device fold — the accumulate is associative, so arrival order doesn't
+matter (the property the reference's ``RDD.reduce`` relied on,
+RapidsRowMatrix.scala:139). Feeds to different jobs interleave freely.
+
+Jobs: "pca" folds (count, Σx, XᵀX); "linreg" folds (XᵀX, Xᵀy, Σx, Σy,
+Σy², n). ``finalize`` runs the algorithm's shared finalize (eigensolve /
+normal-equations solve) and streams the result arrays back.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from spark_rapids_ml_tpu.ops import gram as gram_ops
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel.sharding import row_sharding
+from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.daemon")
+
+
+class _Job:
+    """One accumulation job: device state + its fold function + a lock."""
+
+    def __init__(self, algo: str, n_cols: int, mesh):
+        self.algo = algo
+        self.n_cols = n_cols
+        self.mesh = mesh
+        self.lock = threading.Lock()
+        self.rows = 0
+        self.dropped = False
+        self.n_data = mesh.shape[DATA_AXIS]
+        self.x_sharding = row_sharding(mesh)
+        self.v_sharding = row_sharding(mesh, ndim=1)
+        if algo == "pca":
+            self.state = gram_ops.init_stats(n_cols)
+            self.update = gram_ops.streaming_update(mesh)
+        elif algo == "linreg":
+            from spark_rapids_ml_tpu.models.linear_regression import (
+                init_normal_eq_stats,
+                streaming_normal_eq_update,
+            )
+
+            self.state = init_normal_eq_stats(n_cols)
+            self.update = streaming_normal_eq_update(mesh)
+        else:
+            raise ValueError(f"unknown algo {algo!r} (pca|linreg)")
+
+    def _bucket(self, n: int) -> int:
+        """Pad target: next power of two (≥ data-axis size).
+
+        Spark partitions are rarely equal-sized; padding each batch to its
+        exact multiple-of-n_data size would compile one donated update per
+        distinct shape — unbounded in a long-lived daemon. Power-of-two
+        buckets bound compilations to ~log2(max_rows) shapes; the row mask
+        keeps padded rows out of the statistics."""
+        b = max(self.n_data, 1)
+        while b < n:
+            b <<= 1
+        return b
+
+    def fold(self, x: np.ndarray, y: Optional[np.ndarray]) -> None:
+        if x.shape[1] != self.n_cols:
+            raise ValueError(f"batch width {x.shape[1]} != job n_cols {self.n_cols}")
+        if self.algo == "linreg" and y is None:
+            raise ValueError("linreg feed needs a label column")
+        n = x.shape[0]
+        target = self._bucket(n)
+        xb = np.zeros((target,) + x.shape[1:], dtype=x.dtype)
+        xb[:n] = x
+        mb = np.zeros((target,), dtype=np.float32)
+        mb[:n] = 1.0
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped; rows not accepted")
+            xs = jax.device_put(xb, self.x_sharding)
+            ms = jax.device_put(mb, self.v_sharding)
+            if self.algo == "pca":
+                self.state = self.update(self.state, xs, ms)
+            else:
+                yb = np.zeros((target,), dtype=np.asarray(y).dtype)
+                yb[:n] = np.asarray(y).reshape(-1)
+                ys = jax.device_put(yb, self.v_sharding)
+                self.state = self.update(self.state, xs, ys, ms)
+            self.rows += n
+
+    def finalize(self, params: Dict[str, Any], drop: bool = False) -> Dict[str, np.ndarray]:
+        with self.lock:
+            result = self._finalize_locked(params)
+            if drop:
+                # set under the same lock acquisition so a straggler feed
+                # blocked on it sees the flag and errors instead of folding
+                # rows into a model that was already returned
+                self.dropped = True
+            return result
+
+    def _finalize_locked(self, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        if self.algo == "pca":
+            from spark_rapids_ml_tpu.models.pca import finalize_pca_stats
+
+            sol = finalize_pca_stats(
+                self.state,
+                k=int(params["k"]),
+                mean_center=bool(params.get("mean_center", True)),
+                mesh=self.mesh,
+                n_true=self.rows,
+                solver=params.get("solver"),
+            )
+            return {
+                "pc": sol.pc,
+                "explained_variance": sol.explained_variance,
+                "sigma": sol.sigma,
+                "mean": sol.mean,
+            }
+        from spark_rapids_ml_tpu.models.linear_regression import (
+            finalize_normal_eq_stats,
+        )
+
+        sol = finalize_normal_eq_stats(
+            self.state,
+            reg=float(params.get("reg", 0.0)),
+            elastic_net=float(params.get("elastic_net", 0.0)),
+            fit_intercept=bool(params.get("fit_intercept", True)),
+            max_iter=int(params.get("max_iter", 500)),
+            tol=float(params.get("tol", 1e-6)),
+            n_true=self.rows,
+        )
+        return {
+            "coefficients": sol.coefficients,
+            "intercept": np.asarray([sol.intercept]),
+            "rmse": np.asarray([sol.summary.rmse]),
+            "r2": np.asarray([sol.summary.r2]),
+        }
+
+
+class DataPlaneDaemon:
+    """Arrow-over-TCP accumulation server on the TPU host.
+
+    Binds loopback by default; on a cluster, bind the host's NIC and keep
+    the port executor-reachable only (the daemon trusts its callers the
+    way the reference trusts its executors).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, mesh=None):
+        self._host, self._port = host, port
+        self._mesh = mesh
+        self._jobs: Dict[str, _Job] = {}
+        self._jobs_lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._mesh = self._mesh or default_mesh()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self._host, self._port))
+        s.listen(64)
+        self._sock = s
+        self._port = s.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="srml-dataplane-accept", daemon=True
+        )
+        self._accept_thread.start()
+        logger.info("data-plane daemon listening on %s:%d", self._host, self._port)
+        return self
+
+    @property
+    def address(self):
+        return self._host, self._port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- serving -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name=f"srml-dataplane-{addr[1]}",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while True:
+                try:
+                    req = protocol.recv_json(conn)
+                except protocol.ProtocolError as e:
+                    protocol.send_json(conn, {"ok": False, "error": str(e)})
+                    return
+                if req is None:
+                    return  # client done
+                try:
+                    self._dispatch(conn, req)
+                except Exception as e:  # surface to the caller, keep serving
+                    logger.exception("request failed: %s", req.get("op"))
+                    try:
+                        protocol.send_json(conn, {"ok": False, "error": str(e)})
+                    except OSError:
+                        return
+
+    def _dispatch(self, conn, req: Dict[str, Any]) -> None:
+        op = req.get("op")
+        if op == "feed":
+            self._op_feed(conn, req)
+        elif op == "finalize":
+            self._op_finalize(conn, req)
+        elif op == "status":
+            job = self._get_job(req)
+            protocol.send_json(
+                conn, {"ok": True, "rows": job.rows, "algo": job.algo, "n_cols": job.n_cols}
+            )
+        elif op == "drop":
+            with self._jobs_lock:
+                job = self._jobs.pop(str(req.get("job")), None)
+            if job is not None:
+                with job.lock:
+                    job.dropped = True
+            protocol.send_json(conn, {"ok": True, "dropped": job is not None})
+        elif op == "ping":
+            protocol.send_json(conn, {"ok": True})
+        else:
+            raise ValueError(f"unknown op {op!r}")
+
+    def _get_job(self, req) -> _Job:
+        name = str(req.get("job"))
+        with self._jobs_lock:
+            if name not in self._jobs:
+                raise KeyError(f"no such job {name!r}")
+            return self._jobs[name]
+
+    def _op_feed(self, conn, req: Dict[str, Any]) -> None:
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
+
+        payload = protocol.recv_frame(conn)
+        if payload is None:
+            raise protocol.ProtocolError("connection closed before feed payload")
+        with pa.ipc.open_stream(payload) as reader:
+            table = reader.read_all()
+        name = str(req["job"])
+        input_col = req.get("input_col", "features")
+        x = table_column_to_matrix(table, input_col, req.get("n_cols"))
+        req_algo = str(req.get("algo", "pca"))
+        with self._jobs_lock:
+            job = self._jobs.get(name)
+            if job is None:
+                job = _Job(req_algo, x.shape[1], self._mesh)
+                self._jobs[name] = job
+        if job.algo != req_algo:
+            raise ValueError(
+                f"job {name!r} is algo {job.algo!r}; feed requested {req_algo!r}"
+            )
+        y = None
+        if job.algo == "linreg":
+            label_col = req.get("label_col", "label")
+            if label_col not in table.column_names:
+                raise KeyError(f"label column {label_col!r} not in batch")
+            y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
+        job.fold(x, y)
+        protocol.send_json(conn, {"ok": True, "rows": job.rows})
+
+    def _op_finalize(self, conn, req: Dict[str, Any]) -> None:
+        job = self._get_job(req)
+        drop = bool(req.get("drop", True))
+        arrays = job.finalize(req.get("params", {}), drop=drop)
+        protocol.send_arrays(conn, arrays, {"ok": True, "rows": job.rows})
+        if drop:
+            with self._jobs_lock:
+                self._jobs.pop(str(req.get("job")), None)

@@ -1,0 +1,96 @@
+"""Wire framing for the data-plane daemon.
+
+Every message is a 4-byte big-endian length prefix + payload. A request is
+one JSON frame, optionally followed by one Arrow IPC stream frame (op
+"feed"). A response is one JSON frame, optionally followed by raw-buffer
+frames for each array listed in the JSON's ``arrays`` spec (op
+"finalize"). Max frame size bounds a malformed/hostile length prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+MAX_FRAME = 1 << 31  # 2 GB — one Spark partition's batch comfortably fits
+
+_LEN = struct.Struct(">I")
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+def send_frame(sock, payload: bytes) -> None:
+    if len(payload) > MAX_FRAME:
+        # fail fast sender-side instead of shipping GBs the peer will reject
+        raise ProtocolError(
+            f"frame of {len(payload)} bytes exceeds MAX_FRAME {MAX_FRAME}; "
+            "split the batch"
+        )
+    sock.sendall(_LEN.pack(len(payload)))
+    sock.sendall(payload)
+
+
+def recv_exact(sock, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            return None  # peer closed
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock) -> Optional[bytes]:
+    header = recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame of {n} bytes exceeds MAX_FRAME {MAX_FRAME}")
+    return recv_exact(sock, n)
+
+
+def send_json(sock, obj: Dict[str, Any]) -> None:
+    send_frame(sock, json.dumps(obj).encode())
+
+
+def recv_json(sock) -> Optional[Dict[str, Any]]:
+    frame = recv_frame(sock)
+    if frame is None:
+        return None
+    try:
+        obj = json.loads(frame)
+    except ValueError as e:
+        raise ProtocolError(f"bad JSON frame: {e}") from e
+    if not isinstance(obj, dict):
+        raise ProtocolError(f"expected JSON object, got {type(obj).__name__}")
+    return obj
+
+
+def send_arrays(sock, arrays: Dict[str, np.ndarray], meta: Dict[str, Any]) -> None:
+    """JSON header (meta + array specs) then one raw frame per array."""
+    spec = [
+        {"name": k, "dtype": str(v.dtype), "shape": list(v.shape)}
+        for k, v in arrays.items()
+    ]
+    send_json(sock, {**meta, "arrays": spec})
+    for v in arrays.values():
+        send_frame(sock, np.ascontiguousarray(v).tobytes())
+
+
+def recv_arrays(sock, header: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out = {}
+    for spec in header.get("arrays", []):
+        frame = recv_frame(sock)
+        if frame is None:
+            raise ProtocolError("connection closed mid-array")
+        arr = np.frombuffer(frame, dtype=np.dtype(spec["dtype"]))
+        # frombuffer over the received bytes is read-only; callers own the
+        # result (model coefficients) and may mutate — copy.
+        out[spec["name"]] = arr.reshape(spec["shape"]).copy()
+    return out

@@ -1,0 +1,181 @@
+"""Data-plane daemon tests: executor-fed accumulation over real sockets.
+
+The distributed-feeding coverage the reference lacks entirely (SURVEY.md
+§4: no multi-executor test) — here N concurrent "executors" (threads)
+stream Arrow IPC partitions to the daemon over TCP and the finalized model
+must equal the single-shot in-memory fit (associativity of the fold).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.linear_regression import fit_linear_regression
+from spark_rapids_ml_tpu.models.pca import fit_pca
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+
+@pytest.fixture
+def daemon(mesh8):
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        yield d
+
+
+def _client(daemon):
+    return DataPlaneClient(*daemon.address)
+
+
+@pytest.fixture
+def data(rng):
+    n, d = 600, 24
+    basis = rng.normal(size=(d, d)) * np.logspace(0, -1.5, d)
+    return rng.normal(size=(n, d)) @ basis
+
+
+def test_ping(daemon):
+    with _client(daemon) as c:
+        assert c.ping()
+
+
+def test_pca_concurrent_executors_match_batch_fit(daemon, data, mesh8):
+    k = 4
+    parts = np.array_split(data, 4)
+    errs = []
+
+    def executor(part):
+        try:
+            with _client(daemon) as c:
+                # two sub-batches per partition: exercises repeat feeds on
+                # one connection
+                for sub in np.array_split(part, 2):
+                    c.feed("job-pca", sub, algo="pca")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=executor, args=(p,)) for p in parts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    with _client(daemon) as c:
+        assert c.status("job-pca")["rows"] == data.shape[0]
+        out = c.finalize_pca("job-pca", k=k)
+    ref = fit_pca(data, k=k, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-8)
+    np.testing.assert_allclose(
+        out["explained_variance"], ref.explained_variance, atol=1e-10
+    )
+    np.testing.assert_allclose(out["mean"], ref.mean, atol=1e-10)
+
+
+def test_linreg_feed_finalize(daemon, data, mesh8, rng):
+    w_true = rng.normal(size=(data.shape[1],))
+    y = data @ w_true + 0.5 + 0.01 * rng.normal(size=data.shape[0])
+    with _client(daemon) as c:
+        for xs, ys in zip(np.array_split(data, 3), np.array_split(y, 3)):
+            c.feed("job-lr", (xs, ys), algo="linreg")
+        out = c.finalize_linreg("job-lr", reg=1e-6)
+    ref = fit_linear_regression(data, y, reg=1e-6, mesh=mesh8)
+    np.testing.assert_allclose(out["coefficients"], ref.coefficients, atol=1e-6)
+    np.testing.assert_allclose(out["intercept"][0], ref.intercept, atol=1e-6)
+    np.testing.assert_allclose(out["r2"][0], ref.summary.r2, atol=1e-8)
+
+
+def test_finalize_drops_job_by_default(daemon, data):
+    with _client(daemon) as c:
+        c.feed("ephemeral", data)
+        c.finalize_pca("ephemeral", k=2)
+        with pytest.raises(RuntimeError, match="no such job"):
+            c.status("ephemeral")
+
+
+def test_two_jobs_interleave(daemon, data):
+    a, b = data[:300], data[300:]
+    with _client(daemon) as c:
+        c.feed("a", a)
+        c.feed("b", b)
+        c.feed("a", a)
+        assert c.status("a")["rows"] == 2 * a.shape[0]
+        assert c.status("b")["rows"] == b.shape[0]
+        assert c.drop("a")
+        assert not c.drop("a")  # already gone
+
+
+def test_feed_width_mismatch_rejected(daemon, data):
+    with _client(daemon) as c:
+        c.feed("w", data)
+        with pytest.raises(RuntimeError, match="width"):
+            c.feed("w", data[:, :10])
+        # the error must not kill the connection: next op still works
+        assert c.status("w")["rows"] == data.shape[0]
+
+
+def test_unknown_op_and_unknown_job(daemon):
+    with _client(daemon) as c:
+        with pytest.raises(RuntimeError, match="unknown op"):
+            c._roundtrip({"op": "nope"})
+        with pytest.raises(RuntimeError, match="no such job"):
+            c.status("never-created")
+
+
+def test_linreg_missing_label_rejected(daemon, data):
+    with _client(daemon) as c:
+        with pytest.raises(RuntimeError, match="label"):
+            c.feed("lr2", data, algo="linreg")
+
+
+def test_algo_conflict_rejected(daemon, data, rng):
+    y = rng.normal(size=data.shape[0])
+    with _client(daemon) as c:
+        c.feed("conf", data, algo="pca")
+        with pytest.raises(RuntimeError, match="algo"):
+            c.feed("conf", (data, y), algo="linreg")
+
+
+def test_straggler_fold_after_finalize_rejected(daemon, data):
+    # Straggler protection: a task holding the OLD job object (grabbed
+    # before finalize popped it) must error on fold, not silently lose its
+    # rows into a model that was already returned. (A new feed under the
+    # same name legitimately starts a fresh job.)
+    with _client(daemon) as c:
+        c.feed("s", data)
+        straggler_job = daemon._jobs["s"]
+        c.finalize_pca("s", k=2)
+    with pytest.raises(KeyError, match="finalized"):
+        straggler_job.fold(data, None)
+
+
+def test_finalize_k_out_of_range(daemon, data):
+    with _client(daemon) as c:
+        c.feed("kk", data)
+        with pytest.raises(RuntimeError, match="out of range"):
+            c.finalize_pca("kk", k=data.shape[1] + 1)
+
+
+def test_result_arrays_writable(daemon, data):
+    with _client(daemon) as c:
+        c.feed("wr", data)
+        out = c.finalize_pca("wr", k=2)
+    out["pc"] *= -1.0  # callers own the result; must not be read-only
+
+
+def test_bucket_padding_preserves_stats(daemon, data, mesh8):
+    # Odd-sized batches land in power-of-two buckets; masked padding must
+    # keep the statistics exact.
+    parts = [data[:7], data[7:100], data[100:]]
+    with _client(daemon) as c:
+        for p in parts:
+            c.feed("bp", p)
+        out = c.finalize_pca("bp", k=3)
+    ref = fit_pca(data, k=3, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-8)
+
+
+def test_randomized_solver_over_the_wire(daemon, data, mesh8):
+    with _client(daemon) as c:
+        c.feed("rnd", data)
+        out = c.finalize_pca("rnd", k=3, solver="randomized")
+    ref = fit_pca(data, k=3, mesh=mesh8, solver="full")
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-6)
