@@ -123,8 +123,16 @@ def streaming_normal_eq_update(mesh: Mesh, compute_dtype=None, accum_dtype=None)
     (SURVEY.md §7.6: "literally the PCA reduction with an extra Xᵀy
     psum") — for datasets ≫ HBM and for the data-plane daemon's
     executor-fed batches."""
-    cd = compute_dtype or config.get("compute_dtype")
-    ad = accum_dtype or config.get("accum_dtype")
+    cd = jnp.dtype(compute_dtype or config.get("compute_dtype")).name
+    ad = jnp.dtype(accum_dtype or config.get("accum_dtype")).name
+    return _streaming_normal_eq_update(mesh, cd, ad)
+
+
+@functools.lru_cache(maxsize=32)
+def _streaming_normal_eq_update(mesh: Mesh, cd: str, ad: str):
+    # Cached per (mesh, dtypes): jax's jit cache is keyed on the function
+    # object, so returning a fresh closure per call would re-trace and
+    # re-compile the donated update for every job in a long-lived daemon.
     stats = _normal_eq_stats_fn(mesh, cd, ad)
 
     @functools.partial(jax.jit, donate_argnums=(0,))

@@ -231,6 +231,20 @@ def streaming_update(mesh: Mesh, compute_dtype=None, accum_dtype=None):
     row-sharded batches in. Donation makes the accumulate in-place. This is
     the path for BASELINE.json config #2 (100M×2048 ≫ HBM).
     """
+    dcd, dad = _dtypes()
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else dcd
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else dad
+    # use_pallas is read by local_stats at trace time, so it must be part of
+    # the cache key (same reason as _fit_fn's).
+    return _streaming_update_cached(mesh, cd.name, ad.name, bool(config.get("use_pallas")))
+
+
+@functools.lru_cache(maxsize=32)
+def _streaming_update_cached(mesh: Mesh, compute_dtype, accum_dtype, use_pallas: bool):
+    # Cached per (mesh, dtypes, pallas flag): returning a fresh jitted
+    # closure per call would force a full XLA recompile for every job in a
+    # long-lived daemon (jit caches are keyed on the function object).
+    del use_pallas  # cache key only
 
     def shard_update(count, colsum, gram, x, mask):
         c, s, g = _stats_shard(x, mask, compute_dtype, accum_dtype)

@@ -273,6 +273,15 @@ class DataPlaneDaemon:
         input_col = req.get("input_col", "features")
         x = table_column_to_matrix(table, input_col, req.get("n_cols"))
         req_algo = str(req.get("algo", "pca"))
+        # Validate the batch BEFORE registering a job, so a rejected first
+        # feed doesn't leave an orphan empty job (with its d×d device
+        # buffers) parked under the name forever.
+        y = None
+        if req_algo == "linreg":
+            label_col = req.get("label_col", "label")
+            if label_col not in table.column_names:
+                raise KeyError(f"label column {label_col!r} not in batch")
+            y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
         with self._jobs_lock:
             job = self._jobs.get(name)
             if job is None:
@@ -282,12 +291,6 @@ class DataPlaneDaemon:
             raise ValueError(
                 f"job {name!r} is algo {job.algo!r}; feed requested {req_algo!r}"
             )
-        y = None
-        if job.algo == "linreg":
-            label_col = req.get("label_col", "label")
-            if label_col not in table.column_names:
-                raise KeyError(f"label column {label_col!r} not in batch")
-            y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
         job.fold(x, y)
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
 
@@ -295,7 +298,9 @@ class DataPlaneDaemon:
         job = self._get_job(req)
         drop = bool(req.get("drop", True))
         arrays = job.finalize(req.get("params", {}), drop=drop)
-        protocol.send_arrays(conn, arrays, {"ok": True, "rows": job.rows})
+        # Unregister BEFORE sending: if the client disconnects mid-response
+        # the name must not stay poisoned (dropped=True) in _jobs forever.
         if drop:
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
+        protocol.send_arrays(conn, arrays, {"ok": True, "rows": job.rows})

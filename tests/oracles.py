@@ -1,0 +1,247 @@
+"""Differential-test oracles with NumPy/SciPy fallbacks.
+
+The reference's test strategy is the differential oracle: the accelerated
+path is compared against an independent CPU implementation, sign/permutation
+invariant, tolerance-based (PCASuite.scala:58-87; SURVEY.md §4). sklearn is
+the preferred oracle when installed; every function here falls back to a
+pure NumPy/SciPy implementation of the *same objective* so the differential
+tests still run (instead of skipping) on images without sklearn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # pragma: no cover - exercised implicitly by which branch runs
+    import sklearn  # noqa: F401
+
+    HAVE_SKLEARN = True
+except ImportError:
+    HAVE_SKLEARN = False
+
+
+# ---------------------------------------------------------------------------
+# k-nearest neighbors (sklearn.neighbors.NearestNeighbors, brute force)
+# ---------------------------------------------------------------------------
+
+
+def knn_brute(db: np.ndarray, queries: np.ndarray, k: int):
+    """Exact euclidean kNN: (distances, indices), each (n_queries, k)."""
+    if HAVE_SKLEARN:
+        from sklearn.neighbors import NearestNeighbors
+
+        nn = NearestNeighbors(n_neighbors=k, algorithm="brute").fit(db)
+        return nn.kneighbors(queries)
+    # Exact pairwise distances without the Gram trick (the system under test
+    # uses ‖x‖²+‖y‖²−2xy; the oracle must be independent of it).
+    diff = queries[:, None, :] - db[None, :, :]
+    d2 = np.einsum("qnd,qnd->qn", diff, diff)
+    idx = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    d = np.sqrt(np.take_along_axis(d2, idx, axis=1))
+    return d, idx
+
+
+# ---------------------------------------------------------------------------
+# Ridge (sklearn.linear_model.Ridge: min ‖y−Xw−b‖² + alpha‖w‖², b unpenalized)
+# ---------------------------------------------------------------------------
+
+
+def ridge(x: np.ndarray, y: np.ndarray, alpha: float, fit_intercept: bool = True):
+    """Returns (coef, intercept) minimizing ‖y−Xw−b‖² + alpha‖w‖²."""
+    if HAVE_SKLEARN:
+        from sklearn.linear_model import Ridge
+
+        m = Ridge(alpha=alpha, fit_intercept=fit_intercept).fit(x, y)
+        return m.coef_, float(m.intercept_) if fit_intercept else 0.0
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if fit_intercept:
+        xm, ym = x.mean(axis=0), y.mean()
+        xc, yc = x - xm, y - ym
+    else:
+        xc, yc = x, y
+    d = x.shape[1]
+    w = np.linalg.solve(xc.T @ xc + alpha * np.eye(d), xc.T @ yc)
+    b = float(ym - xm @ w) if fit_intercept else 0.0
+    return w, b
+
+
+# ---------------------------------------------------------------------------
+# Lasso / ElasticNet (sklearn objective:
+#   1/(2n)‖y−Xw−b‖² + alpha·l1_ratio‖w‖₁ + alpha(1−l1_ratio)/2‖w‖²)
+# ---------------------------------------------------------------------------
+
+
+def elastic_net(
+    x: np.ndarray,
+    y: np.ndarray,
+    alpha: float,
+    l1_ratio: float = 1.0,
+    fit_intercept: bool = True,
+    max_iter: int = 10000,
+    tol: float = 1e-12,
+):
+    """Returns (coef, intercept) via cyclic coordinate descent."""
+    if HAVE_SKLEARN:
+        from sklearn.linear_model import ElasticNet, Lasso
+
+        if l1_ratio == 1.0:
+            m = Lasso(alpha=alpha, fit_intercept=fit_intercept, max_iter=max_iter)
+        else:
+            m = ElasticNet(
+                alpha=alpha,
+                l1_ratio=l1_ratio,
+                fit_intercept=fit_intercept,
+                max_iter=max_iter,
+            )
+        m.fit(x, y)
+        return m.coef_, float(m.intercept_) if fit_intercept else 0.0
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    n, d = x.shape
+    if fit_intercept:
+        xm, ym = x.mean(axis=0), y.mean()
+        xc, yc = x - xm, y - ym
+    else:
+        xm, ym = np.zeros(d), 0.0
+        xc, yc = x, y
+    l1 = alpha * l1_ratio
+    l2 = alpha * (1.0 - l1_ratio)
+    col_sq = (xc * xc).sum(axis=0) / n  # (1/n)‖x_j‖²
+    w = np.zeros(d)
+    r = yc.copy()  # residual y − Xw
+    for _ in range(max_iter):
+        w_max = 0.0
+        dw_max = 0.0
+        for j in range(d):
+            if col_sq[j] == 0.0:
+                continue
+            wj = w[j]
+            rho = xc[:, j] @ r / n + col_sq[j] * wj
+            wn = np.sign(rho) * max(abs(rho) - l1, 0.0) / (col_sq[j] + l2)
+            if wn != wj:
+                r += xc[:, j] * (wj - wn)
+                w[j] = wn
+            w_max = max(w_max, abs(wn))
+            dw_max = max(dw_max, abs(wn - wj))
+        if w_max == 0.0 or dw_max / max(w_max, 1e-30) < tol:
+            break
+    b = float(ym - xm @ w) if fit_intercept else 0.0
+    return w, b
+
+
+# ---------------------------------------------------------------------------
+# Logistic regression (sklearn lbfgs objective:
+#   C·Σᵢ logloss(xᵢ, yᵢ) + ½‖w‖², intercept unpenalized; multinomial softmax)
+# ---------------------------------------------------------------------------
+
+
+class _LogRegResult:
+    def __init__(self, coef, intercept, classes):
+        self.coef_ = coef
+        self.intercept_ = intercept
+        self.classes_ = classes
+
+    def predict(self, x):
+        z = x @ self.coef_.T + self.intercept_
+        if z.shape[1] == 1:
+            return self.classes_[(z[:, 0] > 0).astype(int)]
+        return self.classes_[np.argmax(z, axis=1)]
+
+    def score(self, x, y):
+        return float(np.mean(self.predict(x) == np.asarray(y)))
+
+
+def logreg(x: np.ndarray, y: np.ndarray, C: float, tol: float = 1e-10, max_iter: int = 5000):
+    """sklearn-LogisticRegression-shaped result (coef_, intercept_, score)."""
+    if HAVE_SKLEARN:
+        from sklearn.linear_model import LogisticRegression
+
+        return LogisticRegression(C=C, tol=tol, max_iter=max_iter).fit(x, y)
+    from scipy.optimize import minimize
+    from scipy.special import logsumexp
+
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y)
+    classes = np.unique(y)
+    n, d = x.shape
+    n_classes = len(classes)
+    if n_classes == 2:
+        t = (y == classes[1]).astype(np.float64) * 2.0 - 1.0  # ±1
+
+        def obj(p):
+            w, b = p[:d], p[d]
+            z = t * (x @ w + b)
+            # log(1+e^{−z}) stably
+            loss = np.logaddexp(0.0, -z).sum()
+            sig = 1.0 / (1.0 + np.exp(np.clip(z, -700, 700)))
+            g_z = -t * sig
+            gw = C * (x.T @ g_z) + w
+            gb = C * g_z.sum()
+            return C * loss + 0.5 * w @ w, np.concatenate([gw, [gb]])
+
+        res = minimize(obj, np.zeros(d + 1), jac=True, method="L-BFGS-B",
+                       tol=tol, options={"maxiter": max_iter})
+        w, b = res.x[:d], res.x[d]
+        return _LogRegResult(w[None, :], np.array([b]), classes)
+    onehot = (y[:, None] == classes[None, :]).astype(np.float64)
+
+    def obj(p):
+        W = p[: d * n_classes].reshape(n_classes, d)
+        b = p[d * n_classes:]
+        z = x @ W.T + b  # (n, c)
+        lse = logsumexp(z, axis=1)
+        loss = (lse - (z * onehot).sum(axis=1)).sum()
+        p_soft = np.exp(z - lse[:, None])
+        g_z = p_soft - onehot  # (n, c)
+        gW = C * (g_z.T @ x) + W
+        gb = C * g_z.sum(axis=0)
+        return C * loss + 0.5 * (W * W).sum(), np.concatenate([gW.ravel(), gb])
+
+    res = minimize(obj, np.zeros(d * n_classes + n_classes), jac=True,
+                   method="L-BFGS-B", tol=tol, options={"maxiter": max_iter})
+    W = res.x[: d * n_classes].reshape(n_classes, d)
+    b = res.x[d * n_classes:]
+    return _LogRegResult(W, b, classes)
+
+
+# ---------------------------------------------------------------------------
+# KMeans inertia (sklearn.cluster.KMeans with n_init restarts)
+# ---------------------------------------------------------------------------
+
+
+def kmeans_inertia(pts: np.ndarray, k: int, n_init: int = 3, seed: int = 0) -> float:
+    """Best inertia over n_init Lloyd runs with kmeans++-style seeding."""
+    if HAVE_SKLEARN:
+        from sklearn.cluster import KMeans
+
+        return float(KMeans(n_clusters=k, n_init=n_init, random_state=seed).fit(pts).inertia_)
+    rng = np.random.default_rng(seed)
+    pts = np.asarray(pts, dtype=np.float64)
+    best = np.inf
+    for _ in range(n_init):
+        # kmeans++ seeding
+        centers = [pts[rng.integers(len(pts))]]
+        for _ in range(k - 1):
+            d2 = np.min(
+                ((pts[:, None, :] - np.asarray(centers)[None]) ** 2).sum(-1), axis=1
+            )
+            p = d2 / d2.sum()
+            centers.append(pts[rng.choice(len(pts), p=p)])
+        c = np.asarray(centers)
+        for _ in range(300):
+            d2 = ((pts[:, None, :] - c[None]) ** 2).sum(-1)
+            assign = np.argmin(d2, axis=1)
+            newc = np.array(
+                [
+                    pts[assign == j].mean(axis=0) if np.any(assign == j) else c[j]
+                    for j in range(k)
+                ]
+            )
+            if np.allclose(newc, c):
+                c = newc
+                break
+            c = newc
+        inertia = float(((pts - c[assign]) ** 2).sum())
+        best = min(best, inertia)
+    return best

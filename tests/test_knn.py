@@ -19,10 +19,9 @@ def db_and_queries(rng):
 
 
 def _sklearn_knn(db, queries, k):
-    sk = pytest.importorskip("sklearn.neighbors")
-    nn = sk.NearestNeighbors(n_neighbors=k, algorithm="brute").fit(db)
-    d, i = nn.kneighbors(queries)
-    return d, i
+    from oracles import knn_brute
+
+    return knn_brute(db, queries, k)
 
 
 def test_exact_matches_sklearn(db_and_queries, mesh8):

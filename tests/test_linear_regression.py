@@ -34,39 +34,42 @@ def test_no_intercept(regression_data, mesh8):
     assert sol.intercept == 0.0
 
 
-def test_ridge_matches_sklearn(regression_data, mesh8):
-    sk = pytest.importorskip("sklearn.linear_model")
+def test_ridge_matches_oracle(regression_data, mesh8):
+    from oracles import ridge
+
     x, y, _ = regression_data
     lam = 0.3
     sol = fit_linear_regression(x, y, reg=lam, mesh=mesh8)
-    # Spark's objective is 1/(2n)·RSS + λ/2·‖w‖²  ⇒  sklearn alpha = λ·n.
-    ref = sk.Ridge(alpha=lam * len(x), fit_intercept=True).fit(x, y)
-    np.testing.assert_allclose(sol.coefficients, ref.coef_, atol=1e-5)
-    assert abs(sol.intercept - ref.intercept_) < 1e-5
+    # Spark's objective is 1/(2n)·RSS + λ/2·‖w‖²  ⇒  oracle alpha = λ·n.
+    ref_w, ref_b = ridge(x, y, alpha=lam * len(x), fit_intercept=True)
+    np.testing.assert_allclose(sol.coefficients, ref_w, atol=1e-5)
+    assert abs(sol.intercept - ref_b) < 1e-5
 
 
-def test_lasso_matches_sklearn(regression_data, mesh8):
-    sk = pytest.importorskip("sklearn.linear_model")
+def test_lasso_matches_oracle(regression_data, mesh8):
+    from oracles import elastic_net
+
     x, y, _ = regression_data
     lam = 0.1
     sol = fit_linear_regression(
         x, y, reg=lam, elastic_net=1.0, max_iter=2000, mesh=mesh8
     )
-    ref = sk.Lasso(alpha=lam, fit_intercept=True, max_iter=10000).fit(x, y)
-    np.testing.assert_allclose(sol.coefficients, ref.coef_, atol=1e-4)
-    assert abs(sol.intercept - ref.intercept_) < 1e-4
+    ref_w, ref_b = elastic_net(x, y, alpha=lam, l1_ratio=1.0, max_iter=10000)
+    np.testing.assert_allclose(sol.coefficients, ref_w, atol=1e-4)
+    assert abs(sol.intercept - ref_b) < 1e-4
 
 
-def test_elastic_net_matches_sklearn(regression_data, mesh8):
-    sk = pytest.importorskip("sklearn.linear_model")
+def test_elastic_net_matches_oracle(regression_data, mesh8):
+    from oracles import elastic_net
+
     x, y, _ = regression_data
     lam, alpha = 0.1, 0.5
     sol = fit_linear_regression(
         x, y, reg=lam, elastic_net=alpha, max_iter=2000, mesh=mesh8
     )
-    ref = sk.ElasticNet(alpha=lam, l1_ratio=alpha, fit_intercept=True, max_iter=10000).fit(x, y)
-    np.testing.assert_allclose(sol.coefficients, ref.coef_, atol=1e-4)
-    assert abs(sol.intercept - ref.intercept_) < 1e-4
+    ref_w, ref_b = elastic_net(x, y, alpha=lam, l1_ratio=alpha, max_iter=10000)
+    np.testing.assert_allclose(sol.coefficients, ref_w, atol=1e-4)
+    assert abs(sol.intercept - ref_b) < 1e-4
 
 
 def test_shard_invariance(regression_data):

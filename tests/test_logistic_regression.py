@@ -28,13 +28,14 @@ def multi_data(rng):
     return x, y
 
 
-def test_binary_matches_sklearn(binary_data, mesh8):
-    sk = pytest.importorskip("sklearn.linear_model")
+def test_binary_matches_oracle(binary_data, mesh8):
+    from oracles import logreg
+
     x, y = binary_data
     lam = 0.01
     sol = fit_logistic_regression(x, y, reg=lam, mesh=mesh8)
-    # Spark objective: 1/n Σ loss + λ/2 ‖w‖²  ⇒  sklearn C = 1/(n·λ).
-    ref = sk.LogisticRegression(C=1.0 / (len(x) * lam), tol=1e-10, max_iter=5000).fit(x, y)
+    # Spark objective: 1/n Σ loss + λ/2 ‖w‖²  ⇒  oracle C = 1/(n·λ).
+    ref = logreg(x, y, C=1.0 / (len(x) * lam), tol=1e-10, max_iter=5000)
     np.testing.assert_allclose(sol.coefficients, ref.coef_[0], atol=2e-4)
     np.testing.assert_allclose(sol.intercept, ref.intercept_[0], atol=2e-4)
 
@@ -51,12 +52,13 @@ def test_binary_unregularized_separates(mesh8, rng):
     assert acc > 0.99
 
 
-def test_multinomial_matches_sklearn(multi_data, mesh8):
-    sk = pytest.importorskip("sklearn.linear_model")
+def test_multinomial_matches_oracle(multi_data, mesh8):
+    from oracles import logreg
+
     x, y = multi_data
     lam = 0.01
     sol = fit_logistic_regression(x, y, reg=lam, max_iter=3000, tol=1e-9, mesh=mesh8)
-    ref = sk.LogisticRegression(C=1.0 / (len(x) * lam), tol=1e-10, max_iter=5000).fit(x, y)
+    ref = logreg(x, y, C=1.0 / (len(x) * lam), tol=1e-10, max_iter=5000)
     # Softmax parameters are identifiable only up to a per-feature constant
     # shift across classes; compare class-mean-centered coefficients.
     ours = sol.coefficients - sol.coefficients.mean(axis=0, keepdims=True)
