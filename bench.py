@@ -8,7 +8,12 @@ compute path:
     accumulator state (the reference's dgemmCov hot loop,
     rapidsml_jni.cu:120-125, plus the device-side combiner its
     ``accumulateCov`` declared but never implemented — SURVEY.md §2.4),
-    bfloat16 GEMM on the MXU with float32 accumulation;
+    bfloat16 GEMM on the MXU with float32 accumulation. Batches are
+    ingest-cast to the compute dtype at placement (the framework's
+    quantize-on-ingest design: identical Gram numerics, half the transfer
+    bytes) and the update runs the single-HBM-pass Pallas kernel that
+    fuses the boundary row-mask and the column-sum into the GEMM
+    (ops/pallas_kernels.gram_colsum_pallas);
   - one mean-centered finalize + on-device randomized top-k eigensolve +
     sign-flip (the reference's calSVD, rapidsml_jni.cu:215-269) — only the
     (d, k) result leaves the device.
@@ -52,19 +57,21 @@ def main() -> None:
 
     config.set("compute_dtype", "bfloat16")
     config.set("accum_dtype", "float32")
+    config.set("use_pallas", True)
 
     n_chips = len(jax.devices())
     mesh = make_mesh(model=1)
 
-    # On-device data generation (no host transfer in the timed region).
+    # On-device data generation (no host transfer in the timed region),
+    # ingest-cast to the compute dtype as the bridge does at placement.
     x = jax.random.normal(jax.random.key(0), (BATCH_ROWS, D), dtype=jnp.float32)
+    x = x.astype(jnp.bfloat16)
     if n_chips > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
-    mask = jnp.ones((BATCH_ROWS,), dtype=jnp.float32)
 
-    update = gram_ops.streaming_update(
+    update = gram_ops.streaming_update_rows(
         mesh, compute_dtype="bfloat16", accum_dtype="float32"
     )
 
@@ -76,7 +83,7 @@ def main() -> None:
     def fit(n_batches):
         state = gram_ops.init_stats(D, accum_dtype="float32")
         for _ in range(n_batches):
-            state = update(state, x, mask)
+            state = update(state, x, BATCH_ROWS)
         pc, ev, _ = finalize(*state)
         return jax.device_get((pc, ev))  # (d, k) + (k,) — tiny
 

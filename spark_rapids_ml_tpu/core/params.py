@@ -73,6 +73,36 @@ class TypeConverters:
         return value
 
 
+class ParamValidators:
+    """Value-validity predicates, mirroring org.apache.spark.ml.param.ParamValidators
+    (the reference's inherited ``k`` uses ``gt(0)`` via Spark's PCAParams)."""
+
+    @staticmethod
+    def gt(lower):
+        return lambda v: v > lower
+
+    @staticmethod
+    def gtEq(lower):
+        return lambda v: v >= lower
+
+    @staticmethod
+    def lt(upper):
+        return lambda v: v < upper
+
+    @staticmethod
+    def ltEq(upper):
+        return lambda v: v <= upper
+
+    @staticmethod
+    def inRange(lower, upper):
+        return lambda v: lower <= v <= upper
+
+    @staticmethod
+    def inList(allowed):
+        allowed = tuple(allowed)
+        return lambda v: v in allowed
+
+
 class Param(Generic[T]):
     """A named, documented, typed parameter owned by a :class:`Params` instance.
 
@@ -80,7 +110,7 @@ class Param(Generic[T]):
     ``meanCentering`` BooleanParam, RapidsPCA.scala:40-41).
     """
 
-    __slots__ = ("parent", "name", "doc", "typeConverter")
+    __slots__ = ("parent", "name", "doc", "typeConverter", "validator")
 
     def __init__(
         self,
@@ -88,11 +118,23 @@ class Param(Generic[T]):
         name: str,
         doc: str,
         typeConverter: Callable[[Any], T] = TypeConverters.identity,
+        validator: Optional[Callable[[T], bool]] = None,
     ):
         self.parent = parent.uid if isinstance(parent, Params) else str(parent)
         self.name = name
         self.doc = doc
         self.typeConverter = typeConverter
+        self.validator = validator
+
+    def _convert(self, value: T) -> T:
+        """Convert + validate, raising the Spark-style error on rejection."""
+        converted = self.typeConverter(value)
+        if self.validator is not None and not self.validator(converted):
+            raise ValueError(
+                f"{self.parent} parameter {self.name} given invalid value "
+                f"{converted!r}."
+            )
+        return converted
 
     def __repr__(self) -> str:
         return f"{self.parent}__{self.name}"
@@ -112,12 +154,13 @@ class _ParamDecl:
         k = _ParamDecl("k", "number of principal components", TypeConverters.toInt)
     """
 
-    __slots__ = ("name", "doc", "typeConverter")
+    __slots__ = ("name", "doc", "typeConverter", "validator")
 
-    def __init__(self, name, doc, typeConverter=TypeConverters.identity):
+    def __init__(self, name, doc, typeConverter=TypeConverters.identity, validator=None):
         self.name = name
         self.doc = doc
         self.typeConverter = typeConverter
+        self.validator = validator
 
 
 # Public alias used by model classes when declaring params.
@@ -146,7 +189,9 @@ class Params:
             for attr_name, decl in vars(klass).items():
                 if isinstance(decl, _ParamDecl) and decl.name not in seen:
                     seen.add(decl.name)
-                    p = Param(self, decl.name, decl.doc, decl.typeConverter)
+                    p = Param(
+                        self, decl.name, decl.doc, decl.typeConverter, decl.validator
+                    )
                     setattr(self, attr_name, p)
                     self._params[decl.name] = p
 
@@ -174,19 +219,19 @@ class Params:
     # -- set/get -----------------------------------------------------------
     def set(self, param, value) -> "Params":  # noqa: A003
         p = self._resolveParam(param)
-        self._paramMap[p] = p.typeConverter(value)
+        self._paramMap[p] = p._convert(value)
         return self
 
     def _set(self, **kwargs) -> "Params":
         for name, value in kwargs.items():
             p = self.getParam(name)
-            self._paramMap[p] = p.typeConverter(value)
+            self._paramMap[p] = p._convert(value)
         return self
 
     def setDefault(self, **kwargs) -> "Params":
         for name, value in kwargs.items():
             p = self.getParam(name)
-            self._defaultParamMap[p] = p.typeConverter(value)
+            self._defaultParamMap[p] = p._convert(value)
         return self
 
     def isSet(self, param) -> bool:
@@ -351,7 +396,12 @@ class HasSeed(Params):
 
 
 class HasMaxIter(Params):
-    maxIter = ParamDecl("maxIter", "maximum number of iterations (>= 0)", TypeConverters.toInt)
+    maxIter = ParamDecl(
+        "maxIter",
+        "maximum number of iterations (>= 0)",
+        TypeConverters.toInt,
+        validator=ParamValidators.gtEq(0),
+    )
 
     def getMaxIter(self) -> int:
         return self.getOrDefault(self.maxIter)
@@ -361,7 +411,12 @@ class HasMaxIter(Params):
 
 
 class HasTol(Params):
-    tol = ParamDecl("tol", "convergence tolerance (>= 0)", TypeConverters.toFloat)
+    tol = ParamDecl(
+        "tol",
+        "convergence tolerance (>= 0)",
+        TypeConverters.toFloat,
+        validator=ParamValidators.gtEq(0),
+    )
 
     def getTol(self) -> float:
         return self.getOrDefault(self.tol)
@@ -371,7 +426,12 @@ class HasTol(Params):
 
 
 class HasRegParam(Params):
-    regParam = ParamDecl("regParam", "regularization parameter (>= 0)", TypeConverters.toFloat)
+    regParam = ParamDecl(
+        "regParam",
+        "regularization parameter (>= 0)",
+        TypeConverters.toFloat,
+        validator=ParamValidators.gtEq(0),
+    )
 
     def getRegParam(self) -> float:
         return self.getOrDefault(self.regParam)
@@ -385,6 +445,7 @@ class HasElasticNetParam(Params):
         "elasticNetParam",
         "ElasticNet mixing: 0 = L2 penalty, 1 = L1 penalty",
         TypeConverters.toFloat,
+        validator=ParamValidators.inRange(0.0, 1.0),
     )
 
     def getElasticNetParam(self) -> float:

@@ -46,6 +46,7 @@ from spark_rapids_ml_tpu.core.params import (
     HasTol,
     Model,
     ParamDecl,
+    ParamValidators,
     TypeConverters,
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
@@ -251,9 +252,17 @@ def fit_kmeans(
 
 
 class _KMeansParams(HasFeaturesCol, HasPredictionCol, HasMaxIter, HasTol, HasSeed):
-    k = ParamDecl("k", "number of clusters (> 0)", TypeConverters.toInt)
+    k = ParamDecl(
+        "k",
+        "number of clusters (> 0)",
+        TypeConverters.toInt,
+        validator=ParamValidators.gt(0),
+    )
     initMode = ParamDecl(
-        "initMode", "initialization: k-means++ | random", TypeConverters.toString
+        "initMode",
+        "initialization: k-means++ | random",
+        TypeConverters.toString,
+        validator=ParamValidators.inList(["k-means++", "random"]),
     )
 
     def __init__(self, uid=None):

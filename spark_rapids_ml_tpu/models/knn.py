@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.core.params import (
     HasSeed,
     Model,
     ParamDecl,
+    ParamValidators,
     TypeConverters,
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
@@ -94,7 +95,12 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
 
 
 class _NNParams(HasFeaturesCol, HasSeed):
-    k = ParamDecl("k", "number of neighbors to return", TypeConverters.toInt)
+    k = ParamDecl(
+        "k",
+        "number of neighbors to return (> 0)",
+        TypeConverters.toInt,
+        validator=ParamValidators.gt(0),
+    )
 
     def __init__(self, uid=None):
         super().__init__(uid=uid)
@@ -272,8 +278,18 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str):
 
 
 class _ANNParams(_NNParams):
-    nlist = ParamDecl("nlist", "number of IVF inverted lists", TypeConverters.toInt)
-    nprobe = ParamDecl("nprobe", "number of lists probed per query", TypeConverters.toInt)
+    nlist = ParamDecl(
+        "nlist",
+        "number of IVF inverted lists (> 0)",
+        TypeConverters.toInt,
+        validator=ParamValidators.gt(0),
+    )
+    nprobe = ParamDecl(
+        "nprobe",
+        "number of lists probed per query (> 0)",
+        TypeConverters.toInt,
+        validator=ParamValidators.gt(0),
+    )
 
     def __init__(self, uid=None):
         super().__init__(uid=uid)

@@ -331,6 +331,18 @@ class LinearRegression(Estimator, _LinearRegressionParams, MLWritable, MLReadabl
     def setRegParam(self, value: float) -> "LinearRegression":
         return self._set(regParam=value)
 
+    def setElasticNetParam(self, value: float) -> "LinearRegression":
+        return self._set(elasticNetParam=value)
+
+    def setFitIntercept(self, value: bool) -> "LinearRegression":
+        return self._set(fitIntercept=value)
+
+    def setMaxIter(self, value: int) -> "LinearRegression":
+        return self._set(maxIter=value)
+
+    def setTol(self, value: float) -> "LinearRegression":
+        return self._set(tol=value)
+
     def _copy_extra_state(self, source):
         self._mesh = getattr(source, "_mesh", None)
 

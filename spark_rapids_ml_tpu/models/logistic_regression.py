@@ -305,6 +305,15 @@ class LogisticRegression(Estimator, _LogisticRegressionParams, MLWritable, MLRea
     def setRegParam(self, value: float) -> "LogisticRegression":
         return self._set(regParam=value)
 
+    def setFitIntercept(self, value: bool) -> "LogisticRegression":
+        return self._set(fitIntercept=value)
+
+    def setMaxIter(self, value: int) -> "LogisticRegression":
+        return self._set(maxIter=value)
+
+    def setTol(self, value: float) -> "LogisticRegression":
+        return self._set(tol=value)
+
     def _copy_extra_state(self, source):
         self._mesh = getattr(source, "_mesh", None)
 

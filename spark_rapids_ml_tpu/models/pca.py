@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.core.params import (
     HasOutputCol,
     Model,
     ParamDecl,
+    ParamValidators,
     TypeConverters,
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
@@ -374,7 +375,12 @@ def finalize_pca_stats(
 class _PCAParams(HasInputCol, HasOutputCol):
     """Params shared by PCA and PCAModel (RapidsPCAParams, RapidsPCA.scala:34-46)."""
 
-    k = ParamDecl("k", "number of principal components (> 0)", TypeConverters.toInt)
+    k = ParamDecl(
+        "k",
+        "number of principal components (> 0)",
+        TypeConverters.toInt,
+        validator=ParamValidators.gt(0),
+    )
     meanCentering = ParamDecl(
         "meanCentering",
         "whether to center data before computing the covariance "

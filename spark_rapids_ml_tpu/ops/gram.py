@@ -37,15 +37,19 @@ def _dtypes():
     return jnp.dtype(config.get("compute_dtype")), jnp.dtype(config.get("accum_dtype"))
 
 
-def _pallas_gram_applicable(shape, cd, ad) -> bool:
-    """Pallas Gram path: TPU backend, f32 in/accum, tile-divisible shapes."""
-    if not config.get("use_pallas"):
+def _pallas_backend_ok(use_pallas: Optional[bool] = None) -> bool:
+    """Shared Pallas-gate preamble: flag on (None = read config) + TPU backend."""
+    if not (config.get("use_pallas") if use_pallas is None else use_pallas):
         return False
     try:
-        backend = jax.default_backend()
+        return jax.default_backend() != "cpu"
     except RuntimeError:  # pragma: no cover
         return False
-    if backend == "cpu":
+
+
+def _pallas_gram_applicable(shape, cd, ad, use_pallas: Optional[bool] = None) -> bool:
+    """Pallas Gram path: TPU backend, f32 in/accum, tile-divisible shapes."""
+    if not _pallas_backend_ok(use_pallas):
         return False
     n, d = shape
     return (
@@ -61,6 +65,7 @@ def local_stats(
     mask: Optional[jax.Array] = None,
     compute_dtype=None,
     accum_dtype=None,
+    use_pallas: Optional[bool] = None,
 ) -> Stats:
     """Single-block fused stats. x: (m, d); mask: (m,) of {0,1} or None.
 
@@ -80,7 +85,7 @@ def local_stats(
         xm = xc
         count = jnp.asarray(x.shape[0], dtype=ad)
     colsum = jnp.sum(xm.astype(ad), axis=0)
-    if mask is not None and _pallas_gram_applicable(x.shape, cd, ad):
+    if mask is not None and _pallas_gram_applicable(x.shape, cd, ad, use_pallas):
         from spark_rapids_ml_tpu.ops.pallas_kernels import gram_pallas
 
         gram = gram_pallas(xc, mask.astype(cd))
@@ -94,9 +99,13 @@ def local_stats(
     return count, colsum, gram
 
 
-def _stats_shard(x, mask, compute_dtype, accum_dtype):
+def _stats_shard(x, mask, compute_dtype, accum_dtype, use_pallas=None):
     count, colsum, gram = local_stats(
-        x, mask, compute_dtype=compute_dtype, accum_dtype=accum_dtype
+        x,
+        mask,
+        compute_dtype=compute_dtype,
+        accum_dtype=accum_dtype,
+        use_pallas=use_pallas,
     )
     count = jax.lax.psum(count, DATA_AXIS)
     colsum = jax.lax.psum(colsum, DATA_AXIS)
@@ -243,11 +252,12 @@ def streaming_update(mesh: Mesh, compute_dtype=None, accum_dtype=None):
 def _streaming_update_cached(mesh: Mesh, compute_dtype, accum_dtype, use_pallas: bool):
     # Cached per (mesh, dtypes, pallas flag): returning a fresh jitted
     # closure per call would force a full XLA recompile for every job in a
-    # long-lived daemon (jit caches are keyed on the function object).
-    del use_pallas  # cache key only
+    # long-lived daemon (jit caches are keyed on the function object). The
+    # snapshot is threaded to the trace-time gate so a config flip between
+    # builder call and first trace can't cache the wrong executable.
 
     def shard_update(count, colsum, gram, x, mask):
-        c, s, g = _stats_shard(x, mask, compute_dtype, accum_dtype)
+        c, s, g = _stats_shard(x, mask, compute_dtype, accum_dtype, use_pallas)
         return count + c, colsum + s, gram + g
 
     f = jax.shard_map(
@@ -260,6 +270,101 @@ def _streaming_update_cached(mesh: Mesh, compute_dtype, accum_dtype, use_pallas:
     @functools.partial(jax.jit, donate_argnums=(0,))
     def update(state, x, mask):
         return f(state[0], state[1], state[2], x, mask)
+
+    return update
+
+
+def _pallas_rows_applicable(shape, cd, use_pallas: Optional[bool] = None) -> bool:
+    """gram_colsum_pallas gate: TPU backend, lane-aligned d, block-divisible
+    rows, and a (d, d) f32 accumulator that fits the kernel's VMEM budget
+    (constants imported from the kernel so the two can't drift)."""
+    if not _pallas_backend_ok(use_pallas):
+        return False
+    from spark_rapids_ml_tpu.ops.pallas_kernels import (
+        GRAM_COLSUM_BLOCK_N,
+        GRAM_COLSUM_VMEM_BUDGET,
+    )
+
+    m, d = shape
+    return (
+        jnp.dtype(cd) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32))
+        and d % 128 == 0
+        and m % GRAM_COLSUM_BLOCK_N == 0
+        and d * d * 4 <= GRAM_COLSUM_VMEM_BUDGET
+    )
+
+
+def streaming_update_rows(mesh: Mesh, compute_dtype=None, accum_dtype=None):
+    """Jitted (state, x_batch, n_valid) -> state — the fast streaming path.
+
+    Like :func:`streaming_update` but the padding mask is a single scalar:
+    rows ≥ ``n_valid`` (a *global* row count over the whole batch, rows laid
+    out contiguously across the ``data`` axis) are ignored. x arrives already
+    in the compute dtype — the ingest stage casts once at host→device
+    placement (halving transfer bytes for bfloat16) so the hot loop never
+    touches float32 row data. On TPU with ``use_pallas`` the per-shard stats
+    use the single-HBM-pass fused kernel
+    (:func:`~spark_rapids_ml_tpu.ops.pallas_kernels.gram_colsum_pallas`);
+    elsewhere an iota-derived mask reuses the XLA path.
+    """
+    dcd, dad = _dtypes()
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else dcd
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else dad
+    return _streaming_update_rows_cached(
+        mesh, cd.name, ad.name, bool(config.get("use_pallas"))
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _streaming_update_rows_cached(
+    mesh: Mesh, compute_dtype, accum_dtype, use_pallas: bool
+):
+    # use_pallas is the snapshot taken when the builder was called — the gate
+    # must use it (not re-read config at trace time) or a config flip between
+    # builder call and first trace would cache the wrong executable forever.
+    cd = jnp.dtype(compute_dtype)
+    ad = jnp.dtype(accum_dtype)
+
+    def shard_update(count, colsum, gram, x, n_valid):
+        m = x.shape[0]
+        offset = jax.lax.axis_index(DATA_AXIS).astype(jnp.int32) * m
+        nv_local = jnp.clip(n_valid.astype(jnp.int32) - offset, 0, m)
+        xc = x.astype(cd)
+        if _pallas_rows_applicable(x.shape, cd, use_pallas):
+            from spark_rapids_ml_tpu.ops.pallas_kernels import gram_colsum_pallas
+
+            g, cs = gram_colsum_pallas(xc, nv_local)
+            g = g.astype(ad)
+            cs = cs.astype(ad)
+        else:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (m,), 0)
+            mask = (rows < nv_local).astype(cd)
+            _, cs, g = local_stats(
+                xc,
+                mask,
+                compute_dtype=compute_dtype,
+                accum_dtype=accum_dtype,
+                use_pallas=use_pallas,
+            )
+        c = jax.lax.psum(nv_local.astype(ad), DATA_AXIS)
+        cs = jax.lax.psum(cs, DATA_AXIS)
+        g = jax.lax.psum(g, DATA_AXIS)
+        return count + c, colsum + cs, gram + g
+
+    f = jax.shard_map(
+        shard_update,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(DATA_AXIS, None), P()),
+        out_specs=(P(), P(), P()),
+        # pallas_call outputs carry no VMA annotation; the post-psum values
+        # are replicated, which VMA inference can't prove (same as the 2-D
+        # variant above).
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, x, n_valid):
+        return f(state[0], state[1], state[2], x, jnp.asarray(n_valid, jnp.int32))
 
     return update
 

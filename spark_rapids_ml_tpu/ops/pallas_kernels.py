@@ -28,6 +28,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -81,13 +82,105 @@ def gram_pallas(
         ],
         out_specs=pl.BlockSpec((bd, bd), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((d, d), jnp.float32),
-        compiler_params=dict(
-            mosaic=dict(dimension_semantics=("parallel", "parallel", "arbitrary"))
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
         )
         if not interpret
         else None,
         interpret=interpret,
     )(x, x, mask)  # x twice: row-tile (kk, i) and (kk, j) views of the same array
+
+
+# ---------------------------------------------------------------------------
+# Single-pass fused Gram + column-sum with a VMEM-resident accumulator
+# ---------------------------------------------------------------------------
+
+
+# Defaults shared with the streaming-path applicability gate (ops/gram.py).
+GRAM_COLSUM_BLOCK_N = 512
+GRAM_COLSUM_VMEM_BUDGET = 64 * 2**20  # max (d, d) f32 resident accumulator
+
+
+def _gram_colsum_kernel(nvalid_ref, x_ref, g_ref, cs_ref, *, block_n):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        g_ref[:] = jnp.zeros_like(g_ref)
+        cs_ref[:] = jnp.zeros_like(cs_ref)
+
+    row0 = pl.program_id(0) * block_n
+    nv = nvalid_ref[0]
+
+    # Blocks entirely past n_valid contribute nothing — skip their GEMM
+    # (power-of-two bucketing can make half the blocks pure padding).
+    @pl.when(row0 < nv)
+    def _accumulate():
+        # Only the one block straddling the n_valid boundary pays the mask;
+        # full blocks skip the iota/select VPU pass entirely.
+        @pl.when(row0 + block_n > nv)
+        def _mask_boundary():
+            rows = jax.lax.broadcasted_iota(jnp.int32, x_ref.shape, 0) + row0
+            x_ref[:] = jnp.where(rows < nv, x_ref[:], jnp.zeros_like(x_ref))
+
+        xb = x_ref[:]
+        g_ref[:] += jax.lax.dot_general(
+            xb, xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        cs_ref[:] += jnp.sum(xb.astype(jnp.float32), axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def gram_colsum_pallas(
+    x: jax.Array,
+    n_valid: jax.Array,
+    block_n: int = GRAM_COLSUM_BLOCK_N,
+    interpret: bool = False,
+):
+    """One-HBM-pass fused XᵀX + column sum of the first ``n_valid`` rows.
+
+    x: (n, d) in the compute dtype (bfloat16 engages the MXU at full rate;
+    the GEMM accumulates in float32 either way). Rows ≥ n_valid are treated
+    as absent — this replaces the (n,) mask array of ``gram_pallas`` with a
+    scalar, so no mask ever touches HBM and only the boundary block pays
+    any select cost. The (d, d) accumulator lives in VMEM across the whole
+    row-grid (grid is 1-D over row blocks), so X is read exactly once —
+    the streaming equivalent of the reference's dgemmCov hot loop
+    (rapidsml_jni.cu:109-127) with its mean-stats pass fused in.
+
+    Returns (gram (d, d) float32, colsum (d,) float32).
+    """
+    n, d = x.shape
+    bn = min(block_n, n)
+    if n % bn:
+        raise ValueError(f"n={n} not divisible by block_n={bn}")
+    if d * d * 4 > GRAM_COLSUM_VMEM_BUDGET:
+        raise ValueError(f"d={d}: (d, d) f32 accumulator exceeds the VMEM budget")
+    nv = jnp.asarray(n_valid, jnp.int32).reshape((1,))
+    gram, colsum = pl.pallas_call(
+        functools.partial(_gram_colsum_kernel, block_n=bn),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n // bn,),
+            in_specs=[pl.BlockSpec((bn, d), lambda i, nv: (i, 0))],
+            out_specs=[
+                pl.BlockSpec((d, d), lambda i, nv: (0, 0)),
+                pl.BlockSpec((1, d), lambda i, nv: (0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            # (d, d) f32 accumulator + double-buffered input blocks; the
+            # default 16M scoped limit rejects d ≥ 1448.
+            vmem_limit_bytes=100 * 2**20,
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(nv, x)
+    return gram, colsum[0]
 
 
 # ---------------------------------------------------------------------------
@@ -156,8 +249,8 @@ def assign_min_dist_pallas(
             jax.ShapeDtypeStruct((m,), jnp.float32),
             jax.ShapeDtypeStruct((m,), jnp.int32),
         ],
-        compiler_params=dict(
-            mosaic=dict(dimension_semantics=("parallel", "arbitrary"))
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
         )
         if not interpret
         else None,

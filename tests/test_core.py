@@ -255,3 +255,35 @@ def test_trace_span_with_tracing_enabled():
         with trace_span("annotated span") as t:
             pass
     assert t.elapsed is not None
+
+
+# ---------------------------------------------------------------------------
+# Param validators (Spark ParamValidators parity — k uses gt(0) via Spark's
+# PCAParams in the reference, RapidsPCA.scala:34)
+# ---------------------------------------------------------------------------
+
+
+def test_param_validators_reject_invalid():
+    import spark_rapids_ml_tpu as srml
+
+    with pytest.raises(ValueError, match="parameter k given invalid value 0"):
+        srml.PCA().setK(0)
+    with pytest.raises(ValueError, match="invalid value -1"):
+        srml.KMeans().setK(-1)
+    with pytest.raises(ValueError, match="initMode"):
+        srml.KMeans().setInitMode("bogus")
+    with pytest.raises(ValueError, match="regParam"):
+        srml.LinearRegression().setRegParam(-0.5)
+    with pytest.raises(ValueError, match="elasticNetParam"):
+        srml.LinearRegression().setElasticNetParam(1.5)
+    with pytest.raises(ValueError, match="maxIter"):
+        srml.LogisticRegression().setMaxIter(-1)
+
+
+def test_param_validators_accept_valid():
+    import spark_rapids_ml_tpu as srml
+
+    est = srml.PCA().setK(3)
+    assert est.getK() == 3
+    km = srml.KMeans().setK(2).setInitMode("random")
+    assert km.getK() == 2

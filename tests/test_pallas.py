@@ -23,6 +23,55 @@ def test_gram_block_validation(rng):
         gram_pallas(x, np.ones(100, np.float32), block_n=64, block_d=64, interpret=True)
 
 
+def test_gram_colsum_parity(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import gram_colsum_pallas
+
+    n, d = 1024, 256
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    for n_valid in (n, 700):  # full batch + boundary-straddling partial block
+        g, cs = gram_colsum_pallas(x, n_valid, block_n=256, interpret=True)
+        xv = x[:n_valid]
+        np.testing.assert_allclose(np.asarray(g), xv.T @ xv, rtol=1e-5, atol=1e-2)
+        np.testing.assert_allclose(
+            np.asarray(cs), xv.sum(axis=0), rtol=1e-5, atol=1e-2
+        )
+
+
+def test_gram_colsum_block_validation(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import gram_colsum_pallas
+
+    x = rng.normal(size=(100, 128)).astype(np.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        gram_colsum_pallas(x, 100, block_n=64, interpret=True)
+
+
+def test_streaming_update_rows_matches_mask_path(rng):
+    """streaming_update_rows (scalar n_valid) == streaming_update (mask array)
+    on a multi-device CPU mesh, including a partial boundary batch."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import gram as gram_ops
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(model=1)
+    n_dev = mesh.shape["data"]
+    m, d = 16 * n_dev, 32
+    x = rng.normal(size=(m, d)).astype(np.float32)
+    n_valid = m - 5  # straddles the last shard
+
+    upd_rows = gram_ops.streaming_update_rows(mesh)
+    upd_mask = gram_ops.streaming_update(mesh)
+    mask = (np.arange(m) < n_valid).astype(np.float32)
+
+    s_rows = gram_ops.init_stats(d)
+    s_mask = gram_ops.init_stats(d)
+    for _ in range(3):
+        s_rows = upd_rows(s_rows, jnp.asarray(x), n_valid)
+        s_mask = upd_mask(s_mask, jnp.asarray(x), jnp.asarray(mask))
+    for a, b in zip(s_rows, s_mask):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-4)
+
+
 def test_assign_parity(rng):
     m, d, k = 512, 32, 128
     x = rng.normal(size=(m, d)).astype(np.float32)
