@@ -42,8 +42,11 @@ A100_CUML_ROWS_PER_SEC = 13.1e6  # GEMM-bound estimate, see module docstring
 # recorded benchmark always runs the defaults (the north-star shape).
 D = int(os.environ.get("SRML_BENCH_D", 2048))
 K = int(os.environ.get("SRML_BENCH_K", 32))
-BATCH_ROWS = int(os.environ.get("SRML_BENCH_BATCH_ROWS", 1 << 18))  # 2.1 GB f32
-N_BATCHES = int(os.environ.get("SRML_BENCH_BATCHES", 32))  # 8.4M rows / fit
+BATCH_ROWS = int(os.environ.get("SRML_BENCH_BATCH_ROWS", 1 << 18))  # 1.1 GB bf16
+# 384 × 262144 = 100.7M rows — the north-star fit size (BASELINE.json
+# config #2), which also amortizes the tunnel's fixed ~90 ms sync round-trip
+# into the noise.
+N_BATCHES = int(os.environ.get("SRML_BENCH_BATCHES", 384))
 
 
 def main() -> None:

@@ -44,3 +44,47 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
             }
         )
     )
+
+
+def slope_dt(run, n1: int, n2: int, warm: bool = True) -> float:
+    """Seconds per work-unit via a two-point fit: time run(n1) and run(n2),
+    return (t2-t1)/(n2-n1).
+
+    Removes fixed per-measurement overhead from the reported rate — on the
+    dev tunnel a single host↔device sync round-trip costs ~90 ms, which
+    would otherwise swamp any single-call measurement. ``run(n)`` must
+    execute n units and block until the device is done. Each size is timed
+    twice and the min taken, so a single noisy sample can't invert the
+    slope; pass warm=False when the caller has already compiled/warmed both
+    sizes (e.g. repeated sampling in a loop).
+    """
+    import time
+
+    if warm:
+        run(n1)  # warm / compile both sizes
+        run(n2)
+
+    def timed(n):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run(n)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = timed(n1), timed(n2)
+    if t2 <= t1:  # still inverted after min-of-2: fall back to the average
+        return t2 / n2
+    return (t2 - t1) / (n2 - n1)
+
+
+def sync(x) -> None:
+    """Block until device work producing x is done.
+
+    ``jax.block_until_ready`` does not reliably wait on the dev tunnel's
+    remote platform; fetching one element does.
+    """
+    import jax
+
+    leaf = jax.tree.leaves(x)[-1]
+    jax.device_get(leaf[(0,) * getattr(leaf, "ndim", 0)])

@@ -12,7 +12,6 @@ the north-star "within 2×".
 
 import os
 import sys
-import time
 
 if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -52,19 +51,31 @@ def main() -> None:
     mask = jnp.ones((ROWS,), dtype=jnp.float32)
     centers0 = jax.random.normal(jax.random.key(1), (K, D), dtype=jnp.float32)
 
-    # tol=0 → exactly ITERS iterations: a throughput measurement, not a
-    # convergence race.
-    fn = _lloyd_fn(mesh, K, ITERS, 0.0, "bfloat16", "float32")
-    jax.block_until_ready(fn(x, mask, centers0))  # compile + warm
-    t0 = time.perf_counter()
-    centers, cost, n_iter = jax.block_until_ready(fn(x, mask, centers0))
-    dt = time.perf_counter() - t0
-    assert int(n_iter) == ITERS
+    # tol=0 → exactly n iterations: a throughput measurement, not a
+    # convergence race. Two iteration counts + slope_dt cancel the fixed
+    # sync/dispatch overhead out of the reported rate.
+    from benchmarks import slope_dt, sync
+
+    config.set("use_pallas", True)
+    fns = {
+        n: _lloyd_fn(
+            mesh, K, n, 0.0, "bfloat16", "float32", use_pallas=True
+        )
+        for n in (ITERS, 2 * ITERS)
+    }
+
+    def run(n):
+        centers, cost, n_iter = fns[n](x, mask, centers0)
+        sync(centers)
+        assert int(n_iter) == n
+        return centers
+
+    dt_per_iter = slope_dt(run, ITERS, 2 * ITERS)
     emit(
         f"kmeans_row_iters_per_sec_per_chip_d{D}_k{K}",
-        ROWS * ITERS / dt / n_chips,
+        ROWS / dt_per_iter / n_chips,
         "row_iters/s/chip",
-        (ROWS * ITERS / dt / n_chips) / A100_ROW_ITERS_PER_SEC,
+        (ROWS / dt_per_iter / n_chips) / A100_ROW_ITERS_PER_SEC,
     )
 
 

@@ -14,7 +14,6 @@ ballpark, the reference repo itself publishes nothing, BASELINE.md).
 
 import os
 import sys
-import time
 
 if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -44,6 +43,7 @@ def main() -> None:
 
     config.set("compute_dtype", "bfloat16")
     config.set("accum_dtype", "float32")
+    config.set("use_pallas", True)  # fused Lloyd step for the coarse quantizer
 
     n_chips = len(jax.devices())
     rng = np.random.default_rng(0)
@@ -57,14 +57,19 @@ def main() -> None:
         jnp.asarray(index.list_ids),
         jnp.asarray(index.list_mask),
     ]
+    from benchmarks import slope_dt, sync
+
     query = _ivf_query_fn(K, NPROBE, "bfloat16", "float32")
-    jax.block_until_ready(query(*dev, queries))  # compile + warm
-    reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        dists, ids = jax.block_until_ready(query(*dev, queries))
-    dt = (time.perf_counter() - t0) / reps
-    assert np.all(np.asarray(ids) >= 0)
+
+    def run(n):
+        ids = None
+        for _ in range(n):
+            dists, ids = query(*dev, queries)
+        sync(ids)  # one sync; calls queue on device
+        assert np.all(np.asarray(ids) >= 0)
+        return ids
+
+    dt = slope_dt(run, 4, 8)
     emit(
         f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}_k{K}_nprobe{NPROBE}",
         N_QUERY / dt / n_chips,

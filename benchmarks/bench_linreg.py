@@ -13,7 +13,6 @@ Baseline: Gram is 2·d² flops/row; A100 at ~110 TFLOP/s → 110e12/(2·1024²)
 
 import os
 import sys
-import time
 
 if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -53,13 +52,19 @@ def main() -> None:
         y = jax.device_put(y, NamedSharding(mesh, P("data")))
     mask = jnp.ones((ROWS,), dtype=jnp.float32)
 
+    from benchmarks import slope_dt, sync
+
     stats = _normal_eq_stats_fn(mesh, "bfloat16", "float32")
-    jax.block_until_ready(stats(x, y, mask))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = jax.block_until_ready(stats(x, y, mask))
-    dt = (time.perf_counter() - t0) / REPS
-    assert np.isfinite(float(out[5]))
+
+    def run(n):
+        out = None
+        for _ in range(n):
+            out = stats(x, y, mask)
+        sync(out)  # one sync; calls queue on device
+        assert np.isfinite(float(out[5]))
+        return out
+
+    dt = slope_dt(run, REPS, 2 * REPS)
     emit(
         f"linreg_normal_eq_rows_per_sec_per_chip_d{D}",
         ROWS / dt / n_chips,

@@ -12,7 +12,6 @@ vs_baseline >= 0.5 matches the north-star "within 2×".
 
 import os
 import sys
-import time
 
 if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -54,19 +53,27 @@ def main() -> None:
         y = jax.device_put(y, NamedSharding(mesh, P("data")))
     mask = jnp.ones((ROWS,), dtype=jnp.float32)
 
-    # tol=0 → exactly ITERS Newton steps: throughput, not convergence.
-    fn = _newton_fn(mesh, 1e-4, True, ITERS, 0.0, "float32")
-    jax.block_until_ready(fn(x, y, mask))  # compile + warm
-    t0 = time.perf_counter()
-    w, b, n_iter, loss = jax.block_until_ready(fn(x, y, mask))
-    dt = time.perf_counter() - t0
-    iters_run = int(n_iter)
-    assert iters_run >= 1 and np.isfinite(float(loss))
+    # tol=0 → exactly n Newton steps: throughput, not convergence. Two
+    # iteration counts + slope_dt cancel the fixed sync overhead.
+    from benchmarks import slope_dt, sync
+
+    fns = {
+        n: _newton_fn(mesh, 1e-4, True, n, 0.0, "float32")
+        for n in (ITERS, 2 * ITERS)
+    }
+
+    def run(n):
+        w, b, n_iter, loss = fns[n](x, y, mask)
+        sync(w)
+        assert int(n_iter) == n and np.isfinite(float(loss))
+        return w
+
+    dt_per_iter = slope_dt(run, ITERS, 2 * ITERS)
     emit(
         f"logreg_newton_row_iters_per_sec_per_chip_d{D}",
-        ROWS * iters_run / dt / n_chips,
+        ROWS / dt_per_iter / n_chips,
         "row_iters/s/chip",
-        (ROWS * iters_run / dt / n_chips) / A100_ROW_ITERS_PER_SEC,
+        (ROWS / dt_per_iter / n_chips) / A100_ROW_ITERS_PER_SEC,
     )
 
 

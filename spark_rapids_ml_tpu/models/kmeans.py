@@ -106,15 +106,12 @@ def _random_init(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _pallas_assign_applicable(m_local: int, k: int, d: int, cd) -> bool:
+def _pallas_assign_applicable(m_local: int, k: int, d: int, cd, use_pallas=None) -> bool:
     """Fused Pallas assignment path: TPU backend, f32, tile-divisible, and a
     feature width whose (block_m, d) tile fits VMEM."""
-    if not config.get("use_pallas"):
-        return False
-    try:
-        if jax.default_backend() == "cpu":
-            return False
-    except RuntimeError:  # pragma: no cover
+    from spark_rapids_ml_tpu.ops.gram import _pallas_backend_ok
+
+    if not _pallas_backend_ok(use_pallas):
         return False
     bm = min(1024, m_local)
     bk = min(128, k)
@@ -126,22 +123,109 @@ def _pallas_assign_applicable(m_local: int, k: int, d: int, cd) -> bool:
     )
 
 
+def _lloyd_block_n(m_local: int, d: int, k_pad: int, itemsize: int) -> int:
+    """Largest row-block whose full kernel working set fits a conservative
+    VMEM budget: double-buffered x tile + d2/onehot intermediates + the
+    resident sums accumulator and centers block."""
+    from spark_rapids_ml_tpu.ops.pallas_kernels import LLOYD_STEP_BLOCK_N
+
+    for b in (LLOYD_STEP_BLOCK_N, 2048, 1024, 512, 256, 128):
+        if m_local % b:
+            continue
+        vmem = (
+            2 * b * d * itemsize  # double-buffered x tile
+            + 2 * b * k_pad * 4  # d2 + onehot f32 intermediates
+            + k_pad * d * (4 + itemsize)  # sums accumulator + centers
+        )
+        if vmem <= 64 * 2**20:
+            return b
+    return 0
+
+
+def _pallas_step_applicable(m_local: int, k: int, d: int, cd, use_pallas=None) -> bool:
+    """Fused single-HBM-pass Lloyd step (ops/pallas_kernels.lloyd_step_pallas):
+    TPU backend, bf16/f32 compute, lane-aligned d, block-divisible rows, and
+    a full working set that fits VMEM (per _lloyd_block_n)."""
+    from spark_rapids_ml_tpu.ops.gram import _pallas_backend_ok
+
+    if not _pallas_backend_ok(use_pallas):
+        return False
+    from spark_rapids_ml_tpu.ops.pallas_kernels import _ceil_to
+
+    k_pad = _ceil_to(k, 128)
+    cd = jnp.dtype(cd)
+    return (
+        cd in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32))
+        and d % 128 == 0
+        and d <= 2048
+        and k_pad <= 1024
+        and _lloyd_block_n(m_local, d, k_pad, cd.itemsize) > 0
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def _lloyd_fn(
     mesh: Mesh, k: int, max_iter: int, tol: float, cd: str, ad: str, use_pallas: bool = False
 ):
-    # `use_pallas` keys the cache; the trace below re-reads config.
+    # `use_pallas` is the builder-time snapshot, threaded to the trace-time
+    # gates (never re-read config inside the trace — lru_cache key must
+    # match what actually compiled).
     compute_dtype = jnp.dtype(cd)
     accum_dtype = jnp.dtype(ad)
+    from spark_rapids_ml_tpu.ops.pallas_kernels import _ceil_to
+
+    k_pad = _ceil_to(k, 128)
 
     def lloyd_shard(x, mask, centers0):
         xc = x.astype(compute_dtype)
         maskc = mask.astype(accum_dtype)
         pallas_assign = _pallas_assign_applicable(
-            x.shape[0], k, x.shape[1], compute_dtype
+            x.shape[0], k, x.shape[1], compute_dtype, use_pallas
         )
+        pallas_step = _pallas_step_applicable(
+            x.shape[0], k, x.shape[1], compute_dtype, use_pallas
+        )
+        # Valid rows are a contiguous prefix of each shard (shard_rows pads
+        # at the global tail), so the mask collapses to one row count.
+        # Integer sum: an f32 sum of ones saturates at 2^24 rows/shard.
+        nv_local = jnp.sum(mask.astype(jnp.int32))
 
-        def assign_and_update(centers):
+        def shard_stats(centers):
+            """Per-shard (sums (k, d), counts (k,)) for one Lloyd update."""
+            if pallas_step:
+                from spark_rapids_ml_tpu.ops.pallas_kernels import lloyd_step_pallas
+
+                cpad = jnp.zeros((k_pad, x.shape[1]), compute_dtype)
+                cpad = jax.lax.dynamic_update_slice(
+                    cpad, centers.astype(compute_dtype), (0, 0)
+                )
+                sums, counts = lloyd_step_pallas(
+                    xc,
+                    cpad,
+                    nv_local,
+                    k=k,
+                    block_n=_lloyd_block_n(
+                        x.shape[0], x.shape[1], k_pad, compute_dtype.itemsize
+                    ),
+                )
+                return sums[:k].astype(accum_dtype), counts[:k].astype(accum_dtype)
+            assign, _ = _assign_min(centers)
+            onehot = (
+                jax.nn.one_hot(assign, k, dtype=compute_dtype)
+                * maskc[:, None].astype(compute_dtype)
+            )
+            # (k, d) sums and (k,) counts — both MXU/VPU friendly.
+            from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+            with mm_precision(compute_dtype):
+                sums = jax.lax.dot_general(
+                    onehot, xc, (((0,), (0,)), ((), ())),
+                    preferred_element_type=accum_dtype,
+                )
+            counts = jnp.sum(onehot.astype(accum_dtype), axis=0)
+            return sums, counts
+
+        def _assign_min(centers):
             if pallas_assign:
                 from spark_rapids_ml_tpu.ops.pallas_kernels import (
                     assign_min_dist_pallas,
@@ -158,39 +242,33 @@ def _lloyd_fn(
                 )
                 assign = jnp.argmin(d2, axis=1)
                 min_d2 = jnp.min(d2, axis=1)
-            onehot = (
-                jax.nn.one_hot(assign, k, dtype=compute_dtype)
-                * maskc[:, None].astype(compute_dtype)
-            )
-            # (k, d) sums and (k,) counts — both MXU/VPU friendly.
-            sums = jax.lax.dot_general(
-                onehot, xc, (((0,), (0,)), ((), ())),
-                preferred_element_type=accum_dtype,
-            )
-            counts = jnp.sum(onehot.astype(accum_dtype), axis=0)
+            return assign, min_d2
+
+        def update(centers):
+            sums, counts = shard_stats(centers)
             sums = jax.lax.psum(sums, DATA_AXIS)
             counts = jax.lax.psum(counts, DATA_AXIS)
-            cost = jax.lax.psum(jnp.sum(min_d2 * maskc), DATA_AXIS)
-            new_centers = jnp.where(
+            return jnp.where(
                 (counts > 0)[:, None], sums / jnp.maximum(counts, 1)[:, None], centers
             )
-            return new_centers, cost
 
         def cond(carry):
-            _, _, moved2, it = carry
+            _, moved2, it = carry
             return jnp.logical_and(it < max_iter, moved2 > tol * tol)
 
         def body(carry):
-            centers, _, _, it = carry
-            new_centers, cost = assign_and_update(centers)
+            centers, _, it = carry
+            new_centers = update(centers)
             moved2 = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
-            return new_centers, cost, moved2, it + 1
+            return new_centers, moved2, it + 1
 
         centers0 = centers0.astype(accum_dtype)
-        init = (centers0, jnp.array(jnp.inf, accum_dtype), jnp.array(jnp.inf, accum_dtype), 0)
-        centers, cost, _, n_iter = jax.lax.while_loop(cond, body, init)
-        # Final cost at the converged centers.
-        _, final_cost = assign_and_update(centers)
+        init = (centers0, jnp.array(jnp.inf, accum_dtype), 0)
+        centers, _, n_iter = jax.lax.while_loop(cond, body, init)
+        # Final training cost at the converged centers (one assignment pass;
+        # the in-loop fused kernel doesn't materialize distances at all).
+        _, min_d2 = _assign_min(centers)
+        final_cost = jax.lax.psum(jnp.sum(min_d2 * maskc), DATA_AXIS)
         return centers, final_cost, n_iter
 
     f = jax.shard_map(
@@ -198,6 +276,8 @@ def _lloyd_fn(
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
         out_specs=(P(), P(), P()),
+        # pallas_call outputs carry no VMA annotation (same as ops/gram.py).
+        check_vma=False,
     )
     return jax.jit(f)
 

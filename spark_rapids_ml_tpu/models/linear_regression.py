@@ -77,19 +77,23 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str):
     accum_dtype = jnp.dtype(ad)
 
     def shard(x, y, mask):
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
         xc = x.astype(compute_dtype) * mask.astype(compute_dtype)[:, None]
         yc = y.astype(accum_dtype) * mask.astype(accum_dtype)
-        xtx = jax.lax.dot_general(
-            xc, xc, (((0,), (0,)), ((), ())), preferred_element_type=accum_dtype
-        )
-        xty = jax.lax.dot_general(
-            xc, yc[:, None].astype(compute_dtype), (((0,), (0,)), ((), ())),
-            preferred_element_type=accum_dtype,
-        )[:, 0]
+        with mm_precision(compute_dtype):
+            xtx = jax.lax.dot_general(
+                xc, xc, (((0,), (0,)), ((), ())), preferred_element_type=accum_dtype
+            )
+            xty = jax.lax.dot_general(
+                xc, yc[:, None].astype(compute_dtype), (((0,), (0,)), ((), ())),
+                preferred_element_type=accum_dtype,
+            )[:, 0]
         sx = jnp.sum(xc.astype(accum_dtype), axis=0)
         sy = jnp.sum(yc)
         syy = jnp.sum(yc * yc)
-        n = jnp.sum(mask.astype(accum_dtype))
+        # Integer sum: an f32 sum of ones saturates at 2^24 rows.
+        n = jnp.sum(mask.astype(jnp.int32)).astype(accum_dtype)
         return tuple(
             jax.lax.psum(v, DATA_AXIS) for v in (xtx, xty, sx, sy, syy, n)
         )
@@ -149,6 +153,13 @@ def _fista(a: jax.Array, b: jax.Array, l1: float, iters: int, tol: float) -> jax
     Stops early when the iterate movement ‖w_{t+1} − w_t‖ drops below tol
     (the estimator's ``tol`` param), else after ``iters`` steps.
     """
+    from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+    with mm_precision(a.dtype):  # trace-time scope over the whole solver
+        return _fista_body(a, b, l1, iters, tol)
+
+
+def _fista_body(a, b, l1, iters, tol):
     d = a.shape[0]
 
     # Lipschitz constant: largest eigenvalue of A by power iteration.

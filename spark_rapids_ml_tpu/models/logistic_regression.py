@@ -68,10 +68,17 @@ def _newton_fn(mesh: Mesh, reg: float, fit_intercept: bool, max_iter: int, tol: 
     accum = jnp.dtype(ad)
 
     def shard(x, y, mask):
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        with mm_precision(accum):  # true-f32 dots (TPU default is bf16)
+            return _shard(x, y, mask)
+
+    def _shard(x, y, mask):
         xc = x.astype(accum)
         yc = y.astype(accum)
         maskc = mask.astype(accum)
-        n = jax.lax.psum(jnp.sum(maskc), DATA_AXIS)
+        # Integer sum: an f32 sum of ones saturates at 2^24 rows/shard.
+        n = jax.lax.psum(jnp.sum(maskc.astype(jnp.int32)).astype(accum), DATA_AXIS)
         d = x.shape[1]
 
         def grad_hess(w, b):
@@ -146,10 +153,17 @@ def _softmax_gd_fn(
     c = n_classes
 
     def shard(x, y_onehot, mask):
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        with mm_precision(accum):  # true-f32 dots (TPU default is bf16)
+            return _shard(x, y_onehot, mask)
+
+    def _shard(x, y_onehot, mask):
         xc = x.astype(accum)
         yc = y_onehot.astype(accum)
         maskc = mask.astype(accum)
-        n = jax.lax.psum(jnp.sum(maskc), DATA_AXIS)
+        # Integer sum: an f32 sum of ones saturates at 2^24 rows/shard.
+        n = jax.lax.psum(jnp.sum(maskc.astype(jnp.int32)).astype(accum), DATA_AXIS)
         d = x.shape[1]
 
         def grads(w, b):

@@ -521,14 +521,17 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
             pc_dev = jnp.asarray(self.pc, dtype=jnp.dtype(key[0]))
             accum = jnp.dtype(key[1])
 
+            from spark_rapids_ml_tpu.ops.gram import mm_precision
+
             @jax.jit
             def project(x):
-                return jax.lax.dot_general(
-                    x.astype(pc_dev.dtype),
-                    pc_dev,
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=accum,
-                )
+                with mm_precision(pc_dev.dtype):
+                    return jax.lax.dot_general(
+                        x.astype(pc_dev.dtype),
+                        pc_dev,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=accum,
+                    )
 
             self._project_cache[key] = project
         return self._project_cache[key]

@@ -20,6 +20,7 @@ from spark_rapids_ml_tpu.ops.gram import (
     sharded_stats,
     sharded_stats_2d,
     finalize_gram,
+    mm_precision,
 )
 from spark_rapids_ml_tpu.ops.eigh import (
     eigh_descending,
@@ -36,6 +37,7 @@ __all__ = [
     "sharded_stats",
     "sharded_stats_2d",
     "finalize_gram",
+    "mm_precision",
     "eigh_descending",
     "sign_flip",
     "explained_variance_reference",

@@ -21,11 +21,14 @@ def sq_euclidean(
     accum_dtype=jnp.float32,
 ) -> jax.Array:
     """(m, d) × (k, d) → (m, k) squared distances, clipped at 0."""
+    from spark_rapids_ml_tpu.ops.gram import mm_precision
+
     xc = x.astype(compute_dtype) if compute_dtype is not None else x
     yc = y.astype(compute_dtype) if compute_dtype is not None else y
-    xy = jax.lax.dot_general(
-        xc, yc, (((1,), (1,)), ((), ())), preferred_element_type=accum_dtype
-    )
+    with mm_precision(xc.dtype):
+        xy = jax.lax.dot_general(
+            xc, yc, (((1,), (1,)), ((), ())), preferred_element_type=accum_dtype
+        )
     x2 = jnp.sum(jnp.square(x.astype(accum_dtype)), axis=1)
     y2 = jnp.sum(jnp.square(y.astype(accum_dtype)), axis=1)
     d = x2[:, None] + y2[None, :] - 2.0 * xy

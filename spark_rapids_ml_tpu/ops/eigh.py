@@ -98,22 +98,26 @@ def topk_eig_subspace(
     ``(ritz_vals (m,) descending, vectors (d, m))`` with m = k+oversample
     clamped to d.
     """
+    from spark_rapids_ml_tpu.ops.gram import mm_precision
+
     d = gram.shape[0]
     m = min(k + oversample, d)
     v0 = jax.random.normal(jax.random.key(seed), (d, m), dtype=gram.dtype)
 
-    def body(_, v):
-        w = gram @ v
-        q, _ = jnp.linalg.qr(w)
-        return q
+    with mm_precision(gram.dtype):
 
-    v = jax.lax.fori_loop(0, iters, body, jnp.linalg.qr(v0)[0])
-    gv = gram @ v
-    b = v.T @ gv
-    b = 0.5 * (b + b.T)
-    wb, qb = jnp.linalg.eigh(b)  # m×m — tiny
-    wb, qb = wb[::-1], qb[:, ::-1]
-    return wb, v @ qb
+        def body(_, v):
+            w = gram @ v
+            q, _ = jnp.linalg.qr(w)
+            return q
+
+        v = jax.lax.fori_loop(0, iters, body, jnp.linalg.qr(v0)[0])
+        gv = gram @ v
+        b = v.T @ gv
+        b = 0.5 * (b + b.T)
+        wb, qb = jnp.linalg.eigh(b)  # m×m — tiny
+        wb, qb = wb[::-1], qb[:, ::-1]
+        return wb, v @ qb
 
 
 def pca_from_gram_randomized(

@@ -20,6 +20,7 @@ Padded rows are masked out, so stats are exact for any row count.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional, Tuple
 
@@ -31,6 +32,25 @@ from spark_rapids_ml_tpu import config
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 Stats = Tuple[jax.Array, jax.Array, jax.Array]  # (count, colsum, gram)
+
+
+def mm_precision(*dtypes):
+    """Trace-time context: full-precision matmuls when any operand dtype is
+    float32/float64.
+
+    TPU's DEFAULT dot precision computes f32 contractions with single-pass
+    bf16 mantissas, silently giving "float32 compute" only bf16 accuracy —
+    for PCA that surfaces as eigenvector error ~ rounding/eigengap, percent
+    level on close spectra. bfloat16 compute paths are unaffected by this
+    context (there is no decomposition to control), so it costs nothing on
+    the speed-oriented paths.
+    """
+    if any(
+        d is not None and jnp.dtype(d) in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64))
+        for d in dtypes
+    ):
+        return jax.default_matmul_precision("float32")
+    return contextlib.nullcontext()
 
 
 def _dtypes():
@@ -80,7 +100,8 @@ def local_stats(
     xc = x.astype(cd)
     if mask is not None:
         xm = xc * mask.astype(cd)[:, None]
-        count = jnp.sum(mask.astype(ad))
+        # Integer sum: an f32 sum of ones saturates at 2^24 rows.
+        count = jnp.sum(mask.astype(jnp.int32)).astype(ad)
     else:
         xm = xc
         count = jnp.asarray(x.shape[0], dtype=ad)
@@ -90,12 +111,13 @@ def local_stats(
 
         gram = gram_pallas(xc, mask.astype(cd))
     else:
-        gram = jax.lax.dot_general(
-            xm,
-            xm,
-            (((0,), (0,)), ((), ())),  # contract over rows: xᵀx
-            preferred_element_type=ad,
-        )
+        with mm_precision(cd):
+            gram = jax.lax.dot_general(
+                xm,
+                xm,
+                (((0,), (0,)), ((), ())),  # contract over rows: xᵀx
+                preferred_element_type=ad,
+            )
     return count, colsum, gram
 
 
@@ -143,11 +165,12 @@ def _stats_shard_2d(x, mask, compute_dtype, accum_dtype):
     xc = x.astype(cd) * mask.astype(cd)[:, None]
     # (m_local, d_full) — ICI all-gather of feature blocks.
     x_full = jax.lax.all_gather(xc, MODEL_AXIS, axis=1, tiled=True)
-    count = jax.lax.psum(jnp.sum(mask.astype(ad)), DATA_AXIS)
+    count = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)).astype(ad), DATA_AXIS)
     colsum = jax.lax.psum(jnp.sum(x_full.astype(ad), axis=0), DATA_AXIS)
-    slab = jax.lax.dot_general(
-        xc, x_full, (((0,), (0,)), ((), ())), preferred_element_type=ad
-    )
+    with mm_precision(cd):
+        slab = jax.lax.dot_general(
+            xc, x_full, (((0,), (0,)), ((), ())), preferred_element_type=ad
+        )
     gram_slab = jax.lax.psum(slab, DATA_AXIS)
     return count, colsum, gram_slab
 
@@ -184,7 +207,7 @@ def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
     ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
     xc = x.astype(cd) * mask.astype(cd)[:, None]
     d_local = x.shape[1]
-    count = jax.lax.psum(jnp.sum(mask.astype(ad)), DATA_AXIS)
+    count = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)).astype(ad), DATA_AXIS)
     my_colsum = jnp.sum(xc.astype(ad), axis=0)  # (d_local,)
     colsum = jax.lax.all_gather(my_colsum, MODEL_AXIS, axis=0, tiled=True)  # (d,) tiny
     colsum = jax.lax.psum(colsum, DATA_AXIS)
@@ -192,9 +215,10 @@ def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
     perm = [(i, (i + 1) % n_model) for i in range(n_model)]
 
     def block_at(s, slab, held):
-        block = jax.lax.dot_general(
-            xc, held, (((0,), (0,)), ((), ())), preferred_element_type=ad
-        )  # (d_local, d_local): G[my_block, held_block]
+        with mm_precision(cd):
+            block = jax.lax.dot_general(
+                xc, held, (((0,), (0,)), ((), ())), preferred_element_type=ad
+            )  # (d_local, d_local): G[my_block, held_block]
         col = (((idx - s) % n_model) * d_local).astype(jnp.int32)
         return jax.lax.dynamic_update_slice(slab, block, (jnp.int32(0), col))
 

@@ -35,6 +35,12 @@ def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _dot_prec(dt):
+    """Mosaic, like XLA, defaults f32 dots to single-pass bf16 mantissas on
+    TPU; request full precision for f32 operands (no-op for bf16)."""
+    return jax.lax.Precision.HIGHEST if jnp.dtype(dt) == jnp.float32 else None
+
+
 # ---------------------------------------------------------------------------
 # Tiled Gram: G = (X·mask)ᵀ (X·mask), accumulated in float32
 # ---------------------------------------------------------------------------
@@ -45,11 +51,13 @@ def _gram_kernel(x_i_ref, x_j_ref, mask_ref, o_ref):
     def _init():
         o_ref[:] = jnp.zeros_like(o_ref)
 
-    m = mask_ref[:]  # (bn,)
-    xi = x_i_ref[:] * m[:, None]
-    xj = x_j_ref[:] * m[:, None]
+    m = mask_ref[:]  # (bn, 1) — 2-D: 1-D operands trip an XLA↔Mosaic
+    # layout mismatch on real TPUs (T(1024) vs T(512) tiling)
+    xi = x_i_ref[:] * m
+    xj = x_j_ref[:] * m
     o_ref[:] += jax.lax.dot_general(
-        xi, xj, (((0,), (0,)), ((), ())), preferred_element_type=o_ref.dtype
+        xi, xj, (((0,), (0,)), ((), ())), preferred_element_type=o_ref.dtype,
+        precision=_dot_prec(xi.dtype),
     )
 
 
@@ -78,7 +86,7 @@ def gram_pallas(
         in_specs=[
             pl.BlockSpec((bn, bd), lambda i, j, kk: (kk, i)),
             pl.BlockSpec((bn, bd), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((bn,), lambda i, j, kk: (kk,)),
+            pl.BlockSpec((bn, 1), lambda i, j, kk: (kk, 0)),
         ],
         out_specs=pl.BlockSpec((bd, bd), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((d, d), jnp.float32),
@@ -88,7 +96,7 @@ def gram_pallas(
         if not interpret
         else None,
         interpret=interpret,
-    )(x, x, mask)  # x twice: row-tile (kk, i) and (kk, j) views of the same array
+    )(x, x, mask.reshape(n, 1))  # x twice: (kk, i) and (kk, j) row-tile views
 
 
 # ---------------------------------------------------------------------------
@@ -123,7 +131,8 @@ def _gram_colsum_kernel(nvalid_ref, x_ref, g_ref, cs_ref, *, block_n):
 
         xb = x_ref[:]
         g_ref[:] += jax.lax.dot_general(
-            xb, xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            xb, xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=_dot_prec(xb.dtype),
         )
         cs_ref[:] += jnp.sum(xb.astype(jnp.float32), axis=0, keepdims=True)
 
@@ -184,6 +193,112 @@ def gram_colsum_pallas(
 
 
 # ---------------------------------------------------------------------------
+# Fused KMeans Lloyd step: assign + centroid-sum update in one HBM pass
+# ---------------------------------------------------------------------------
+
+
+def _lloyd_step_kernel(nvalid_ref, x_ref, c_ref, c2_ref, sums_ref, counts_ref, *, block_n):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    row0 = pl.program_id(0) * block_n
+    nv = nvalid_ref[0]
+
+    @pl.when(row0 < nv)
+    def _accumulate():
+        xb = x_ref[:]  # (bn, d) compute dtype
+        c = c_ref[:]  # (k_pad, d) compute dtype; padded rows are zeros
+        xc = jax.lax.dot_general(
+            xb, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=_dot_prec(xb.dtype),
+        )  # (bn, k_pad)
+        # ||x-c||² up to the row-constant ||x||²: argmin-invariant. Padded
+        # centers carry c2 = LLOYD_PAD_D2 so they never win.
+        d2 = c2_ref[:] - 2.0 * xc
+        assign = jnp.argmin(d2, axis=1).astype(jnp.int32)  # (bn,)
+        ks = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0) + row0
+        onehot = jnp.where(
+            (ks == assign[:, None]) & (rows < nv), 1.0, 0.0
+        ).astype(xb.dtype)  # (bn, k_pad)
+        sums_ref[:] += jax.lax.dot_general(
+            onehot, xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=_dot_prec(xb.dtype),
+        )
+        counts_ref[:] += jnp.sum(onehot.astype(jnp.float32), axis=0, keepdims=True)
+
+
+LLOYD_PAD_D2 = 1e30  # finite sentinel: padded centers never win the argmin
+LLOYD_STEP_BLOCK_N = 4096
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block_n", "interpret"))
+def lloyd_step_pallas(
+    x: jax.Array,
+    centers: jax.Array,
+    n_valid: jax.Array,
+    k: int,
+    block_n: int = LLOYD_STEP_BLOCK_N,
+    interpret: bool = False,
+):
+    """One fused Lloyd iteration's statistics in a single HBM pass over x.
+
+    x: (n, d) compute dtype; centers: (k_pad, d) compute dtype whose rows
+    beyond the true ``k`` are padding — they are excluded from the argmin
+    via a LLOYD_PAD_D2 distance sentinel. Rows ≥ n_valid are skipped (whole
+    blocks past the boundary skip their GEMMs entirely).
+
+    Per block: pairwise-distance GEMM → argmin → one-hot → centroid-sum
+    GEMM, with the (k_pad, d) sums and (1, k_pad) counts accumulators
+    VMEM-resident across the row grid. Nothing of size (n, k) or (n, d)
+    is ever written back to HBM — the fusion the XLA path can't express
+    (it materializes both the distance matrix and the one-hot matrix).
+
+    Returns (sums (k_pad, d) float32, counts (k_pad,) float32).
+    """
+    n, d = x.shape
+    k_pad = centers.shape[0]
+    bn = min(block_n, n)
+    if n % bn:
+        raise ValueError(f"n={n} not divisible by block_n={bn}")
+    if k_pad % 128:
+        raise ValueError(f"k_pad={k_pad} must be a multiple of 128 lanes")
+    c2 = jnp.sum(jnp.square(centers.astype(jnp.float32)), axis=1, keepdims=True).T
+    ks = jax.lax.broadcasted_iota(jnp.int32, c2.shape, 1)
+    c2 = jnp.where(ks < k, c2, LLOYD_PAD_D2)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape((1,))
+    sums, counts = pl.pallas_call(
+        functools.partial(_lloyd_step_kernel, block_n=bn),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n // bn,),
+            in_specs=[
+                pl.BlockSpec((bn, d), lambda i, nv: (i, 0)),
+                pl.BlockSpec((k_pad, d), lambda i, nv: (0, 0)),
+                pl.BlockSpec((1, k_pad), lambda i, nv: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((k_pad, d), lambda i, nv: (0, 0)),
+                pl.BlockSpec((1, k_pad), lambda i, nv: (0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 2**20
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(nv, x, centers, c2)
+    return sums, counts[0]
+
+
+# ---------------------------------------------------------------------------
 # Fused KMeans assignment: argmin_k ||x - c_k||² without an (m, k) HBM array
 # ---------------------------------------------------------------------------
 
@@ -201,7 +316,8 @@ def _assign_kernel(x_ref, c_ref, c2_ref, best_d_ref, best_i_ref):
     c2 = c2_ref[:]  # (bk,)
     # ||x-c||² up to the query-constant ||x||²: c² − 2xc (argmin-invariant).
     xc = jax.lax.dot_general(
-        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=_dot_prec(x.dtype),
     )
     d2 = c2[None, :] - 2.0 * xc  # (bm, bk)
     local_best = jnp.min(d2, axis=1)

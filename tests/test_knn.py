@@ -1,5 +1,6 @@
 """Nearest-neighbor tests: exact vs sklearn brute force, IVF recall."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -132,6 +133,37 @@ def test_ivf_flat_recall(rng, mesh8):
     assert recall > 0.9, f"IVF recall@{k} too low: {recall}"
     # Distances for true positives must agree.
     assert np.all(np.isfinite(dists))
+
+
+def test_ivf_large_k_exceeds_block_width(rng):
+    # k larger than one scan block's candidate pool (LIST_BLOCK * maxlen):
+    # the per-block top-k must clamp to the block width and recover full k
+    # in the cross-block merge, not crash. A hand-built index pins maxlen=2
+    # so the clamp branch (blk_k = 64 < k = 100) is guaranteed to trigger —
+    # a fitted quantizer can't promise that.
+    from spark_rapids_ml_tpu.models.knn import IVFFlatIndex, _ivf_query_fn
+
+    db = rng.normal(size=(256, 8)).astype(np.float32)
+    queries = rng.normal(size=(5, 8)).astype(np.float32)
+    k, nlist, maxlen = 100, 128, 2
+    lists = db.reshape(nlist, maxlen, 8)
+    list_ids = np.arange(256, dtype=np.int64).reshape(nlist, maxlen)
+    index = IVFFlatIndex(
+        centroids=lists.mean(axis=1),
+        lists=lists,
+        list_ids=list_ids,
+        list_mask=np.ones((nlist, maxlen), np.float32),
+    )
+    query = _ivf_query_fn(k, nlist, "float32", "float32")  # probe all lists
+    dists, idx = query(
+        jnp.asarray(index.centroids),
+        jnp.asarray(index.lists),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+        jnp.asarray(queries),
+    )
+    _, ref_i = _sklearn_knn(db, queries, k)
+    np.testing.assert_array_equal(np.sort(idx, axis=1), np.sort(ref_i, axis=1))
 
 
 def test_ivf_nprobe_all_is_exact(rng, mesh8):

@@ -89,3 +89,36 @@ def test_assign_parity(rng):
     # partial distance + ||x||^2 == true min distance
     full = np.asarray(part_d) + np.sum(x**2, 1)
     np.testing.assert_allclose(full, d2.min(axis=1), rtol=1e-4, atol=1e-2)
+
+
+def test_lloyd_step_parity(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import lloyd_step_pallas
+
+    m, d, k, k_pad = 1024, 128, 60, 128
+    # well-separated clusters: argmin margins >> f32 GEMM error
+    centers = (rng.normal(size=(k, d)) * 10).astype(np.float32)
+    lab = rng.integers(0, k, size=m)
+    x = (centers[lab] + 0.01 * rng.normal(size=(m, d))).astype(np.float32)
+    cpad = np.zeros((k_pad, d), np.float32)
+    cpad[:k] = centers
+    for n_valid in (m, 700):  # full + boundary-straddling partial block
+        sums, counts = lloyd_step_pallas(
+            x, cpad, n_valid, k=k, block_n=256, interpret=True
+        )
+        ref_sums = np.zeros((k, d))
+        ref_counts = np.zeros(k)
+        np.add.at(ref_sums, lab[:n_valid], x[:n_valid])
+        np.add.at(ref_counts, lab[:n_valid], 1)
+        np.testing.assert_allclose(np.asarray(counts)[:k], ref_counts)
+        np.testing.assert_allclose(np.asarray(sums)[:k], ref_sums, rtol=1e-4, atol=1e-2)
+        # padded centers never win the argmin
+        assert float(np.asarray(counts)[k:].sum()) == 0.0
+
+
+def test_lloyd_step_block_validation(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import lloyd_step_pallas
+
+    x = rng.normal(size=(100, 128)).astype(np.float32)
+    c = rng.normal(size=(128, 128)).astype(np.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        lloyd_step_pallas(x, c, 100, k=100, block_n=64, interpret=True)
