@@ -52,7 +52,7 @@ from spark_rapids_ml_tpu.core.params import (
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops.distances import sq_euclidean
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
-from spark_rapids_ml_tpu.parallel.sharding import shard_rows
+from spark_rapids_ml_tpu.parallel.sharding import pad_rows, shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
@@ -322,6 +322,196 @@ def fit_kmeans(
         centers=np.asarray(centers, dtype=np.float64),
         cost=float(cost),
         n_iter=int(n_iter),
+        n_rows=n_true,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Streaming (out-of-HBM) Lloyd: one host scan per iteration
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _stream_step_fn(mesh: Mesh, k: int, cd: str, ad: str):
+    """Jitted donated accumulate of one batch's Lloyd statistics at fixed
+    centers: (state, centers, x, mask) -> state with
+    state = (sums (k, d), counts (k,), cost ()).
+
+    Uses the XLA assign path (not the fused Pallas step): streaming batches
+    are modest, and materializing (batch, k) distances buys the running
+    cost for free — convergence monitoring the fused kernel can't provide.
+    """
+    compute_dtype = jnp.dtype(cd)
+    accum_dtype = jnp.dtype(ad)
+
+    def shard(sums, counts, cost, centers, x, mask):
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        xc = x.astype(compute_dtype)
+        maskc = mask.astype(accum_dtype)
+        d2 = sq_euclidean(
+            xc, centers.astype(compute_dtype), accum_dtype=accum_dtype
+        )
+        assign = jnp.argmin(d2, axis=1)
+        min_d2 = jnp.min(d2, axis=1)
+        onehot = (
+            jax.nn.one_hot(assign, k, dtype=compute_dtype)
+            * maskc[:, None].astype(compute_dtype)
+        )
+        with mm_precision(compute_dtype):
+            bs = jax.lax.dot_general(
+                onehot, xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=accum_dtype,
+            )
+        bc = jnp.sum(onehot.astype(accum_dtype), axis=0)
+        bcost = jnp.sum(min_d2 * maskc)
+        return (
+            sums + jax.lax.psum(bs, DATA_AXIS),
+            counts + jax.lax.psum(bc, DATA_AXIS),
+            cost + jax.lax.psum(bcost, DATA_AXIS),
+        )
+
+    f = jax.shard_map(
+        shard,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, centers, x, mask):
+        return f(state[0], state[1], state[2], centers, x, mask)
+
+    return update
+
+
+def fit_kmeans_stream(
+    batch_source,
+    k: int,
+    n_cols: int,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+    seed: int = 0,
+    init: str = "k-means++",
+    mesh: Optional[Mesh] = None,
+    checkpoint_path: Optional[str] = None,
+    init_sample_rows: int = 65536,
+) -> KMeansSolution:
+    """Lloyd's algorithm over a re-scannable stream of host row-batches —
+    the capacity path for datasets ≫ HBM (BASELINE.json config #3:
+    50M×256 is 51 GB f32, beyond a single chip).
+
+    ``batch_source`` is a CALLABLE returning a fresh iterator of (rows, d)
+    arrays; each Lloyd iteration consumes one full scan (that re-scan
+    requirement is what distinguishes iterative streaming from the
+    single-pass PCA/LinReg accumulators). Per batch, assignment +
+    centroid-partials run sharded on device and fold into a donated (k, d)
+    accumulator; only the (k, d) centers live across scans. One extra scan
+    at the end computes the exact training cost at the final centers
+    (Spark ``summary.trainingCost`` semantics, matching the in-memory fit).
+
+    With ``checkpoint_path``, centers are persisted after every iteration
+    and an interrupted fit resumes at the saved iteration (the
+    preemption-safety gap noted in SURVEY.md §5 "failure detection").
+    """
+    from spark_rapids_ml_tpu.core import checkpoint as ckpt
+
+    if k <= 0:
+        raise ValueError(f"k = {k} must be > 0")
+    if init not in ("k-means++", "random"):
+        raise ValueError(f"unknown init mode {init!r} (k-means++|random)")
+    mesh = mesh or default_mesh()
+    cd, ad = config.get("compute_dtype"), config.get("accum_dtype")
+    update = _stream_step_fn(mesh, k, cd, ad)
+    accum_dtype = jnp.dtype(ad)
+
+    start_iter = 0
+    centers = None
+    restored = ckpt.load_state(checkpoint_path) if checkpoint_path else None
+    if restored is not None:
+        arrays, meta = restored
+        if meta.get("n_cols") != n_cols or meta.get("k") != k:
+            raise ValueError(
+                f"checkpoint at {checkpoint_path} is for k="
+                f"{meta.get('k')}, n_cols={meta.get('n_cols')}, not ({k}, {n_cols})"
+            )
+        centers = np.asarray(arrays["centers"])
+        start_iter = int(meta["it"])
+    if centers is None:
+        # Init on a bounded host sample drawn from the stream's head.
+        rng = np.random.default_rng(seed)
+        head = []
+        got = 0
+        for batch in batch_source():
+            head.append(np.asarray(batch))
+            got += head[-1].shape[0]
+            if got >= init_sample_rows:
+                break
+        if not head:
+            raise ValueError("batch_source yielded no batches")
+        sample = np.concatenate(head)[:init_sample_rows]
+        if k > sample.shape[0]:
+            raise ValueError(
+                f"k = {k} exceeds the {sample.shape[0]}-row init sample; "
+                f"raise init_sample_rows"
+            )
+        with trace_span("kmeans init"):
+            centers = (
+                _kmeans_plus_plus(sample, k, rng)
+                if init == "k-means++"
+                else _random_init(sample, k, rng)
+            )
+
+    def scan(centers_dev):
+        state = (
+            jnp.zeros((k, n_cols), accum_dtype),
+            jnp.zeros((k,), accum_dtype),
+            jnp.zeros((), accum_dtype),
+        )
+        n_rows = 0
+        for batch in batch_source():
+            # shard_rows pads, casts f64→f32 via the threaded native bridge
+            # (halving host→device bytes for f64 sources), and places.
+            xs, ms, n_b = shard_rows(np.asarray(batch), mesh, dtype=np.float32)
+            n_rows += n_b
+            state = update(state, centers_dev, xs, ms)
+        return state, n_rows
+
+    n_true = 0
+    n_iter = start_iter
+    centers_dev = jnp.asarray(centers, accum_dtype)
+    with trace_span("lloyd-stream"):
+        for it in range(start_iter, max_iter):
+            (sums, counts, _), n_true = scan(centers_dev)
+            new_centers = jnp.where(
+                (counts > 0)[:, None],
+                sums / jnp.maximum(counts, 1)[:, None],
+                centers_dev,
+            )
+            moved2 = float(
+                jnp.max(jnp.sum((new_centers - centers_dev) ** 2, axis=1))
+            )
+            centers_dev = new_centers
+            n_iter = it + 1
+            if checkpoint_path:
+                ckpt.save_state(
+                    checkpoint_path,
+                    {"centers": np.asarray(jax.device_get(centers_dev))},
+                    {"it": n_iter, "k": k, "n_cols": n_cols},
+                )
+            if moved2 <= float(tol) ** 2:
+                break
+        # Exact cost at the final centers (one cost-only scan).
+        (_, _, cost), n_true = scan(centers_dev)
+    if checkpoint_path:
+        import os
+
+        if os.path.exists(checkpoint_path):
+            os.unlink(checkpoint_path)
+    return KMeansSolution(
+        centers=np.asarray(jax.device_get(centers_dev), dtype=np.float64),
+        cost=float(cost),
+        n_iter=n_iter,
         n_rows=n_true,
     )
 

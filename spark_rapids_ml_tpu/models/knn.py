@@ -218,19 +218,22 @@ def build_ivf_flat(
     x = np.asarray(x)
     sol = fit_kmeans(x, k=nlist, max_iter=10, seed=seed, init="random", mesh=mesh)
     centroids = sol.centers
-    # Host-side bucketing (one pass; the device-side assign would need the
-    # same gather). Chunked to bound memory.
+    # Device-side assignment (the n·nlist·d FLOPs belong on the MXU — at
+    # 1M×768×1024 the host-numpy version is minutes of CPU); only the
+    # (n,) argmin comes back. The scatter into padded lists stays on host.
     n = x.shape[0]
     assign = np.empty((n,), dtype=np.int64)
+    cdev = jnp.asarray(centroids, jnp.float32)
+
+    @jax.jit
+    def _assign_chunk(chunk, cdev):
+        d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
+        return jnp.argmin(d2, axis=1)
+
     step = 1 << 18
     for i in range(0, n, step):
-        chunk = x[i : i + step]
-        d2 = (
-            np.sum(chunk**2, 1)[:, None]
-            - 2 * chunk @ centroids.T
-            + np.sum(centroids**2, 1)[None, :]
-        )
-        assign[i : i + step] = np.argmin(d2, axis=1)
+        chunk = jnp.asarray(x[i : i + step], jnp.float32)
+        assign[i : i + step] = np.asarray(_assign_chunk(chunk, cdev))
     counts = np.bincount(assign, minlength=nlist)
     maxlen = max(int(counts.max()), 1)
     d = x.shape[1]

@@ -89,9 +89,14 @@ def _newton_fn(mesh: Mesh, reg: float, fit_intercept: bool, max_iter: int, tol: 
             grad_b = jax.lax.psum(jnp.sum(r), DATA_AXIS) / n
             wgt = jnp.maximum(p * (1.0 - p), 1e-10) * maskc
             xw = xc * wgt[:, None]
+            # The Hessian is a preconditioner, not the answer: inexact
+            # Newton converges to the same optimum (the gradient sets the
+            # fixed point), so the dominant n·d² GEMM runs at fast DEFAULT
+            # precision; gradients keep the surrounding full-f32 scope.
             h_ww = jax.lax.psum(
                 jax.lax.dot_general(xw, xc, (((0,), (0,)), ((), ())),
-                                    preferred_element_type=accum),
+                                    preferred_element_type=accum,
+                                    precision=jax.lax.Precision.DEFAULT),
                 DATA_AXIS,
             ) / n + reg * jnp.eye(d, dtype=accum)
             h_wb = jax.lax.psum(jnp.sum(xw, axis=0), DATA_AXIS) / n
@@ -280,6 +285,226 @@ def fit_logistic_regression(
             n_iter=int(n_iter),
             n_rows=n_true,
         )
+
+
+# ---------------------------------------------------------------------------
+# Streaming (out-of-HBM) Newton: one host scan per iteration
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _stream_grad_hess_fn(mesh: Mesh, ad: str):
+    """Jitted donated accumulate of one batch's Newton statistics at fixed
+    (w, b): (state, w, b, x, y, mask) -> state with
+    state = (gw (d,), gb (), hww (d, d), hwb (d,), hbb (), loss (), n ()).
+
+    Raw sums — normalization by n and the L2 term are applied in the
+    finalize step once the scan's true row count is known.
+    """
+    accum = jnp.dtype(ad)
+
+    def shard(gw, gb, hww, hwb, hbb, loss, n, w, b, x, y, mask):
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        with mm_precision(accum):
+            xc = x.astype(accum)
+            yc = y.astype(accum)
+            maskc = mask.astype(accum)
+            z = xc @ w + b
+            p = jax.nn.sigmoid(z)
+            r = (p - yc) * maskc
+            wgt = jnp.maximum(p * (1.0 - p), 1e-10) * maskc
+            xw = xc * wgt[:, None]
+            bloss = jnp.sum((jax.nn.softplus(z) - yc * z) * maskc)
+            bn = jnp.sum(maskc.astype(jnp.int32)).astype(accum)
+            return (
+                gw + jax.lax.psum(xc.T @ r, DATA_AXIS),
+                gb + jax.lax.psum(jnp.sum(r), DATA_AXIS),
+                hww
+                + jax.lax.psum(
+                    jax.lax.dot_general(
+                        xw, xc, (((0,), (0,)), ((), ())),
+                        preferred_element_type=accum,
+                        # Preconditioner-only (see _newton_fn): fast path.
+                        precision=jax.lax.Precision.DEFAULT,
+                    ),
+                    DATA_AXIS,
+                ),
+                hwb + jax.lax.psum(jnp.sum(xw, axis=0), DATA_AXIS),
+                hbb + jax.lax.psum(jnp.sum(wgt), DATA_AXIS),
+                loss + jax.lax.psum(bloss, DATA_AXIS),
+                n + jax.lax.psum(bn, DATA_AXIS),
+            )
+
+    f = jax.shard_map(
+        shard,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                  P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(),) * 7,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, w, b, x, y, mask):
+        return f(*state, w, b, x, y, mask)
+
+    return update
+
+
+@functools.lru_cache(maxsize=64)
+def _stream_newton_step_fn(reg: float, fit_intercept: bool, ad: str):
+    """Jitted finalize: scan sums + current (w, b) -> (new_w, new_b, delta)."""
+    accum = jnp.dtype(ad)
+
+    def step(gw, gb, hww, hwb, hbb, n, w, b):
+        n = jnp.maximum(n, 1.0)
+        d = gw.shape[0]
+        grad_w = gw / n + reg * w
+        grad_b = gb / n
+        h_ww = hww / n + reg * jnp.eye(d, dtype=accum)
+        h_wb = hwb / n
+        h_bb = hbb / n
+        if fit_intercept:
+            # Bordered (d+1) system via block elimination — same math as
+            # the in-memory _newton_fn body.
+            hinv_hwb = jnp.linalg.solve(h_ww, h_wb)
+            hinv_gw = jnp.linalg.solve(h_ww, grad_w)
+            schur = jnp.maximum(h_bb - h_wb @ hinv_hwb, 1e-12)
+            db = (grad_b - h_wb @ hinv_gw) / schur
+            dw = hinv_gw - hinv_hwb * db
+        else:
+            dw = jnp.linalg.solve(h_ww, grad_w)
+            db = jnp.zeros((), accum)
+        delta = jnp.sqrt(jnp.sum(dw * dw) + db * db)
+        return w - dw, b - db, delta
+
+    return jax.jit(step)
+
+
+def fit_logistic_stream(
+    batch_source,
+    n_cols: int,
+    reg: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    mesh: Optional[Mesh] = None,
+    checkpoint_path: Optional[str] = None,
+) -> LogisticSolution:
+    """Binary Newton-IRLS over a re-scannable stream of host (x, y) batches
+    — the capacity path for label datasets ≫ HBM (BASELINE.json config #4:
+    Criteo-1TB normal-equations family).
+
+    ``batch_source`` is a CALLABLE returning a fresh iterator of
+    ``(x (rows, d), y (rows,))`` pairs; each Newton iteration consumes one
+    full scan, accumulating gradient + Hessian sharded on device into a
+    donated O(d²) state. Labels must be {0, 1} (binary only — the
+    multinomial GD path needs hundreds of scans and belongs on the
+    in-memory path). The returned ``loss`` is the objective at the LAST
+    iterate evaluated during its final scan (one iteration stale, standard
+    for streaming monitors; a converged fit has delta ≤ tol so the
+    difference is below the stopping precision).
+
+    With ``checkpoint_path``, (w, b) persist after every iteration and an
+    interrupted fit resumes at the saved iteration.
+    """
+    from spark_rapids_ml_tpu.core import checkpoint as ckpt
+
+    mesh = mesh or default_mesh()
+    ad = config.get("accum_dtype")
+    accum = jnp.dtype(ad)
+    update = _stream_grad_hess_fn(mesh, ad)
+    newton_step = _stream_newton_step_fn(float(reg), bool(fit_intercept), ad)
+
+    w = jnp.zeros((n_cols,), accum)
+    b = jnp.zeros((), accum)
+    start_iter = 0
+    restored = ckpt.load_state(checkpoint_path) if checkpoint_path else None
+    if restored is not None:
+        arrays, meta = restored
+        if meta.get("n_cols") != n_cols:
+            raise ValueError(
+                f"checkpoint at {checkpoint_path} is for n_cols="
+                f"{meta.get('n_cols')}, not {n_cols}"
+            )
+        w = jnp.asarray(arrays["w"], accum)
+        b = jnp.asarray(arrays["b"], accum)
+        start_iter = int(meta["it"])
+
+    labels_checked = False
+
+    def scan(w_dev, b_dev):
+        nonlocal labels_checked
+        state = (
+            jnp.zeros((n_cols,), accum),
+            jnp.zeros((), accum),
+            jnp.zeros((n_cols, n_cols), accum),
+            jnp.zeros((n_cols,), accum),
+            jnp.zeros((), accum),
+            jnp.zeros((), accum),
+            jnp.zeros((), accum),
+        )
+        n_rows = 0
+        for xb_host, yb_host in batch_source():
+            yb_host = np.asarray(yb_host).reshape(-1)
+            if not labels_checked:  # first scan only — data is fixed across scans
+                bad = set(np.unique(yb_host)) - {0, 1, 0.0, 1.0}
+                if bad:
+                    raise ValueError(
+                        f"labels must be binary 0/1 for the streaming path; "
+                        f"got {sorted(bad)[:8]}"
+                    )
+            n_rows += yb_host.shape[0]
+            # shard_rows pads, casts f64→f32 via the threaded native bridge,
+            # and places row-sharded.
+            xs, ms, _ = shard_rows(np.asarray(xb_host), mesh, dtype=np.float32)
+            ys, _, _ = shard_rows(yb_host.astype(np.float32), mesh)
+            state = update(state, w_dev, b_dev, xs, ys, ms)
+        labels_checked = True
+        return state, n_rows
+
+    n_true = 0
+    n_iter = start_iter
+    loss = float("nan")
+    with trace_span("logreg-stream"):
+        for it in range(start_iter, max_iter):
+            (gw, gb, hww, hwb, hbb, lsum, n), n_true = scan(w, b)
+            # Objective at the iterate the scan evaluated (pre-update w).
+            loss = float(lsum / jnp.maximum(n, 1.0)) + 0.5 * float(reg) * float(
+                jnp.sum(w * w)
+            )
+            w, b, delta = newton_step(gw, gb, hww, hwb, hbb, n, w, b)
+            n_iter = it + 1
+            if checkpoint_path:
+                ckpt.save_state(
+                    checkpoint_path,
+                    {
+                        "w": np.asarray(jax.device_get(w)),
+                        "b": np.asarray(jax.device_get(b)),
+                    },
+                    {"it": n_iter, "n_cols": n_cols},
+                )
+            if float(delta) <= tol:
+                break
+        if n_true == 0:
+            # Resumed at/past max_iter: the loop never ran, so evaluate the
+            # restored iterate once for a faithful (n_rows, loss).
+            (_, _, _, _, _, lsum, n), n_true = scan(w, b)
+            loss = float(lsum / jnp.maximum(n, 1.0)) + 0.5 * float(reg) * float(
+                jnp.sum(w * w)
+            )
+    if checkpoint_path:
+        import os
+
+        if os.path.exists(checkpoint_path):
+            os.unlink(checkpoint_path)
+    return LogisticSolution(
+        coefficients=np.asarray(jax.device_get(w), dtype=np.float64),
+        intercept=np.asarray(jax.device_get(b), dtype=np.float64),
+        n_iter=n_iter,
+        n_rows=n_true,
+        loss=loss,
+    )
 
 
 # ---------------------------------------------------------------------------

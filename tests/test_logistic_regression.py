@@ -103,3 +103,88 @@ def test_label_validation(mesh8, rng):
         fit_logistic_regression(x, np.zeros(20), mesh=mesh8)
     with pytest.raises(ValueError, match="labels must be"):
         fit_logistic_regression(x, np.where(rng.uniform(size=20) < 0.5, 1.0, 5.0), mesh=mesh8)
+
+
+def test_streaming_matches_batch(rng, mesh8):
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        fit_logistic_regression,
+        fit_logistic_stream,
+    )
+
+    w_true = rng.normal(size=6)
+    x = rng.normal(size=(2000, 6))
+    y = (x @ w_true + 0.5 + rng.normal(size=2000) * 0.3 > 0).astype(np.float64)
+
+    sol_b = fit_logistic_regression(
+        x, y, reg=1e-3, max_iter=30, tol=1e-8, mesh=mesh8
+    )
+
+    def source():
+        for i in range(0, 2000, 512):
+            yield x[i : i + 512], y[i : i + 512]
+
+    sol_s = fit_logistic_stream(
+        source, n_cols=6, reg=1e-3, max_iter=30, tol=1e-8, mesh=mesh8
+    )
+    assert sol_s.n_rows == 2000
+    np.testing.assert_allclose(sol_s.coefficients, sol_b.coefficients, atol=1e-4)
+    np.testing.assert_allclose(sol_s.intercept, sol_b.intercept, atol=1e-4)
+    assert np.isfinite(sol_s.loss)
+
+
+def test_streaming_rejects_nonbinary(mesh8, rng):
+    from spark_rapids_ml_tpu.models.logistic_regression import fit_logistic_stream
+
+    x = rng.normal(size=(64, 4))
+    y = rng.integers(0, 3, size=64).astype(np.float64)  # 3 classes
+
+    def source():
+        yield x, y
+
+    with pytest.raises(ValueError, match="binary"):
+        fit_logistic_stream(source, n_cols=4, max_iter=2, mesh=mesh8)
+
+
+def test_streaming_checkpoint_resume(rng, mesh8, tmp_path):
+    from spark_rapids_ml_tpu.models.logistic_regression import fit_logistic_stream
+
+    w_true = rng.normal(size=5)
+    x = rng.normal(size=(1024, 5))
+    y = (x @ w_true > 0).astype(np.float64)
+    ck = str(tmp_path / "lr.ckpt")
+
+    def source():
+        for i in range(0, 1024, 256):
+            yield x[i : i + 256], y[i : i + 256]
+
+    full = fit_logistic_stream(
+        source, n_cols=5, reg=1e-3, max_iter=25, tol=1e-10, mesh=mesh8
+    )
+
+    class Stop(Exception):
+        pass
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise Stop()
+        return iter((x[i : i + 256], y[i : i + 256]) for i in range(0, 1024, 256))
+
+    try:
+        fit_logistic_stream(
+            lambda: flaky(), n_cols=5, reg=1e-3, max_iter=25, tol=1e-10,
+            mesh=mesh8, checkpoint_path=ck,
+        )
+    except Stop:
+        pass
+    import os
+
+    assert os.path.exists(ck)
+    resumed = fit_logistic_stream(
+        source, n_cols=5, reg=1e-3, max_iter=25, tol=1e-10,
+        mesh=mesh8, checkpoint_path=ck,
+    )
+    assert not os.path.exists(ck)
+    np.testing.assert_allclose(resumed.coefficients, full.coefficients, atol=1e-5)
