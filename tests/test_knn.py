@@ -180,3 +180,111 @@ def test_ivf_nprobe_all_is_exact(rng, mesh8):
     _, idx = ann.kneighbors(queries)
     _, ref_i = _sklearn_knn(db, queries, k)
     np.testing.assert_array_equal(np.sort(idx, axis=1), np.sort(ref_i, axis=1))
+
+
+def test_ivf_bucketed_matches_dense_no_drops(rng):
+    # With slack high enough that C == q, no (query, list) pair can be
+    # dropped, so on this CPU test backend (where approx_min_k lowers to an
+    # exact sort) the bucketed executor must return exactly the dense
+    # executor's neighbor sets. On real TPUs the bucketed shortlist is
+    # approximate by design (recall_target=0.95 + exact rerank) and only a
+    # recall bound holds — this equality is a CPU-only algebraic check of
+    # the bucketing/gather-back plumbing, not a cross-backend contract.
+    from spark_rapids_ml_tpu.models.knn import build_ivf_flat, _ivf_query_fn
+
+    db = rng.normal(size=(2048, 16)).astype(np.float32)
+    queries = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    index = build_ivf_flat(db, nlist=64, seed=0)
+    dev = [
+        jnp.asarray(index.centroids, jnp.float32),
+        jnp.asarray(index.lists),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+    ]
+    k, nprobe = 10, 8  # nprobe*4 < nlist -> auto would pick bucketed
+    dense = _ivf_query_fn(k, nprobe, "float32", "float32", mode="dense")
+    bucketed = _ivf_query_fn(
+        k, nprobe, "float32", "float32", mode="bucketed", slack=1e9
+    )
+    dd, di = dense(*dev, queries)
+    bd, bi = bucketed(*dev, queries)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(di), axis=1), np.sort(np.asarray(bi), axis=1)
+    )
+    np.testing.assert_allclose(
+        np.sort(np.asarray(dd), axis=1), np.sort(np.asarray(bd), axis=1),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_ivf_bucketed_recall_default_slack(rng):
+    # Clustered data + clustered queries (the capacity-pressure case):
+    # default slack must still deliver high recall through the estimator
+    # path, which auto-selects the bucketed executor (nprobe*4 < nlist).
+    centers = rng.normal(size=(32, 24)) * 8
+    db = np.concatenate([c + rng.normal(size=(120, 24)) for c in centers])
+    queries = np.concatenate([c + rng.normal(size=(4, 24)) for c in centers])
+    k = 10
+    ann = (
+        ApproximateNearestNeighbors()
+        .setK(k)
+        .setNlist(32)
+        .setNprobe(4)
+        .fit({"features": db})
+    )
+    dists, idx = ann.kneighbors(queries)
+    _, ref_i = _sklearn_knn(db, queries, k)
+    recall = np.mean(
+        [len(set(idx[i]) & set(ref_i[i])) / k for i in range(len(queries))]
+    )
+    assert recall > 0.85, f"bucketed IVF recall@{k} too low: {recall}"
+
+
+def test_ivf_bucketed_correlated_queries_degrade_gracefully(rng):
+    # 256 IDENTICAL queries all probing the same nprobe lists: per-list
+    # capacity (C=64) cannot hold them all, but the rotated eviction order
+    # must leave every query covering at least one probed list — no query
+    # may come back empty (all -1), the old failure mode.
+    from spark_rapids_ml_tpu.models.knn import build_ivf_flat, _ivf_query_fn
+
+    centers = rng.normal(size=(32, 12)) * 10
+    db = np.concatenate([c + rng.normal(size=(100, 12)) for c in centers]).astype(
+        np.float32
+    )
+    queries = np.broadcast_to(centers[0].astype(np.float32), (256, 12)).copy()
+    index = build_ivf_flat(db, nlist=32, seed=0)
+    q = _ivf_query_fn(10, 4, "float32", "float32", mode="bucketed")
+    _, idx = q(
+        jnp.asarray(index.centroids, jnp.float32),
+        jnp.asarray(index.lists),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+        jnp.asarray(queries),
+    )
+    idx = np.asarray(idx)
+    assert np.all(idx >= 0), f"{np.sum(np.all(idx < 0, axis=1))} queries empty"
+
+
+def test_ivf_padding_queries_do_not_evict_real_ones(rng):
+    # 65 real queries pad internally to 128; the 63 zero-vector pad rows
+    # all probe the lists nearest the origin and must lose every capacity
+    # contest (rank forced past nprobe), leaving real queries' results
+    # identical to an unpadded 64-query call on the shared prefix.
+    centers = rng.normal(size=(32, 12)) * 10
+    db = np.concatenate([c + rng.normal(size=(100, 12)) for c in centers]).astype(
+        np.float32
+    )
+    queries = (centers[rng.integers(0, 32, size=65)] + rng.normal(size=(65, 12))).astype(
+        np.float32
+    )
+    ann = (
+        ApproximateNearestNeighbors()
+        .setK(10)
+        .setNlist(32)
+        .setNprobe(4)
+        .fit({"features": db})
+    )
+    _, idx65 = ann.kneighbors(queries)  # padded to 128 internally
+    _, idx64 = ann.kneighbors(queries[:64])  # no padding
+    np.testing.assert_array_equal(idx65[:64], idx64)
+    assert np.all(np.asarray(idx65) >= 0)
