@@ -34,6 +34,7 @@ from spark_rapids_ml_tpu.spark.estimator import (
     SparkLinearRegression,
     SparkLogisticRegression,
     SparkNearestNeighbors,
+    SparkApproximateNearestNeighbors,
 )
 
 __all__ = [
@@ -45,4 +46,5 @@ __all__ = [
     "SparkLinearRegression",
     "SparkLogisticRegression",
     "SparkNearestNeighbors",
+    "SparkApproximateNearestNeighbors",
 ]

@@ -167,7 +167,10 @@ def _make_wrapper(name, core_cls, doc):
 
 
 from spark_rapids_ml_tpu.models.kmeans import KMeans as _KMeans
-from spark_rapids_ml_tpu.models.knn import NearestNeighbors as _NearestNeighbors
+from spark_rapids_ml_tpu.models.knn import (
+    ApproximateNearestNeighbors as _ApproximateNearestNeighbors,
+    NearestNeighbors as _NearestNeighbors,
+)
 from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression as _LinearRegression,
 )
@@ -190,4 +193,9 @@ SparkLogisticRegression = _make_wrapper(
 )
 SparkNearestNeighbors = _make_wrapper(
     "SparkNearestNeighbors", _NearestNeighbors, "Exact KNN over PySpark DataFrames."
+)
+SparkApproximateNearestNeighbors = _make_wrapper(
+    "SparkApproximateNearestNeighbors",
+    _ApproximateNearestNeighbors,
+    "IVF-Flat approximate KNN over PySpark DataFrames.",
 )

@@ -86,3 +86,22 @@ def test_wrapper_spark_df_requires_pyspark():
         SparkPCA().setK(2).fit(FakeSparkDF())
     with pytest.raises(ImportError, match="pyspark"):
         SparkPCA(). setK(2).fit({"features": np.ones((10, 4))}).transform(FakeSparkDF())
+
+
+def test_ann_wrapper_host_data(rng):
+    # The ANN wrapper must behave like the core estimator on host data.
+    from spark_rapids_ml_tpu.spark import SparkApproximateNearestNeighbors
+
+    centers = rng.normal(size=(8, 12)) * 8
+    db = np.concatenate([c + rng.normal(size=(64, 12)) for c in centers])
+    ann = (
+        SparkApproximateNearestNeighbors()
+        .setK(5)
+        .setNlist(8)
+        .setNprobe(8)
+        .fit({"features": db})
+    )
+    dists, idx = ann.kneighbors(db[:4])
+    assert idx.shape == (4, 5)
+    # Self is its own nearest neighbor when probing everything.
+    np.testing.assert_array_equal(idx[:, 0], np.arange(4))
