@@ -385,6 +385,31 @@ def _stream_step_fn(mesh: Mesh, k: int, cd: str, ad: str):
     return update
 
 
+def stream_zero_state(k: int, n_cols: int, accum_dtype) -> tuple:
+    """Zero (sums, counts, cost) accumulator for one Lloyd pass — shared by
+    fit_kmeans_stream and the data-plane daemon's iterative kmeans job."""
+    ad = jnp.dtype(accum_dtype)
+    return (
+        jnp.zeros((k, n_cols), ad),
+        jnp.zeros((k,), ad),
+        jnp.zeros((), ad),
+    )
+
+
+def apply_lloyd_update(sums, counts, centers):
+    """One Lloyd center update from a full pass's statistics.
+
+    Empty clusters keep their previous centroid (Spark behavior). Returns
+    (new_centers, moved² max over centers) — the single source of the
+    update rule for both the in-process stream fit and the daemon.
+    """
+    new_centers = jnp.where(
+        (counts > 0)[:, None], sums / jnp.maximum(counts, 1)[:, None], centers
+    )
+    moved2 = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+    return new_centers, moved2
+
+
 def fit_kmeans_stream(
     batch_source,
     k: int,
@@ -463,11 +488,7 @@ def fit_kmeans_stream(
             )
 
     def scan(centers_dev):
-        state = (
-            jnp.zeros((k, n_cols), accum_dtype),
-            jnp.zeros((k,), accum_dtype),
-            jnp.zeros((), accum_dtype),
-        )
+        state = stream_zero_state(k, n_cols, accum_dtype)
         n_rows = 0
         for batch in batch_source():
             # shard_rows pads, casts f64→f32 via the threaded native bridge
@@ -483,15 +504,8 @@ def fit_kmeans_stream(
     with trace_span("lloyd-stream"):
         for it in range(start_iter, max_iter):
             (sums, counts, _), n_true = scan(centers_dev)
-            new_centers = jnp.where(
-                (counts > 0)[:, None],
-                sums / jnp.maximum(counts, 1)[:, None],
-                centers_dev,
-            )
-            moved2 = float(
-                jnp.max(jnp.sum((new_centers - centers_dev) ** 2, axis=1))
-            )
-            centers_dev = new_centers
+            centers_dev, moved2 = apply_lloyd_update(sums, counts, centers_dev)
+            moved2 = float(moved2)
             n_iter = it + 1
             if checkpoint_path:
                 ckpt.save_state(

@@ -381,6 +381,39 @@ def _stream_newton_step_fn(reg: float, fit_intercept: bool, ad: str):
     return jax.jit(step)
 
 
+def stream_zero_state(n_cols: int, accum_dtype) -> tuple:
+    """Zero (gw, gb, hww, hwb, hbb, loss, n) accumulator for one Newton
+    pass — shared by fit_logistic_stream and the data-plane daemon."""
+    ad = jnp.dtype(accum_dtype)
+    d = n_cols
+    return (
+        jnp.zeros((d,), ad),
+        jnp.zeros((), ad),
+        jnp.zeros((d, d), ad),
+        jnp.zeros((d,), ad),
+        jnp.zeros((), ad),
+        jnp.zeros((), ad),
+        jnp.zeros((), ad),
+    )
+
+
+def stream_objective(lsum, n, reg: float, w) -> float:
+    """Training objective at the iterate a pass evaluated: mean data loss
+    plus the L2 term — the single definition both streaming paths report."""
+    return float(lsum / jnp.maximum(n, 1.0)) + 0.5 * float(reg) * float(
+        jnp.sum(w * w)
+    )
+
+
+def validate_binary_labels(y: np.ndarray) -> None:
+    """Raise unless labels are {0, 1} (Spark ML binary convention)."""
+    bad = set(np.unique(y)) - {0, 1, 0.0, 1.0}
+    if bad:
+        raise ValueError(
+            f"labels must be binary 0/1 for the streaming path; got {sorted(bad)[:8]}"
+        )
+
+
 def fit_logistic_stream(
     batch_source,
     n_cols: int,
@@ -435,25 +468,12 @@ def fit_logistic_stream(
 
     def scan(w_dev, b_dev):
         nonlocal labels_checked
-        state = (
-            jnp.zeros((n_cols,), accum),
-            jnp.zeros((), accum),
-            jnp.zeros((n_cols, n_cols), accum),
-            jnp.zeros((n_cols,), accum),
-            jnp.zeros((), accum),
-            jnp.zeros((), accum),
-            jnp.zeros((), accum),
-        )
+        state = stream_zero_state(n_cols, accum)
         n_rows = 0
         for xb_host, yb_host in batch_source():
             yb_host = np.asarray(yb_host).reshape(-1)
             if not labels_checked:  # first scan only — data is fixed across scans
-                bad = set(np.unique(yb_host)) - {0, 1, 0.0, 1.0}
-                if bad:
-                    raise ValueError(
-                        f"labels must be binary 0/1 for the streaming path; "
-                        f"got {sorted(bad)[:8]}"
-                    )
+                validate_binary_labels(yb_host)
             n_rows += yb_host.shape[0]
             # shard_rows pads, casts f64→f32 via the threaded native bridge,
             # and places row-sharded.
@@ -470,9 +490,7 @@ def fit_logistic_stream(
         for it in range(start_iter, max_iter):
             (gw, gb, hww, hwb, hbb, lsum, n), n_true = scan(w, b)
             # Objective at the iterate the scan evaluated (pre-update w).
-            loss = float(lsum / jnp.maximum(n, 1.0)) + 0.5 * float(reg) * float(
-                jnp.sum(w * w)
-            )
+            loss = stream_objective(lsum, n, reg, w)
             w, b, delta = newton_step(gw, gb, hww, hwb, hbb, n, w, b)
             n_iter = it + 1
             if checkpoint_path:
@@ -490,9 +508,7 @@ def fit_logistic_stream(
             # Resumed at/past max_iter: the loop never ran, so evaluate the
             # restored iterate once for a faithful (n_rows, loss).
             (_, _, _, _, _, lsum, n), n_true = scan(w, b)
-            loss = float(lsum / jnp.maximum(n, 1.0)) + 0.5 * float(reg) * float(
-                jnp.sum(w * w)
-            )
+            loss = stream_objective(lsum, n, reg, w)
     if checkpoint_path:
         import os
 

@@ -70,10 +70,12 @@ class DataPlaneClient:
         input_col: str = "features",
         label_col: str = "label",
         n_cols: Optional[int] = None,
+        params: Optional[Dict[str, Any]] = None,
     ) -> int:
         """Feed one batch. ``data``: an Arrow Table/RecordBatch, or an
-        (n, d) ndarray (optionally a (x, y) tuple for linreg). Returns the
-        job's total accumulated rows."""
+        (n, d) ndarray (optionally a (x, y) tuple for linreg/logreg).
+        ``params`` configures job creation on the first feed (kmeans needs
+        {"k": ...}). Returns the job's total accumulated rows."""
         import pyarrow as pa
 
         from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
@@ -103,10 +105,18 @@ class DataPlaneClient:
                 "input_col": input_col,
                 "label_col": label_col,
                 "n_cols": n_cols,
+                "params": params or {},
             },
             payload=sink.getvalue().to_pybytes(),
         )
         return int(resp["rows"])
+
+    def step(self, job: str, params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Pass boundary for iterative jobs (kmeans/logreg): apply the
+        Lloyd/Newton update over the pass's accumulated statistics and
+        return convergence info ({"iteration", "moved2"|"delta", ...})."""
+        resp, _ = self._roundtrip({"op": "step", "job": job, "params": params or {}})
+        return {k: v for k, v in resp.items() if k != "ok"}
 
     def status(self, job: str) -> Dict[str, Any]:
         resp, _ = self._roundtrip({"op": "status", "job": job})
@@ -141,4 +151,16 @@ class DataPlaneClient:
 
     def finalize_linreg(self, job: str, **params) -> Dict[str, np.ndarray]:
         arrays, _ = self.finalize(job, params)
+        return arrays
+
+    def finalize_kmeans(self, job: str) -> Dict[str, np.ndarray]:
+        """Model after the last ``step``: {"centers", "cost", "n_iter"}.
+        ``cost`` is the (unstepped) current pass's accumulated inertia —
+        feed one extra pass without stepping to read the final cost."""
+        arrays, _ = self.finalize(job, {})
+        return arrays
+
+    def finalize_logreg(self, job: str) -> Dict[str, np.ndarray]:
+        """Model after the last ``step``: {"coefficients", "intercept", "n_iter"}."""
+        arrays, _ = self.finalize(job, {})
         return arrays

@@ -9,6 +9,20 @@ RapidsRowMatrix.scala:139). Feeds to different jobs interleave freely.
 Jobs: "pca" folds (count, Σx, XᵀX); "linreg" folds (XᵀX, Xᵀy, Σx, Σy,
 Σy², n). ``finalize`` runs the algorithm's shared finalize (eigensolve /
 normal-equations solve) and streams the result arrays back.
+
+Iterative jobs: "kmeans" and "logreg" are MULTI-PASS — executors re-feed
+the dataset once per iteration (Lloyd / Newton) against the job's current
+iterate, and the driver calls ``step`` at each pass boundary to apply the
+update and read convergence info (moved² / delta), deciding whether to
+run another pass. ``finalize`` then returns the model. This is the
+daemon-side face of models.kmeans.fit_kmeans_stream /
+models.logistic_regression.fit_logistic_stream.
+
+KMeans center seeding uses the FIRST batch that arrives: with several
+executors feeding concurrently, which batch wins the race is
+nondeterministic, so the same seed can yield different inits run to run.
+For a reproducible init, have the driver (or one designated task) feed a
+seeding batch of ≥ k rows before fanning out the rest.
 """
 
 from __future__ import annotations
@@ -32,7 +46,12 @@ logger = get_logger("serve.daemon")
 class _Job:
     """One accumulation job: device state + its fold function + a lock."""
 
-    def __init__(self, algo: str, n_cols: int, mesh):
+    def __init__(self, algo: str, n_cols: int, mesh, params: Optional[Dict[str, Any]] = None):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu import config
+
+        params = params or {}
         self.algo = algo
         self.n_cols = n_cols
         self.mesh = mesh
@@ -42,6 +61,9 @@ class _Job:
         self.n_data = mesh.shape[DATA_AXIS]
         self.x_sharding = row_sharding(mesh)
         self.v_sharding = row_sharding(mesh, ndim=1)
+        self.iteration = 0
+        self.pass_rows = 0
+        self._accum = jnp.dtype(config.get("accum_dtype"))
         if algo == "pca":
             self.state = gram_ops.init_stats(n_cols)
             self.update = gram_ops.streaming_update(mesh)
@@ -53,8 +75,42 @@ class _Job:
 
             self.state = init_normal_eq_stats(n_cols)
             self.update = streaming_normal_eq_update(mesh)
+        elif algo == "kmeans":
+            from spark_rapids_ml_tpu.models.kmeans import _stream_step_fn
+
+            self.k = int(params.get("k", 0))
+            if self.k <= 0:
+                raise ValueError("kmeans job needs params={'k': > 0} on first feed")
+            self.seed = int(params.get("seed", 0))
+            self.init = str(params.get("init", "k-means++"))
+            if self.init not in ("k-means++", "random"):
+                raise ValueError(f"unknown init {self.init!r} (k-means++|random)")
+            self.centers = None  # initialized from the first batch's rows
+            self.update = _stream_step_fn(
+                mesh, self.k, config.get("compute_dtype"), config.get("accum_dtype")
+            )
+            self.state = self._kmeans_zero_state()
+        elif algo == "logreg":
+            from spark_rapids_ml_tpu.models.logistic_regression import (
+                _stream_grad_hess_fn,
+            )
+
+            self.w = jnp.zeros((n_cols,), self._accum)
+            self.b = jnp.zeros((), self._accum)
+            self.update = _stream_grad_hess_fn(mesh, config.get("accum_dtype"))
+            self.state = self._logreg_zero_state()
         else:
-            raise ValueError(f"unknown algo {algo!r} (pca|linreg)")
+            raise ValueError(f"unknown algo {algo!r} (pca|linreg|kmeans|logreg)")
+
+    def _kmeans_zero_state(self):
+        from spark_rapids_ml_tpu.models.kmeans import stream_zero_state
+
+        return stream_zero_state(self.k, self.n_cols, self._accum)
+
+    def _logreg_zero_state(self):
+        from spark_rapids_ml_tpu.models.logistic_regression import stream_zero_state
+
+        return stream_zero_state(self.n_cols, self._accum)
 
     def _bucket(self, n: int) -> int:
         """Pad target: next power of two (≥ data-axis size).
@@ -72,8 +128,8 @@ class _Job:
     def fold(self, x: np.ndarray, y: Optional[np.ndarray]) -> None:
         if x.shape[1] != self.n_cols:
             raise ValueError(f"batch width {x.shape[1]} != job n_cols {self.n_cols}")
-        if self.algo == "linreg" and y is None:
-            raise ValueError("linreg feed needs a label column")
+        if self.algo in ("linreg", "logreg") and y is None:
+            raise ValueError(f"{self.algo} feed needs a label column")
         n = x.shape[0]
         target = self._bucket(n)
         xb = np.zeros((target,) + x.shape[1:], dtype=x.dtype)
@@ -83,16 +139,98 @@ class _Job:
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped; rows not accepted")
+            if self.algo == "kmeans" and self.centers is None:
+                if n < self.k:
+                    raise ValueError(
+                        f"first kmeans batch has {n} rows < k={self.k}; "
+                        f"feed a larger first batch (it seeds the centers)"
+                    )
+                import jax.numpy as jnp
+
+                from spark_rapids_ml_tpu.models.kmeans import (
+                    _kmeans_plus_plus,
+                    _random_init,
+                )
+
+                init_fn = (
+                    _kmeans_plus_plus if self.init == "k-means++" else _random_init
+                )
+                c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
+                self.centers = jnp.asarray(c0, self._accum)
             xs = jax.device_put(xb, self.x_sharding)
             ms = jax.device_put(mb, self.v_sharding)
             if self.algo == "pca":
                 self.state = self.update(self.state, xs, ms)
+            elif self.algo == "kmeans":
+                self.state = self.update(self.state, self.centers, xs, ms)
+            elif self.algo == "logreg":
+                yb = np.zeros((target,), dtype=np.float32)
+                yb[:n] = np.asarray(y).reshape(-1)
+                ys = jax.device_put(yb, self.v_sharding)
+                self.state = self.update(self.state, self.w, self.b, xs, ys, ms)
             else:
                 yb = np.zeros((target,), dtype=np.asarray(y).dtype)
                 yb[:n] = np.asarray(y).reshape(-1)
                 ys = jax.device_put(yb, self.v_sharding)
                 self.state = self.update(self.state, xs, ys, ms)
             self.rows += n
+            self.pass_rows += n
+
+    def step(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Pass boundary for iterative jobs: apply the update at the end of
+        one full dataset scan, reset the pass accumulator, and report
+        convergence info for the driver's stop decision."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            if self.algo not in ("kmeans", "logreg"):
+                raise ValueError(
+                    f"algo {self.algo!r} is single-pass; step not applicable"
+                )
+            if self.pass_rows == 0:
+                # A retried/premature step over an empty pass would corrupt
+                # the iterate (zero Hessian solve / moved2=0 fake converge).
+                raise ValueError(
+                    "step with no rows fed this pass (duplicate step retry, "
+                    "or executors have not fed yet)"
+                )
+            if self.algo == "kmeans":
+                from spark_rapids_ml_tpu.models.kmeans import apply_lloyd_update
+
+                sums, counts, cost = self.state
+                self.centers, moved2 = apply_lloyd_update(sums, counts, self.centers)
+                self.state = self._kmeans_zero_state()
+                self.iteration += 1
+                info = {
+                    "iteration": self.iteration,
+                    "moved2": float(moved2),
+                    "cost": float(cost),
+                    "pass_rows": self.pass_rows,
+                }
+                self.pass_rows = 0
+                return info
+            from spark_rapids_ml_tpu.models.logistic_regression import (
+                _stream_newton_step_fn,
+                stream_objective,
+            )
+
+            reg = float(params.get("reg", 0.0))
+            gw, gb, hww, hwb, hbb, lsum, n = self.state
+            newton = _stream_newton_step_fn(
+                reg, bool(params.get("fit_intercept", True)), self._accum.name
+            )
+            loss = stream_objective(lsum, n, reg, self.w)
+            self.w, self.b, delta = newton(gw, gb, hww, hwb, hbb, n, self.w, self.b)
+            self.state = self._logreg_zero_state()
+            self.iteration += 1
+            info = {
+                "iteration": self.iteration,
+                "delta": float(delta),
+                "loss": loss,
+                "pass_rows": self.pass_rows,
+            }
+            self.pass_rows = 0
+            return info
 
     def finalize(self, params: Dict[str, Any], drop: bool = False) -> Dict[str, np.ndarray]:
         with self.lock:
@@ -105,6 +243,21 @@ class _Job:
             return result
 
     def _finalize_locked(self, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        if self.algo == "kmeans":
+            if self.centers is None:
+                raise ValueError("finalize before any feed: no centers")
+            _, _, cost = self.state
+            return {
+                "centers": np.asarray(jax.device_get(self.centers)),
+                "cost": np.asarray([float(cost)]),
+                "n_iter": np.asarray([self.iteration]),
+            }
+        if self.algo == "logreg":
+            return {
+                "coefficients": np.asarray(jax.device_get(self.w)),
+                "intercept": np.asarray(jax.device_get(self.b)).reshape(1),
+                "n_iter": np.asarray([self.iteration]),
+            }
         if self.algo == "pca":
             from spark_rapids_ml_tpu.models.pca import finalize_pca_stats
 
@@ -235,6 +388,10 @@ class DataPlaneDaemon:
             self._op_feed(conn, req)
         elif op == "finalize":
             self._op_finalize(conn, req)
+        elif op == "step":
+            job = self._get_job(req)
+            info = job.step(req.get("params", {}))
+            protocol.send_json(conn, {"ok": True, **info})
         elif op == "status":
             job = self._get_job(req)
             protocol.send_json(
@@ -277,15 +434,34 @@ class DataPlaneDaemon:
         # feed doesn't leave an orphan empty job (with its d×d device
         # buffers) parked under the name forever.
         y = None
-        if req_algo == "linreg":
+        if req_algo in ("linreg", "logreg"):
             label_col = req.get("label_col", "label")
             if label_col not in table.column_names:
                 raise KeyError(f"label column {label_col!r} not in batch")
             y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
+            if req_algo == "logreg":
+                from spark_rapids_ml_tpu.models.logistic_regression import (
+                    validate_binary_labels,
+                )
+
+                validate_binary_labels(y)
+        if req_algo == "kmeans":
+            # Validate the seeding constraint BEFORE registering: a first
+            # batch smaller than k must not leave an orphan centerless job
+            # parked under the name (whose params later feeds would
+            # silently inherit).
+            k_req = int((req.get("params") or {}).get("k", 0))
+            with self._jobs_lock:
+                is_new = name not in self._jobs
+            if is_new and x.shape[0] < k_req:
+                raise ValueError(
+                    f"first kmeans batch has {x.shape[0]} rows < k={k_req}; "
+                    f"feed a larger first batch (it seeds the centers)"
+                )
         with self._jobs_lock:
             job = self._jobs.get(name)
             if job is None:
-                job = _Job(req_algo, x.shape[1], self._mesh)
+                job = _Job(req_algo, x.shape[1], self._mesh, req.get("params"))
                 self._jobs[name] = job
         if job.algo != req_algo:
             raise ValueError(

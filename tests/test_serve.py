@@ -179,3 +179,108 @@ def test_randomized_solver_over_the_wire(daemon, data, mesh8):
         out = c.finalize_pca("rnd", k=3, solver="randomized")
     ref = fit_pca(data, k=3, mesh=mesh8, solver="full")
     np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-6)
+
+
+def test_kmeans_iterative_job_matches_stream_fit(daemon, rng, mesh8):
+    # Daemon-side Lloyd must match fit_kmeans_stream given the same init
+    # (both seed centers from the head of the data with the same rng).
+    from spark_rapids_ml_tpu.models.kmeans import fit_kmeans_stream
+
+    centers_true = rng.normal(size=(4, 12)) * 10
+    pts = np.concatenate(
+        [c + rng.normal(size=(200, 12)) for c in centers_true]
+    ).astype(np.float32)
+    perm = rng.permutation(len(pts))
+    pts = pts[perm]
+    parts = np.array_split(pts, 4)
+    k, seed, passes = 4, 7, 8
+
+    with _client(daemon) as c:
+        for it in range(passes):
+            for p in parts:
+                c.feed(
+                    "job-km", p, algo="kmeans",
+                    params={"k": k, "seed": seed, "init": "random"},
+                )
+            info = c.step("job-km")
+            assert info["iteration"] == it + 1
+            assert info["pass_rows"] == len(pts)
+        # one extra unstepped pass so finalize reports the final cost
+        for p in parts:
+            c.feed("job-km", p, algo="kmeans", params={"k": k})
+        out = c.finalize_kmeans("job-km")
+
+    # Reference: fit_kmeans_stream with random init over the first batch,
+    # same seed -> same init rows (daemon seeds from its first batch).
+    def source():
+        return iter(parts)
+
+    ref = fit_kmeans_stream(
+        source, k=k, n_cols=12, max_iter=passes, tol=0.0, seed=seed,
+        init="random", init_sample_rows=len(parts[0]), mesh=mesh8,
+    )
+    np.testing.assert_allclose(
+        np.sort(out["centers"], axis=0), np.sort(ref.centers, axis=0), atol=1e-3
+    )
+    np.testing.assert_allclose(out["cost"][0], ref.cost, rtol=1e-5)
+
+
+def test_logreg_iterative_job_matches_stream_fit(daemon, rng, mesh8):
+    from spark_rapids_ml_tpu.models.logistic_regression import fit_logistic_stream
+
+    w_true = rng.normal(size=10)
+    x = rng.normal(size=(1200, 10)).astype(np.float32)
+    y = (x @ w_true + 0.2 > 0).astype(np.float32)
+    parts = [(x[i : i + 300], y[i : i + 300]) for i in range(0, 1200, 300)]
+    reg, passes = 1e-3, 12
+
+    with _client(daemon) as c:
+        for it in range(passes):
+            for px, py in parts:
+                c.feed("job-lr", (px, py), algo="logreg")
+            info = c.step("job-lr", params={"reg": reg})
+            assert info["iteration"] == it + 1
+        out = c.finalize_logreg("job-lr")
+
+    def source():
+        return iter(parts)
+
+    ref = fit_logistic_stream(
+        source, n_cols=10, reg=reg, max_iter=passes, tol=0.0, mesh=mesh8
+    )
+    np.testing.assert_allclose(out["coefficients"], ref.coefficients, atol=1e-5)
+    np.testing.assert_allclose(out["intercept"][0], ref.intercept, atol=1e-5)
+
+
+def test_step_on_single_pass_job_rejected(daemon, rng):
+    with _client(daemon) as c:
+        c.feed("job-p", rng.normal(size=(64, 6)), algo="pca")
+        with pytest.raises(RuntimeError, match="single-pass"):
+            c.step("job-p")
+
+
+def test_step_with_empty_pass_rejected(daemon, rng):
+    # A duplicate/premature step must error, not corrupt the iterate.
+    with _client(daemon) as c:
+        c.feed("job-km2", rng.normal(size=(64, 6)), algo="kmeans", params={"k": 4})
+        c.step("job-km2")  # legitimate pass boundary
+        with pytest.raises(RuntimeError, match="no rows fed"):
+            c.step("job-km2")
+
+
+def test_kmeans_first_batch_smaller_than_k_rejected_cleanly(daemon, rng):
+    with _client(daemon) as c:
+        with pytest.raises(RuntimeError, match="seeds the centers"):
+            c.feed("job-km3", rng.normal(size=(3, 6)), algo="kmeans", params={"k": 8})
+        # The rejected first feed must not leave an orphan job: a retry
+        # with a proper batch (and its params) succeeds from scratch.
+        c.feed("job-km3", rng.normal(size=(64, 6)), algo="kmeans", params={"k": 8})
+        assert c.step("job-km3")["iteration"] == 1
+
+
+def test_logreg_nonbinary_labels_rejected(daemon, rng):
+    x = rng.normal(size=(32, 4))
+    y = rng.integers(0, 3, size=32).astype(np.float64)
+    with _client(daemon) as c:
+        with pytest.raises(RuntimeError, match="binary"):
+            c.feed("job-lr2", (x, y), algo="logreg")
