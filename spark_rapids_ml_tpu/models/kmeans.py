@@ -291,6 +291,9 @@ def fit_kmeans(
     init: str = "k-means++",
     mesh: Optional[Mesh] = None,
 ) -> KMeansSolution:
+    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
+
+    require_single_process("fit_kmeans (k-means++/random init samples local data)")
     mesh = mesh or default_mesh()
     x = np.asarray(x)
     n, d = x.shape
@@ -440,7 +443,9 @@ def fit_kmeans_stream(
     preemption-safety gap noted in SURVEY.md §5 "failure detection").
     """
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
+    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
 
+    require_single_process("fit_kmeans_stream (per-batch scans are host-driven)")
     if k <= 0:
         raise ValueError(f"k = {k} must be > 0")
     if init not in ("k-means++", "random"):

@@ -246,6 +246,9 @@ def fit_logistic_regression(
     tol: float = 1e-6,
     mesh: Optional[Mesh] = None,
 ) -> LogisticSolution:
+    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
+
+    require_single_process("fit_logistic_regression (n_classes inferred from local labels)")
     mesh = mesh or default_mesh()
     x = np.asarray(x)
     y = np.asarray(y).reshape(-1)
@@ -442,7 +445,9 @@ def fit_logistic_stream(
     interrupted fit resumes at the saved iteration.
     """
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
+    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
 
+    require_single_process("fit_logistic_stream (per-batch scans are host-driven)")
     mesh = mesh or default_mesh()
     ad = config.get("accum_dtype")
     accum = jnp.dtype(ad)

@@ -271,6 +271,9 @@ def fit_pca_stream(
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     solver = _resolve_solver(solver)  # fail fast, before consuming batches
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
+    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
+
+    require_single_process("fit_pca_stream (per-batch placement is host-driven)")
 
     mesh = mesh or default_mesh()
     update = gram_ops.streaming_update(mesh)

@@ -53,9 +53,19 @@ def shard_rows(
 ):
     """Pad + place a host matrix row-sharded on the mesh.
 
-    Returns (x_sharded, mask_sharded, n_true_rows). ``jax.device_put`` with a
-    NamedSharding splits the host buffer across devices without staging the
-    full array on any single device.
+    Returns (x_sharded, mask_sharded, n_true_rows). Single-process:
+    ``jax.device_put`` with a NamedSharding splits the host buffer across
+    devices without staging the full array on any single device.
+
+    Multi-process (``jax.process_count() > 1``): ``x`` is THIS process's
+    local rows (each host materializes only its slice —
+    ``parallel.distributed.process_local_rows`` gives the driver-side
+    split). Local row counts are allgathered to agree on a common
+    rows-per-device, each process pads its slice to that layout, and the
+    global array is assembled with
+    ``jax.make_array_from_process_local_data``; ``n_true_rows`` is the
+    GLOBAL row count. Padding sits at each process's tail, so per-device
+    shards keep the valid-prefix property the masked kernels rely on.
     """
     n_true = x.shape[0]
     n_data = mesh.shape[DATA_AXIS]
@@ -68,7 +78,68 @@ def shard_rows(
             x = cast if cast is not None else x.astype(np.float32)
         else:
             x = x.astype(dtype)
+    if jax.process_count() > 1:
+        return _shard_rows_multiprocess(x, mesh, with_mask)
     x, mask = pad_rows(x, n_data)
     xs = jax.device_put(x, row_sharding(mesh, x.ndim))
     ms = jax.device_put(mask, row_sharding(mesh, 1)) if with_mask else None
     return xs, ms, n_true
+
+
+def require_single_process(feature: str) -> None:
+    """Fail fast (identically on every process) for code whose host-side
+    preparation depends on local data — running it multi-process would
+    diverge replicated inputs or desync collectives instead of erroring."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{feature} is single-controller only: its host-side setup "
+            f"(init/validation) is data-dependent and would diverge across "
+            f"processes. Multi-process paths: fit_pca / fit_linear_regression "
+            f"with per-process local rows, or the data-plane daemon on one host."
+        )
+
+
+def _shard_rows_multiprocess(x: np.ndarray, mesh: Mesh, with_mask: bool):
+    from jax.experimental import multihost_utils as mhu
+
+    n_data = mesh.shape[DATA_AXIS]
+    model = mesh.shape.get(MODEL_AXIS, 1)
+    # This process's share of the MESH's devices (a mesh may cover a
+    # subset, and hosts may own unequal counts) — not local_device_count.
+    pidx = jax.process_index()
+    local_in_mesh = sum(1 for dev in mesh.devices.flat if dev.process_index == pidx)
+    data_devs_local = local_in_mesh // model
+    if data_devs_local == 0 and x.shape[0] > 0:
+        raise ValueError(
+            f"process {pidx} owns no devices of this mesh but was given "
+            f"{x.shape[0]} rows; feed rows only from processes in the mesh"
+        )
+    # Consensus layout: allgather (rows, data-devices) per process; the
+    # common per-device row count is the max requirement over processes,
+    # so every device's slice lands inside its owner's local buffer.
+    stats = np.asarray(
+        mhu.process_allgather(np.asarray([x.shape[0], data_devs_local]))
+    ).reshape(-1, 2)
+    n_true_global = int(stats[:, 0].sum())
+    per_dev = 1
+    for rows_i, devs_i in stats:
+        if devs_i > 0:
+            per_dev = max(per_dev, int(-(-rows_i // devs_i)))
+    local_rows = per_dev * data_devs_local
+    if x.shape[0] == 0:  # a process can own zero rows of a tiny dataset
+        xl = np.zeros((local_rows,) + x.shape[1:], dtype=x.dtype)
+        mask = np.zeros((local_rows,), dtype=np.float32) if with_mask else None
+    else:
+        xl, mask = pad_rows(x, local_rows)  # x.shape[0] <= local_rows by construction
+    global_rows = per_dev * n_data
+    xs = jax.make_array_from_process_local_data(
+        row_sharding(mesh, x.ndim), xl, (global_rows,) + x.shape[1:]
+    )
+    ms = (
+        jax.make_array_from_process_local_data(
+            row_sharding(mesh, 1), mask, (global_rows,)
+        )
+        if with_mask
+        else None
+    )
+    return xs, ms, n_true_global

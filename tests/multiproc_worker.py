@@ -1,0 +1,65 @@
+"""Worker for the multi-process distributed test (see test_multiprocess.py).
+
+Each process: initialize the distributed runtime (our wrapper), build the
+global mesh, materialize ONLY its local row slice, run the sharded PCA fit,
+and have process 0 print the result as JSON. This is the multi-node
+coverage the reference lacks entirely (SURVEY.md §4: "no
+multi-executor/multi-node test").
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    n_procs = int(sys.argv[2])
+    port = sys.argv[3]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from spark_rapids_ml_tpu.parallel.distributed import (
+        global_mesh,
+        initialize_cluster,
+        process_local_rows,
+    )
+
+    initialize_cluster(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n_procs,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == n_procs
+
+    import numpy as np
+
+    from spark_rapids_ml_tpu.models.pca import fit_pca
+
+    # Deterministic dataset; every process computes the full array but
+    # feeds only its local slice (how a real loader would behave).
+    rng = np.random.default_rng(0)
+    n, d, k = 603, 16, 3  # odd count: exercises uneven per-process padding
+    x = rng.normal(size=(n, d)) * np.logspace(0, -1.0, d)
+    lo, hi = process_local_rows(n)
+
+    mesh = global_mesh()
+    sol = fit_pca(x[lo:hi], k=k, mean_center=True, mesh=mesh)
+    if jax.process_index() == 0:
+        print(
+            json.dumps(
+                {
+                    "pc": np.asarray(sol.pc).tolist(),
+                    "ev": np.asarray(sol.explained_variance).tolist(),
+                    "n_rows": sol.n_rows,
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Run-to-run determinism: the TPU-world substitute for sanitizers.
+
+The reference's only concurrency-safety mechanism is per-thread default
+CUDA streams (SURVEY.md §5 "race detection": compile flag
+``CUDA_API_PER_THREAD_DEFAULT_STREAM``); on TPU, XLA owns ordering, so the
+corresponding guarantee to pin down is bitwise run-to-run determinism of
+every fit — two identical calls must produce identical bits, including
+across the collective (psum/all_gather/ppermute) paths on the 8-device
+mesh. A nondeterministic reduction order would show up here first.
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
+from spark_rapids_ml_tpu.models.knn import build_ivf_flat, _ivf_query_fn
+from spark_rapids_ml_tpu.models.linear_regression import fit_linear_regression
+from spark_rapids_ml_tpu.models.logistic_regression import fit_logistic_regression
+from spark_rapids_ml_tpu.models.pca import fit_pca
+
+
+def _bits(a) -> bytes:
+    return np.ascontiguousarray(np.asarray(a)).tobytes()
+
+
+def test_pca_bitwise_deterministic(rng, mesh8):
+    x = rng.normal(size=(500, 24))
+    a = fit_pca(x, k=4, mesh=mesh8)
+    b = fit_pca(x, k=4, mesh=mesh8)
+    assert _bits(a.pc) == _bits(b.pc)
+    assert _bits(a.explained_variance) == _bits(b.explained_variance)
+
+
+def test_pca_ring_bitwise_deterministic(rng):
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(data=4, model=2)
+    x = rng.normal(size=(512, 32))
+    with config.option("gram_algorithm", "ring"):
+        a = fit_pca(x, k=4, mesh=mesh)
+        b = fit_pca(x, k=4, mesh=mesh)
+    assert _bits(a.pc) == _bits(b.pc)
+
+
+def test_kmeans_bitwise_deterministic(rng, mesh8):
+    x = rng.normal(size=(640, 16))
+    a = fit_kmeans(x, k=5, max_iter=10, seed=3, mesh=mesh8)
+    b = fit_kmeans(x, k=5, max_iter=10, seed=3, mesh=mesh8)
+    assert _bits(a.centers) == _bits(b.centers)
+    assert a.cost == b.cost and a.n_iter == b.n_iter
+
+
+def test_linreg_bitwise_deterministic(rng, mesh8):
+    x = rng.normal(size=(400, 12))
+    y = x @ rng.normal(size=12) + 0.1 * rng.normal(size=400)
+    a = fit_linear_regression(x, y, reg=1e-4, mesh=mesh8)
+    b = fit_linear_regression(x, y, reg=1e-4, mesh=mesh8)
+    assert _bits(a.coefficients) == _bits(b.coefficients)
+    assert a.intercept == b.intercept
+
+
+def test_logreg_bitwise_deterministic(rng, mesh8):
+    x = rng.normal(size=(400, 12))
+    y = (x @ rng.normal(size=12) > 0).astype(np.float64)
+    a = fit_logistic_regression(x, y, reg=1e-3, max_iter=15, mesh=mesh8)
+    b = fit_logistic_regression(x, y, reg=1e-3, max_iter=15, mesh=mesh8)
+    assert _bits(a.coefficients) == _bits(b.coefficients)
+
+
+def test_ivf_query_bitwise_deterministic(rng):
+    import jax.numpy as jnp
+
+    db = rng.normal(size=(1024, 16)).astype(np.float32)
+    queries = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    index = build_ivf_flat(db, nlist=64, seed=0)
+    dev = [
+        jnp.asarray(index.centroids, jnp.float32),
+        jnp.asarray(index.lists),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+    ]
+    q = _ivf_query_fn(10, 8, "float32", "float32", mode="bucketed")
+    d1, i1 = q(*dev, queries)
+    d2, i2 = q(*dev, queries)
+    assert _bits(i1) == _bits(i2)
+    assert _bits(d1) == _bits(d2)
+
+
+def test_index_build_deterministic(rng):
+    db = rng.normal(size=(1024, 16)).astype(np.float32)
+    a = build_ivf_flat(db, nlist=32, seed=5)
+    b = build_ivf_flat(db, nlist=32, seed=5)
+    assert _bits(a.centroids) == _bits(b.centroids)
+    assert _bits(a.list_ids) == _bits(b.list_ids)

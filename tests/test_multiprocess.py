@@ -1,0 +1,73 @@
+"""True multi-process distributed runtime test.
+
+Spawns 2 OS processes, each with 2 virtual CPU devices, joined through
+``jax.distributed`` (our ``initialize_cluster`` wrapper) into one 4-device
+job. Each process feeds only its local row slice
+(``process_local_rows`` + the multi-process branch of ``shard_rows``,
+which assembles the global array with
+``jax.make_array_from_process_local_data``); the fitted PCA must match a
+single-process fit of the full dataset. This validates the cross-process
+psum path (Gloo collectives here; ICI/DCN on real pods) end to end —
+coverage the reference has no analogue of (SURVEY.md §4).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_pca_matches_single_process():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "multiproc_worker.py")
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # the workers set their own backend/device config
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_ENABLE_X64")
+    }
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout={out!r}\nstderr={err[-2000:]!r}"
+
+    result = json.loads(outs[0][1].decode().strip().splitlines()[-1])
+    assert result["n_rows"] == 603
+
+    # Single-process oracle over the same data.
+    rng = np.random.default_rng(0)
+    n, d, k = 603, 16, 3
+    x = rng.normal(size=(n, d)) * np.logspace(0, -1.0, d)
+    from spark_rapids_ml_tpu.models.pca import fit_pca
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    ref = fit_pca(x, k=k, mean_center=True, mesh=make_mesh(data=4, model=1))
+    np.testing.assert_allclose(
+        np.abs(np.asarray(result["pc"])), np.abs(ref.pc), atol=1e-8
+    )
+    np.testing.assert_allclose(
+        np.asarray(result["ev"]), ref.explained_variance, atol=1e-10
+    )
