@@ -259,6 +259,154 @@ def build_ivf_flat(
     return IVFFlatIndex(centroids, lists, list_ids, list_mask)
 
 
+def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
+    """Per-list query capacity C = min(q, ceil(q*nprobe/nlist * slack)),
+    lane-rounded. At C == q no (query, list) pair can ever be dropped."""
+    cap = int(np.ceil(q * nprobe / nlist * slack))
+    return min(q, max(8, ((cap + 7) // 8) * 8))
+
+
+def _bucketed_core(
+    qc, queries, probe, lists, list_ids, list_mask, list_norms,
+    n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
+    list_block: int = 32,
+):
+    """The capacity-bucketed scorer over ONE device's lists.
+
+    ``probe``: (q, nprobe) list indices INTO ``lists``; -1 marks pairs this
+    device does not own (the sharded executor localizes global probe ids
+    and marks the rest -1 — they are dropped here and satisfied by the
+    owning device). Returns (dists (q, k) exact f32 ascending, ids (q, k);
+    +inf/-1 where fewer than k candidates exist locally).
+
+    See _ivf_query_fn's docstring for the full algorithm: eviction-ordered
+    capacity bucketing, batched per-list-block GEMMs, position-only scan,
+    and the exact f32 rerank.
+    """
+    q = queries.shape[0]
+    nlist, maxlen, d = lists.shape
+    n_pairs = q * nprobe
+
+    # --- bucket (query, list) pairs by list with capacity C ---
+    # Non-owned pairs take the sentinel list id ``nlist``: they sort last,
+    # scatter out of bounds (dropped), and never hold capacity.
+    flat_list = jnp.where(probe >= 0, probe, nlist).reshape(-1)
+    flat_query = jnp.repeat(jnp.arange(q, dtype=jnp.int32), nprobe)
+    # Eviction order when a hot list overflows its capacity, least
+    # valuable dropped first: (1) padding queries (rows >= n_valid);
+    # (2) higher probe rank — a query's least promising list costs the
+    # least recall; (3) within a rank, a RANK-KEYED rotated query order so
+    # correlated query batches spread across their probed lists instead of
+    # the same C winners taking every list.
+    flat_rank = jnp.tile(jnp.arange(nprobe, dtype=jnp.int32), q)
+    rot = (flat_query + flat_rank * C) % q
+    flat_rank = jnp.where(flat_query >= n_valid, nprobe, flat_rank)
+    # Lexicographic (list, rank, rot) via two stable argsorts.
+    o1 = jnp.argsort(rot, stable=True)
+    key2 = (flat_list * (nprobe + 2) + flat_rank)[o1]
+    order = o1[jnp.argsort(key2, stable=True)]
+    sl = flat_list[order]
+    sq_ids = flat_query[order]
+    counts = jnp.zeros((nlist + 1,), jnp.int32).at[flat_list].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:-1]).astype(jnp.int32)]
+    )  # (nlist + 1,): entry nlist serves the sentinel (slot value unused)
+    slot = jnp.arange(n_pairs, dtype=jnp.int32) - starts[sl]
+    keep = (slot < C) & (sl < nlist)
+    bucket_q = (
+        jnp.full((nlist, C), -1, jnp.int32)
+        .at[jnp.where(keep, sl, nlist), jnp.where(keep, slot, 0)]
+        .set(sq_ids, mode="drop")
+    )
+    # Per original (query, probe) pair: its slot in its list (-1 = dropped).
+    slot_unsorted = (
+        jnp.full((n_pairs,), -1, jnp.int32)
+        .at[order]
+        .set(jnp.where(keep, slot, -1))
+    )
+    pair_slot = slot_unsorted.reshape(q, nprobe)
+    pair_list = jnp.where(probe >= 0, probe, 0)  # dropped pairs masked via pair_slot
+
+    nblk = -(-nlist // list_block)
+    pad = nblk * list_block - nlist
+    lists_p = jnp.pad(lists, ((0, pad), (0, 0), (0, 0)))
+    ids_p = jnp.pad(list_ids, ((0, pad), (0, 0)), constant_values=-1)
+    msk_p = jnp.pad(list_mask, ((0, pad), (0, 0)))
+    bq_p = jnp.pad(bucket_q, ((0, pad), (0, 0)), constant_values=-1)
+    # Masked row norms (precomputed index data): padded rows carry a huge
+    # norm so they never win a top-k.
+    norms_p = jnp.pad(list_norms.astype(accum_dtype), ((0, pad), (0, 0)))
+    r2_all = jnp.where(msk_p > 0, norms_p, jnp.asarray(1e30, accum_dtype))
+    # 2k-wide per-(list, slot) shortlist: selection runs on the compute
+    # dtype's noisy scores; the exact rerank recovers boundary swaps.
+    blk_k = min(2 * k, maxlen)
+    if nprobe * blk_k < k:
+        raise ValueError(
+            f"k={k} exceeds the bucketed candidate pool nprobe*maxlen="
+            f"{nprobe * maxlen}; raise nprobe or use mode='dense'"
+        )
+
+    def body(_, b):
+        qidx = jax.lax.dynamic_slice(bq_p, (b * list_block, 0), (list_block, C))
+        qv = qc[jnp.maximum(qidx, 0)]  # (L, C, d) gather of query vectors
+        rows = jax.lax.dynamic_slice(
+            lists_p, (b * list_block, 0, 0), (list_block, maxlen, d)
+        ).astype(compute_dtype)
+        r2 = jax.lax.dynamic_slice(r2_all, (b * list_block, 0), (list_block, maxlen))
+        # Batched MXU GEMM: each list scores only its assigned queries.
+        # Full precision for f32 compute (TPU's DEFAULT is bf16-mantissa).
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        with mm_precision(compute_dtype):
+            qr = jnp.einsum(
+                "lcd,lmd->lcm", qv, rows, preferred_element_type=accum_dtype
+            )
+        # Ranking score r2 - 2qr: the per-query ||q||^2 constant cannot
+        # change a per-row argmin and the rerank restores true distances.
+        d2 = r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
+        # 0.95 within-list recall: recall_target=1.0 degenerates to a full
+        # per-row sort (4x the einsum+selection cost); misses concentrate
+        # at the k-th boundary and the 2k shortlist + rerank absorbs them.
+        bd, bpos = jax.lax.approx_min_k(
+            d2.reshape(list_block * C, maxlen), blk_k, recall_target=0.95
+        )
+        # Positions, not ids: the in-scan per-row id gather measured ~2x
+        # the GEMM+selection cost; ids resolve once for the winners.
+        return _, (
+            bd.reshape(list_block, C, blk_k),
+            bpos.reshape(list_block, C, blk_k).astype(jnp.int32),
+        )
+
+    _, (res_d, res_p) = jax.lax.scan(body, None, jnp.arange(nblk))
+    res_d = res_d.reshape(nblk * list_block, C, blk_k)
+    res_p = res_p.reshape(nblk * list_block, C, blk_k)
+
+    # Gather each query's candidates back from its (list, slot) buckets.
+    ps = jnp.maximum(pair_slot, 0)
+    cand_d = res_d[pair_list, ps]  # (q, nprobe, blk_k)
+    cand_pos = res_p[pair_list, ps]
+    dropped = (pair_slot < 0)[:, :, None]
+    cand_d = jnp.where(dropped, jnp.inf, cand_d).reshape(q, nprobe * blk_k)
+    cand_pos = jnp.where(dropped, 0, cand_pos).reshape(q, nprobe * blk_k)
+    cand_list = jnp.broadcast_to(
+        pair_list[:, :, None], (q, nprobe, blk_k)
+    ).reshape(q, nprobe * blk_k)
+    # Exact rerank (the ScaNN two-stage): select a 4k-wide shortlist by
+    # approximate score, rescore exactly in f32 from the stored rows.
+    R = min(4 * k, nprobe * blk_k)
+    negR, posR = jax.lax.top_k(-cand_d, R)
+    wl = jnp.take_along_axis(cand_list, posR, axis=1)  # (q, R)
+    wp = jnp.take_along_axis(cand_pos, posR, axis=1)
+    ids_R = ids_p[wl, wp]  # (q, R); -1 for padded-row candidates
+    rows_R = lists_p[wl, wp].astype(accum_dtype)  # (q, R, d)
+    diff = rows_R - queries.astype(accum_dtype)[:, None, :]
+    exact_d = jnp.sum(diff * diff, axis=2)  # (q, R) — direct, exact f32
+    exact_d = jnp.where((ids_R < 0) | jnp.isinf(-negR), jnp.inf, exact_d)
+    neg, pos = jax.lax.top_k(-exact_d, k)
+    win_ids = jnp.where(jnp.isinf(neg), -1, jnp.take_along_axis(ids_R, pos, axis=1))
+    return jnp.maximum(-neg, 0.0), win_ids
+
+
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
                   slack: float = 2.0):
@@ -356,163 +504,16 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
     @jax.jit
     def query_bucketed(centroids, lists, list_ids, list_mask, queries, n_valid, list_norms):
         q = queries.shape[0]
-        nlist, maxlen, d = lists.shape
-        n_pairs = q * nprobe
-        cap = int(np.ceil(n_pairs / nlist * slack))
-        C = min(q, max(8, ((cap + 7) // 8) * 8))  # lane-friendly capacity
+        nlist = lists.shape[0]
+        C = _bucketed_capacity(q, nprobe, nlist, slack)
         qc = queries.astype(compute_dtype)
         cd2 = sq_euclidean(qc, centroids.astype(compute_dtype), accum_dtype=accum_dtype)
         _, probe = jax.lax.top_k(-cd2, nprobe)  # (q, nprobe)
-
-        # --- bucket (query, list) pairs by list with capacity C ---
-        flat_list = probe.reshape(-1)  # (P,)
-        flat_query = jnp.repeat(jnp.arange(q, dtype=jnp.int32), nprobe)
-        # Eviction order when a hot list overflows its capacity, least
-        # valuable dropped first: (1) padding queries (rows ≥ n_valid — the
-        # caller's power-of-2 batch padding must never evict real queries'
-        # pairs); (2) higher probe rank — a query's least promising list
-        # costs the least recall; (3) within a rank, a per-list ROTATED
-        # query order, so correlated query batches (many near-duplicates
-        # probing the same lists) spread across lists instead of the same
-        # C winners taking every list — each query keeps coverage of at
-        # least one probed list instead of some queries losing all nprobe.
-        flat_rank = jnp.tile(jnp.arange(nprobe, dtype=jnp.int32), q)
-        # Rotate by RANK, not list id: identical queries probe the same
-        # lists in the same rank order, so rank-keyed windows are disjoint
-        # across their nprobe lists ((query + rank·C) mod q covers every
-        # query once when rank·C spans q), while list-id-keyed rotation
-        # collides whenever two probed lists share a residue mod q/C.
-        rot = (flat_query + flat_rank * C) % q
-        flat_rank = jnp.where(flat_query >= n_valid, nprobe, flat_rank)
-        # Lexicographic (list, rank, rot) via two stable argsorts.
-        o1 = jnp.argsort(rot, stable=True)
-        key2 = (flat_list * (nprobe + 1) + flat_rank)[o1]
-        order = o1[jnp.argsort(key2, stable=True)]
-        sl = flat_list[order]
-        sq_ids = flat_query[order]
-        counts = jnp.zeros((nlist,), jnp.int32).at[flat_list].add(1)
-        starts = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+        return _bucketed_core(
+            qc, queries, probe, lists, list_ids, list_mask, list_norms,
+            n_valid, k, nprobe, C, compute_dtype, accum_dtype,
+            list_block=LIST_BLOCK,
         )
-        slot = jnp.arange(n_pairs, dtype=jnp.int32) - starts[sl]
-        keep = slot < C
-        # Overflow pairs scatter out of bounds and are dropped.
-        bucket_q = (
-            jnp.full((nlist, C), -1, jnp.int32)
-            .at[jnp.where(keep, sl, nlist), jnp.where(keep, slot, 0)]
-            .set(sq_ids, mode="drop")
-        )
-        # Per original (query, probe) pair: its slot in its list (-1 = dropped),
-        # for the gather-back after the block scan.
-        slot_unsorted = (
-            jnp.full((n_pairs,), -1, jnp.int32)
-            .at[order]
-            .set(jnp.where(keep, slot, -1))
-        )
-        pair_slot = slot_unsorted.reshape(q, nprobe)
-        pair_list = probe  # (q, nprobe)
-
-        nblk = -(-nlist // LIST_BLOCK)
-        pad = nblk * LIST_BLOCK - nlist
-        lists_p = jnp.pad(lists, ((0, pad), (0, 0), (0, 0)))
-        ids_p = jnp.pad(list_ids, ((0, pad), (0, 0)), constant_values=-1)
-        msk_p = jnp.pad(list_mask, ((0, pad), (0, 0)))
-        bq_p = jnp.pad(bucket_q, ((0, pad), (0, 0)), constant_values=-1)
-        # Masked row norms: padded rows carry a huge norm so they never win
-        # a top-k — this replaces a per-block (L, C, maxlen) mask pass.
-        # ``list_norms`` is pure index data; callers holding a long-lived
-        # index (the model, the benchmark) pass it precomputed so repeated
-        # query batches skip the full-database HBM sweep.
-        norms_p = jnp.pad(list_norms.astype(accum_dtype), ((0, pad), (0, 0)))
-        r2_all = jnp.where(msk_p > 0, norms_p, jnp.asarray(1e30, accum_dtype))
-        # 2k-wide per-(list, slot) shortlist: selection runs on the compute
-        # dtype's noisy scores, so keep margin for the exact rerank to
-        # recover boundary swaps (bf16: +0.08 recall@10 measured).
-        blk_k = min(2 * k, maxlen)
-        if nprobe * blk_k < k:
-            raise ValueError(
-                f"k={k} exceeds the bucketed candidate pool nprobe*maxlen="
-                f"{nprobe * maxlen}; raise nprobe or use mode='dense'"
-            )
-
-        def body(_, b):
-            qidx = jax.lax.dynamic_slice(
-                bq_p, (b * LIST_BLOCK, 0), (LIST_BLOCK, C)
-            )  # (L, C) query ids, -1 = empty slot
-            qv = qc[jnp.maximum(qidx, 0)]  # (L, C, d) gather of query vectors
-            rows = jax.lax.dynamic_slice(
-                lists_p, (b * LIST_BLOCK, 0, 0), (LIST_BLOCK, maxlen, d)
-            ).astype(compute_dtype)
-            r2 = jax.lax.dynamic_slice(
-                r2_all, (b * LIST_BLOCK, 0), (LIST_BLOCK, maxlen)
-            )
-            # Batched MXU GEMM: each list scores only its assigned queries.
-            # Full precision for f32 compute (TPU's DEFAULT is bf16-mantissa
-            # — measured ~0.8% distance error that reorders near-boundary
-            # neighbors and costs ~0.1 recall@10 on tight-margin data).
-            from spark_rapids_ml_tpu.ops.gram import mm_precision
-
-            with mm_precision(compute_dtype):
-                qr = jnp.einsum(
-                    "lcd,lmd->lcm", qv, rows, preferred_element_type=accum_dtype
-                )
-            # Ranking score r² − 2qr: the per-query ‖q‖² constant is added
-            # after the gather-back (it cannot change a per-row argmin).
-            # Padded rows lose via the 1e30 masked norm; empty slots score
-            # garbage but no (query, probe) pair ever gathers them.
-            d2 = r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
-            # 0.95 within-list recall: recall_target=1.0 degenerates to a
-            # full per-row sort and dominates the whole query (measured 4×
-            # the einsum+selection cost). The bucketed executor is the
-            # approximate path by construction (probing + capacity), and
-            # misses concentrate at the k-th boundary, not the near
-            # neighbors; the dense executor keeps the exact contract.
-            bd, bpos = jax.lax.approx_min_k(
-                d2.reshape(LIST_BLOCK * C, maxlen), blk_k, recall_target=0.95
-            )
-            # Return row POSITIONS, not ids: the in-scan per-row ids gather
-            # measured ~2× the einsum+selection cost; one global gather
-            # after the scan replaces all 64 of them.
-            return _, (
-                bd.reshape(LIST_BLOCK, C, blk_k),
-                bpos.reshape(LIST_BLOCK, C, blk_k).astype(jnp.int32),
-            )
-
-        _, (res_d, res_p) = jax.lax.scan(body, None, jnp.arange(nblk))
-        res_d = res_d.reshape(nblk * LIST_BLOCK, C, blk_k)
-        res_p = res_p.reshape(nblk * LIST_BLOCK, C, blk_k)
-
-        # Gather each query's candidates back from its (list, slot) buckets.
-        ps = jnp.maximum(pair_slot, 0)
-        cand_d = res_d[pair_list, ps]  # (q, nprobe, blk_k)
-        cand_pos = res_p[pair_list, ps]
-        dropped = (pair_slot < 0)[:, :, None]
-        cand_d = jnp.where(dropped, jnp.inf, cand_d).reshape(q, nprobe * blk_k)
-        cand_pos = jnp.where(dropped, 0, cand_pos).reshape(q, nprobe * blk_k)
-        cand_list = jnp.broadcast_to(
-            pair_list[:, :, None], (q, nprobe, blk_k)
-        ).reshape(q, nprobe * blk_k)
-        # Exact rerank (the ScaNN two-stage): the scan's scores carry the
-        # compute dtype's noise (bf16 reorders ~0.8%-apart neighbors, ~0.1
-        # recall@10 on tight-margin data), so select a 4k-wide shortlist by
-        # approximate score, rescore it exactly in f32 from the stored
-        # rows, and only then take the final top-k.
-        R = min(4 * k, nprobe * blk_k)
-        negR, posR = jax.lax.top_k(-cand_d, R)
-        wl = jnp.take_along_axis(cand_list, posR, axis=1)  # (q, R)
-        wp = jnp.take_along_axis(cand_pos, posR, axis=1)
-        ids_R = ids_p[wl, wp]  # (q, R); -1 for padded-row candidates
-        rows_R = lists_p[wl, wp].astype(accum_dtype)  # (q, R, d)
-        diff = rows_R - queries.astype(accum_dtype)[:, None, :]
-        exact_d = jnp.sum(diff * diff, axis=2)  # (q, R) — direct, exact f32
-        exact_d = jnp.where(
-            (ids_R < 0) | jnp.isinf(-negR), jnp.inf, exact_d
-        )
-        neg, pos = jax.lax.top_k(-exact_d, k)
-        win_ids = jnp.where(
-            jnp.isinf(neg), -1, jnp.take_along_axis(ids_R, pos, axis=1)
-        )
-        return jnp.maximum(-neg, 0.0), win_ids
 
     def query(centroids, lists, list_ids, list_mask, queries,
               n_valid=None, list_norms=None):
@@ -531,6 +532,81 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         return query_bucketed(
             centroids, lists, list_ids, list_mask, queries,
             jnp.asarray(n_valid, jnp.int32), list_norms,
+        )
+
+    return query
+
+
+@functools.lru_cache(maxsize=32)
+def _ivf_query_fn_sharded(
+    k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 2.0
+):
+    """Sharded IVF query: inverted lists sharded over the ``data`` mesh
+    axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
+    does not fit one chip).
+
+    Under ``shard_map``, every device probes the replicated centroids
+    (identical (q, nprobe) global probe set), localizes the probe ids to
+    its own list range (non-owned pairs marked -1 and satisfied by their
+    owning device), runs the capacity-bucketed scorer over its local
+    lists, and the per-device (q, k) exact-reranked candidates merge with
+    one ``all_gather`` over ICI + a final top-k — communication is
+    O(q·k·devices), independent of database size, the same merge shape as
+    the exact KNN. Always the bucketed (approximate) executor; list ids
+    stay global so returned ids need no translation.
+    """
+    compute_dtype = jnp.dtype(cd)
+    accum_dtype = jnp.dtype(ad)
+    n_data = mesh.shape[DATA_AXIS]
+
+    def shard(centroids, lists, list_ids, list_mask, list_norms, queries, n_valid):
+        q = queries.shape[0]
+        nlist_local = lists.shape[0]
+        qc = queries.astype(compute_dtype)
+        cd2 = sq_euclidean(
+            qc, centroids.astype(compute_dtype), accum_dtype=accum_dtype
+        )
+        _, probe = jax.lax.top_k(-cd2, nprobe)  # global list ids, replicated
+        lo = jax.lax.axis_index(DATA_AXIS).astype(jnp.int32) * nlist_local
+        local = (probe >= lo) & (probe < lo + nlist_local)
+        probe_local = jnp.where(local, probe - lo, -1)
+        C = _bucketed_capacity(q, nprobe, nlist_local * n_data, slack)
+        dists, ids = _bucketed_core(
+            qc, queries, probe_local, lists, list_ids, list_mask, list_norms,
+            n_valid, k, nprobe, C, compute_dtype, accum_dtype,
+        )
+        # Merge the per-device top-k: O(q·k·devices) over ICI.
+        cat_d = jax.lax.all_gather(dists, DATA_AXIS, axis=1, tiled=True)
+        cat_i = jax.lax.all_gather(ids, DATA_AXIS, axis=1, tiled=True)
+        neg, pos = jax.lax.top_k(-cat_d, k)
+        return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
+
+    f = jax.shard_map(
+        shard,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P(DATA_AXIS, None, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(),
+            P(),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,  # gathered candidates are value-replicated
+    )
+    jitted = jax.jit(f)
+
+    def query(centroids, lists, list_ids, list_mask, queries,
+              n_valid=None, list_norms=None):
+        if n_valid is None:
+            n_valid = queries.shape[0]
+        if list_norms is None:
+            list_norms = jnp.sum(jnp.square(lists.astype(accum_dtype)), axis=2)
+        return jitted(
+            centroids, lists, list_ids, list_mask, list_norms, queries,
+            jnp.asarray(n_valid, jnp.int32),
         )
 
     return query
@@ -602,6 +678,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         super().__init__(uid=uid)
         self.index = index
         self._dev_index = None  # device-resident index + norms cache
+        self._shard_mesh = None  # set by shard_index()
 
     def _model_data(self):
         return {
@@ -624,6 +701,46 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
     def _copy_extra_state(self, source):
         self.index = source.index
         self._dev_index = None
+        # Re-run the sharded placement (it pads nlist to a device multiple
+        # — an invariant _ensure_dev_index alone would not restore).
+        src_mesh = getattr(source, "_shard_mesh", None)
+        self._shard_mesh = None
+        if src_mesh is not None and self.index is not None:
+            self.shard_index(src_mesh)
+
+    def shard_index(self, mesh: Optional[Mesh] = None) -> "ApproximateNearestNeighborsModel":
+        """Shard the inverted lists over the mesh's ``data`` axis — the
+        capacity path for databases ≫ one chip's HBM (BASELINE.json config
+        #5: 10M×768 on multi-host). nlist pads to a device multiple (pad
+        lists are never probed: the centroid set stays unpadded). Queries
+        then execute with the sharded bucketed executor (approximate:
+        probing + capacity + 0.95-recall shortlists + exact rerank) and an
+        O(q·k·devices) all_gather merge. Returns self (fluent)."""
+        mesh = mesh or default_mesh()
+        n_data = mesh.shape[DATA_AXIS]
+        idx = self.index
+        nlist = idx.lists.shape[0]
+        pad = (-nlist) % n_data
+        from jax.sharding import NamedSharding
+
+        def put(arr, spec, pad_width, fill=0):
+            if pad:
+                arr = np.pad(arr, pad_width, constant_values=fill)
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        lists = put(idx.lists, P(DATA_AXIS, None, None), ((0, pad), (0, 0), (0, 0)))
+        ids = put(idx.list_ids, P(DATA_AXIS, None), ((0, pad), (0, 0)), fill=-1)
+        mask = put(idx.list_mask, P(DATA_AXIS, None), ((0, pad), (0, 0)))
+        norms = jnp.sum(jnp.square(lists.astype(jnp.float32)), axis=2)
+        self._dev_index = (
+            jax.device_put(np.asarray(idx.centroids), NamedSharding(mesh, P())),
+            lists,
+            ids,
+            mask,
+            norms,
+        )
+        self._shard_mesh = mesh
+        return self
 
     def _ensure_dev_index(self):
         """Upload the index (+ row norms) to device ONCE per model — the
@@ -668,9 +785,15 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
         qp, _ = pad_rows(queries, bucket)
         with trace_span("ivf query"):
-            fn = _ivf_query_fn(
-                k, nprobe, config.get("compute_dtype"), config.get("accum_dtype")
-            )
+            if self._shard_mesh is not None:
+                fn = _ivf_query_fn_sharded(
+                    k, nprobe, config.get("compute_dtype"),
+                    config.get("accum_dtype"), self._shard_mesh,
+                )
+            else:
+                fn = _ivf_query_fn(
+                    k, nprobe, config.get("compute_dtype"), config.get("accum_dtype")
+                )
             cent, lists, ids_dev, mask, norms = self._ensure_dev_index()
             d2, ids = jax.device_get(
                 fn(cent, lists, ids_dev, mask, jnp.asarray(qp),

@@ -288,3 +288,58 @@ def test_ivf_padding_queries_do_not_evict_real_ones(rng):
     _, idx64 = ann.kneighbors(queries[:64])  # no padding
     np.testing.assert_array_equal(idx65[:64], idx64)
     assert np.all(np.asarray(idx65) >= 0)
+
+
+def test_ivf_sharded_index_matches_unsharded(rng, mesh8):
+    # Lists sharded over 8 devices: results must match the single-device
+    # bucketed executor (CPU backend: both exact given no capacity drops).
+    centers = rng.normal(size=(24, 16)) * 8  # 24 lists: pads to 8-multiple
+    db = np.concatenate([c + rng.normal(size=(160, 16)) for c in centers]).astype(
+        np.float32
+    )
+    queries = np.concatenate([c + rng.normal(size=(3, 16)) for c in centers]).astype(
+        np.float32
+    )
+    k = 10
+    ann = (
+        ApproximateNearestNeighbors(mesh=mesh8)
+        .setK(k)
+        .setNlist(24)
+        .setNprobe(4)
+        .fit({"features": db})
+    )
+    model = ann  # fit() returned the model
+    d_plain, i_plain = model.kneighbors(queries)
+    model.shard_index(mesh8)
+    d_shard, i_shard = model.kneighbors(queries)
+    np.testing.assert_array_equal(
+        np.sort(i_plain, axis=1), np.sort(i_shard, axis=1)
+    )
+    np.testing.assert_allclose(
+        np.sort(d_plain, axis=1), np.sort(d_shard, axis=1), rtol=1e-5
+    )
+    # And recall against brute force stays high.
+    _, ref_i = _sklearn_knn(db, queries, k)
+    recall = np.mean(
+        [len(set(i_shard[i]) & set(ref_i[i])) / k for i in range(len(queries))]
+    )
+    assert recall > 0.85, recall
+
+
+def test_ivf_sharded_model_copy_preserves_sharding(rng, mesh8):
+    # Copying a sharded model must re-establish the padded sharded index
+    # (nlist=30 is not divisible by 8 devices — regression for the lost
+    # padding invariant on copy).
+    db = rng.normal(size=(900, 8)).astype(np.float32)
+    queries = rng.normal(size=(10, 8)).astype(np.float32)
+    model = (
+        ApproximateNearestNeighbors(mesh=mesh8)
+        .setK(5)
+        .setNlist(30)
+        .setNprobe(5)
+        .fit({"features": db})
+    )
+    model.shard_index(mesh8)
+    a = model.kneighbors(queries)
+    b = model.copy().kneighbors(queries)
+    np.testing.assert_array_equal(a[1], b[1])
