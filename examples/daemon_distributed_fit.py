@@ -1,0 +1,38 @@
+"""Executor-fed distributed fit through the TPU-host data-plane daemon.
+
+Emulates N Spark tasks (threads here; real tasks connect over the
+network) streaming Arrow partitions, then finalizes PCA on the driver.
+Iterative algorithms use the same wire protocol with one scan per
+iteration and a step() call at each pass boundary.
+"""
+
+import os
+import sys
+
+if __package__ in (None, ""):  # runnable without installation
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import threading
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+rng = np.random.default_rng(0)
+data = (rng.normal(size=(200_000, 128)) * np.logspace(0, -1.5, 128)).astype(np.float32)
+parts = np.array_split(data, 8)
+
+with DataPlaneDaemon() as daemon:
+    host, port = daemon.address
+
+    def task(part):
+        with DataPlaneClient(host, port) as c:
+            c.feed("demo", part, algo="pca")
+
+    threads = [threading.Thread(target=task, args=(p,)) for p in parts]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+
+    with DataPlaneClient(host, port) as c:
+        result = c.finalize_pca("demo", k=8)
+print("pc:", result["pc"].shape, "ev:", result["explained_variance"][:4])

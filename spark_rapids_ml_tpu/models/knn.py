@@ -208,18 +208,41 @@ class IVFFlatIndex(NamedTuple):
 
 
 def build_ivf_flat(
-    x: np.ndarray, nlist: int, seed: int = 0, mesh: Optional[Mesh] = None
+    x: np.ndarray,
+    nlist: int,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    train_rows: int = 2_000_000,
 ) -> IVFFlatIndex:
     """Train the coarse quantizer and bucket the database into padded lists.
 
     The quantizer uses random init (the IVF convention — a k-means++ pass
     with nlist in the hundreds is nlist sequential host passes over the
-    sample for no recall benefit at this k).
+    sample for no recall benefit at this k) and trains on at most
+    ``train_rows`` sampled rows — FAISS's convention: quantizer quality
+    saturates at a few hundred points per list, and training on the full
+    database would force it through HBM 10+ times for nothing (the
+    assignment pass below still covers every row, in chunks).
     """
     from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
 
     x = np.asarray(x)
-    sol = fit_kmeans(x, k=nlist, max_iter=10, seed=seed, init="random", mesh=mesh)
+    if train_rows < nlist:
+        raise ValueError(
+            f"train_rows = {train_rows} must be >= nlist = {nlist} "
+            f"(the quantizer needs at least one training row per list)"
+        )
+    if x.shape[0] > train_rows:
+        # shuffle=False: Floyd's O(train_rows) sampling — the default
+        # shuffles a full O(n) permutation, ~800 MB at 100M rows, for an
+        # ordering k-means training doesn't care about.
+        pick = np.random.default_rng(seed).choice(
+            x.shape[0], train_rows, replace=False, shuffle=False
+        )
+        sample = x[pick]
+    else:
+        sample = x
+    sol = fit_kmeans(sample, k=nlist, max_iter=10, seed=seed, init="random", mesh=mesh)
     centroids = sol.centers
     # Device-side assignment (the n·nlist·d FLOPs belong on the MXU — at
     # 1M×768×1024 the host-numpy version is minutes of CPU); only the

@@ -343,3 +343,13 @@ def test_ivf_sharded_model_copy_preserves_sharding(rng, mesh8):
     a = model.kneighbors(queries)
     b = model.copy().kneighbors(queries)
     np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_ivf_build_bounded_training(rng):
+    # train_rows caps quantizer training; assignment still covers all rows.
+    from spark_rapids_ml_tpu.models.knn import build_ivf_flat
+
+    db = rng.normal(size=(4096, 8)).astype(np.float32)
+    index = build_ivf_flat(db, nlist=16, seed=0, train_rows=512)
+    assert int(index.list_mask.sum()) == 4096  # every row bucketed
+    assert sorted(index.list_ids[index.list_ids >= 0].tolist()) == list(range(4096))
