@@ -283,9 +283,25 @@ def build_ivf_flat(
 
 
 def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
-    """Per-list query capacity C = min(q, ceil(q*nprobe/nlist * slack)),
-    lane-rounded. At C == q no (query, list) pair can ever be dropped."""
-    cap = int(np.ceil(q * nprobe / nlist * slack))
+    """Per-list query capacity C, lane-rounded.
+
+    Base: ceil(q*nprobe/nlist * slack) — expected per-list load times a
+    slack for load fluctuations (relative headroom shrinks with the mean
+    load λ = q*nprobe/nlist: (slack−1)·√λ sigmas for a Poisson load).
+
+    A ceil(q/nprobe) floor additionally guarantees nprobe*C >= q — under
+    the rank-rotated eviction order even a batch of IDENTICAL queries
+    keeps at least one probed list per query — but only while that floor
+    costs ≤ 4× the base capacity (i.e. nlist ≤ 4·slack·nprobe²). Beyond
+    that the worst-case insurance would multiply every average-case
+    query's FLOPs by nlist/(slack·nprobe²), so it is skipped: extremely
+    correlated batches with tiny nprobe relative to nlist can then drop
+    whole queries — raise nprobe, slack, or split the batch.
+    At C == q nothing can ever be dropped.
+    """
+    base = int(np.ceil(q * nprobe / nlist * slack))
+    floor = int(np.ceil(q / nprobe))
+    cap = max(base, floor) if floor <= 4 * base else base
     return min(q, max(8, ((cap + 7) // 8) * 8))
 
 
@@ -432,7 +448,7 @@ def _bucketed_core(
 
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
-                  slack: float = 2.0):
+                  slack: float = 1.5):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -450,7 +466,8 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
       maxlen) GEMM, and per-(list, slot) top-k candidates are gathered back
       per query for the final merge. FLOPs ≈ slack × the probed work — at
       nprobe/nlist = 1/32 that is ~16× fewer than dense. Capacity overflow
-      (C = min(q, ceil(q·nprobe/nlist · slack))) drops a query's coverage of
+      (C per _bucketed_capacity: slack-scaled expected load, with a
+      bounded identical-query coverage floor) drops a query's coverage of
       an over-subscribed list — the standard fixed-capacity ANN trade; C
       clamps at q, where no drops are possible.
 
@@ -562,7 +579,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
 
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn_sharded(
-    k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 2.0
+    k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5
 ):
     """Sharded IVF query: inverted lists sharded over the ``data`` mesh
     axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
