@@ -63,8 +63,12 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
     accum_dtype = jnp.dtype(ad)
     n_data = mesh.shape[DATA_AXIS]
 
-    def shard(db, mask, queries):
-        # db: (m_local, d) this device's database shard; queries replicated.
+    def shard(db, mask, row_ids, queries):
+        # db: (m_local, d) this device's database shard; queries replicated;
+        # row_ids: (m_local,) the shard's rows' ORIGINAL indices (-1 = pad).
+        # An explicit id map rather than shard_id*m_local + local arithmetic:
+        # multi-process ingestion pads at each process's tail, so padded
+        # positions are interleaved and arithmetic ids would be wrong.
         m_local = db.shape[0]
         # A shard can hold fewer rows than k; its local candidate list is
         # then all of its rows. The union of per-shard top-min(k, m_local)
@@ -77,8 +81,7 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
         # Masked-out (padding) rows get +inf so they never win.
         d2 = jnp.where(mask[None, :] > 0, d2, jnp.inf)
         neg, local_idx = jax.lax.top_k(-d2, kl)  # (q, kl)
-        shard_id = jax.lax.axis_index(DATA_AXIS)
-        global_idx = local_idx + shard_id * m_local
+        global_idx = row_ids[local_idx]
         # Gather candidates from all shards: (q, kl·n_data) each; the pool
         # holds >= k valid entries because padding is tail-only.
         cand_d = jax.lax.all_gather(-neg, DATA_AXIS, axis=1, tiled=True)
@@ -90,7 +93,7 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
     f = jax.shard_map(
         shard,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(P(), P()),
         check_vma=False,  # gathered candidates are value-replicated
     )
@@ -145,6 +148,8 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         self._mesh = mesh
         self._db_sharded = None
         self._db_mask = None
+        self._db_ids = None
+        self._n_global = None
 
     def _model_data(self):
         return {"database": self.database}
@@ -159,31 +164,63 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
 
     def _ensure_index(self, mesh):
         if self._db_sharded is None:
-            xp, mask = pad_rows(self.database, mesh.shape[DATA_AXIS])
-            self._db_sharded = jax.device_put(xp, row_sharding(mesh))
-            self._db_mask = jax.device_put(mask, row_sharding(mesh, 1))
+            from spark_rapids_ml_tpu.parallel.sharding import shard_rows
+
+            n_local = self.database.shape[0]
+            if jax.process_count() > 1:
+                # Multi-process: `database` is this process's local slice;
+                # its original-row-id range starts after lower ranks' rows.
+                from jax.experimental import multihost_utils as mhu
+
+                counts = np.asarray(
+                    mhu.process_allgather(np.asarray([n_local]))
+                ).reshape(-1)
+                lo = int(counts[: jax.process_index()].sum())
+            else:
+                lo = 0
+            self._db_sharded, self._db_mask, self._n_global = shard_rows(
+                self.database, mesh
+            )
+            # Explicit id map; +1 shift so shard_rows's zero-padding decodes
+            # to -1 (a real row 0 must stay distinguishable from padding).
+            ids, _, _ = shard_rows(
+                np.arange(lo + 1, lo + n_local + 1, dtype=np.int32),
+                mesh,
+                with_mask=False,
+            )
+            self._db_ids = ids - 1
 
     def kneighbors(
         self, queries: np.ndarray, k: Optional[int] = None
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (distances (q, k), indices (q, k)), Euclidean, ascending."""
+        """Returns (distances (q, k), indices (q, k)), Euclidean, ascending.
+
+        Multi-process: every process passes the SAME query batch and its
+        own local database slice was used at fit; returned indices are
+        global row positions (concatenation order of the process slices).
+        """
         if self.database is None:
             raise RuntimeError("model has no database (unfitted?)")
         k = self.getK() if k is None else k
-        n = self.database.shape[0]
-        if not 0 < k <= n:
-            raise ValueError(f"k = {k} out of range (0, numRows = {n}]")
         mesh = self._mesh or default_mesh()
         self._ensure_index(mesh)
+        n = self._n_global
+        if not 0 < k <= n:
+            raise ValueError(f"k = {k} out of range (0, numRows = {n}]")
         queries = np.asarray(queries)
         q = queries.shape[0]
         bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
         qp, _ = pad_rows(queries, bucket)
         with trace_span("knn query"):
+            from spark_rapids_ml_tpu.parallel.sharding import replicated_array
+
             fn = _exact_knn_fn(
                 mesh, k, config.get("compute_dtype"), config.get("accum_dtype")
             )
-            d2, idx = jax.device_get(fn(self._db_sharded, self._db_mask, jnp.asarray(qp)))
+            d2, idx = jax.device_get(
+                fn(self._db_sharded, self._db_mask, self._db_ids,
+                   replicated_array(qp, mesh))
+            )
         return np.sqrt(np.maximum(d2[:q], 0)), idx[:q].astype(np.int64)
 
     def _transform(self, dataset):

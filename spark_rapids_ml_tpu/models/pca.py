@@ -490,7 +490,11 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
 
     # -- persistence (PCAModelWriter/Reader, RapidsPCA.scala:193-228) ------
     def _model_data(self):
-        data = {"pc": self.pc, "explainedVariance": self.explainedVariance}
+        data = {"pc": self.pc}
+        # Omit-when-None (like mean): a legacy-loaded model re-saved with
+        # an explainedVariance=None column would reload as a 0-d nan.
+        if self.explainedVariance is not None:
+            data["explainedVariance"] = self.explainedVariance
         if self.mean is not None:
             data["mean"] = self.mean
         return data
@@ -499,7 +503,10 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
     def _from_model_data(cls, uid, data):
         return cls(
             pc=data["pc"],
-            explained_variance=data["explainedVariance"],
+            # Tolerate saves without explainedVariance — the reference's
+            # reader does the same for pre-Spark-1.6 models
+            # (RapidsPCA.scala:209-213); transform needs only pc.
+            explained_variance=data.get("explainedVariance"),
             mean=data.get("mean"),
             uid=uid,
         )

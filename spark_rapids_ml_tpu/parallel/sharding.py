@@ -86,6 +86,20 @@ def shard_rows(
     return xs, ms, n_true
 
 
+def replicated_array(x: np.ndarray, mesh: Mesh):
+    """Place a host array fully replicated on the mesh.
+
+    Multi-process: every process must pass the SAME values (e.g. a query
+    batch distributed to all hosts); each contributes its addressable
+    replicas via ``make_array_from_callback``."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, replicated(mesh))
+    x = np.asarray(x)
+    return jax.make_array_from_callback(
+        x.shape, replicated(mesh), lambda idx: x[idx]
+    )
+
+
 def require_single_process(feature: str) -> None:
     """Fail fast (identically on every process) for code whose host-side
     preparation depends on local data — running it multi-process would

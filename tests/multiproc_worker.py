@@ -49,6 +49,15 @@ def main() -> None:
 
     mesh = global_mesh()
     sol = fit_pca(x[lo:hi], k=k, mean_center=True, mesh=mesh)
+
+    # Exact KNN: each process indexes its local slice; queries identical
+    # everywhere; returned ids are global row positions.
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+
+    queries = x[:7]  # every process passes the same batch
+    model = NearestNeighbors(mesh=mesh).setK(5).fit({"features": x[lo:hi]})
+    dists, idx = model.kneighbors(queries)
+
     if jax.process_index() == 0:
         print(
             json.dumps(
@@ -56,6 +65,8 @@ def main() -> None:
                     "pc": np.asarray(sol.pc).tolist(),
                     "ev": np.asarray(sol.explained_variance).tolist(),
                     "n_rows": sol.n_rows,
+                    "knn_idx": np.asarray(idx).tolist(),
+                    "knn_d": np.asarray(dists).tolist(),
                 }
             )
         )

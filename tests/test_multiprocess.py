@@ -71,3 +71,14 @@ def test_two_process_pca_matches_single_process():
     np.testing.assert_allclose(
         np.asarray(result["ev"]), ref.explained_variance, atol=1e-10
     )
+
+    # Exact KNN across processes: global ids must match a single-process
+    # model over the full database.
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+
+    nn = NearestNeighbors(mesh=make_mesh(data=4, model=1)).setK(5).fit(
+        {"features": x}
+    )
+    ref_d, ref_i = nn.kneighbors(x[:7])
+    np.testing.assert_array_equal(np.asarray(result["knn_idx"]), ref_i)
+    np.testing.assert_allclose(np.asarray(result["knn_d"]), ref_d, atol=1e-8)

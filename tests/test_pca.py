@@ -327,3 +327,37 @@ def test_sign_flip_unit():
     out = np.asarray(sign_flip(u))
     # col0: max-|x| is -0.8 -> flip; col1: max-|x| is -0.9 -> flip
     np.testing.assert_allclose(out, -u)
+
+
+def test_load_tolerates_missing_explained_variance(rng, mesh8):
+    # Reference parity: its reader loads pre-Spark-1.6 models that carry no
+    # explainedVariance (RapidsPCA.scala:209-213) — transform needs only pc.
+    from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+
+    x = rng.normal(size=(200, 12))
+    model = PCA(mesh=mesh8).setInputCol("features").setK(3).fit({"features": x})
+    data = model._model_data()
+    del data["explainedVariance"]  # simulate a legacy save
+    legacy = PCAModel._from_model_data(model.uid, data)
+    assert legacy.explainedVariance is None
+    out = legacy.transform({"features": x})
+    np.testing.assert_allclose(
+        out["pca_features"], model.transform({"features": x})["pca_features"]
+    )
+
+
+def test_legacy_model_resave_roundtrip(rng, mesh8, tmp_path):
+    # A legacy-loaded model (no explainedVariance) re-saved and re-loaded
+    # must keep explainedVariance None — not decay into a 0-d nan.
+    from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+
+    x = rng.normal(size=(100, 8))
+    model = PCA(mesh=mesh8).setInputCol("features").setK(2).fit({"features": x})
+    data = model._model_data()
+    del data["explainedVariance"]
+    legacy = PCAModel._from_model_data(model.uid, data)
+    path = str(tmp_path / "legacy")
+    legacy.save(path)
+    again = PCAModel.load(path)
+    assert again.explainedVariance is None
+    np.testing.assert_allclose(again.pc, model.pc)
