@@ -39,6 +39,7 @@ def main() -> None:
 
     config.set("compute_dtype", "bfloat16")
     config.set("accum_dtype", "float32")
+    config.set("use_pallas", True)  # fused single-HBM-pass Newton step
 
     n_chips = len(jax.devices())
     mesh = make_mesh(model=1)

@@ -61,7 +61,6 @@ from spark_rapids_ml_tpu.utils.profiling import trace_span
 def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
     compute_dtype = jnp.dtype(cd)
     accum_dtype = jnp.dtype(ad)
-    n_data = mesh.shape[DATA_AXIS]
 
     def shard(db, mask, row_ids, queries):
         # db: (m_local, d) this device's database shard; queries replicated;

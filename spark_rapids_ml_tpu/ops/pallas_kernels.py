@@ -37,8 +37,15 @@ def _ceil_to(x: int, m: int) -> int:
 
 def _dot_prec(dt):
     """Mosaic, like XLA, defaults f32 dots to single-pass bf16 mantissas on
-    TPU; request full precision for f32 operands (no-op for bf16)."""
-    return jax.lax.Precision.HIGHEST if jnp.dtype(dt) == jnp.float32 else None
+    TPU; request full precision for f32 operands. bf16 operands pin
+    DEFAULT explicitly — an ambient ``mm_precision`` HIGHEST context would
+    otherwise make Mosaic attempt an f32x3 decomposition of a bf16 lhs
+    ("Bad lhs type")."""
+    return (
+        jax.lax.Precision.HIGHEST
+        if jnp.dtype(dt) == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -296,6 +303,145 @@ def lloyd_step_pallas(
         interpret=interpret,
     )(nv, x, centers, c2)
     return sums, counts[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused binomial Newton statistics: one HBM pass per IRLS iteration
+# ---------------------------------------------------------------------------
+
+
+NEWTON_STATS_BLOCK_N = 512
+NEWTON_STATS_VMEM_BUDGET = 64 * 2**20  # max (d, d) f32 resident Hessian
+
+
+def _newton_stats_kernel(b_ref, x_ref, y_ref, m_ref, w_ref, gw_ref, h_ref, s_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        gw_ref[:] = jnp.zeros_like(gw_ref)
+        h_ref[:] = jnp.zeros_like(h_ref)
+        s_ref[:] = jnp.zeros_like(s_ref)
+
+    xb = x_ref[:]  # (bn, d) compute dtype
+    y = y_ref[:]  # (bn, 1) f32
+    m = m_ref[:]  # (bn, 1) f32
+    w = w_ref[:]  # (128, d) compute dtype; row 0 = w, rest zeros
+    hp = _dot_prec(xb.dtype)
+    # Row-local IRLS quantities: z → p → (residual, weight). This is why the
+    # whole iteration fits in one pass — nothing couples rows except the
+    # final sums. Two Mosaic shape/fusion constraints shape the matvec:
+    # the MXU pads N to 128 lanes anyway but rejects bf16 dots with a
+    # literal N=1, so w arrives pre-padded to (128, d); and the scalar
+    # `+ b` must come after the lane slice — fusing an add into a matmul
+    # accumulator is rejected ("Only constant accumulator supported").
+    z128 = jax.lax.dot_general(
+        xb, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hp,
+    )  # (bn, 128); only lane 0 is live
+    z = z128[:, :1] + b_ref[0]  # (bn, 1)
+    p = jax.nn.sigmoid(z)
+    r = (p - y) * m
+    wgt = jnp.maximum(p * (1.0 - p), 1e-10) * m
+    # One (128, bn)×(bn, d) GEMM yields both vector statistics: row 0 the
+    # gradient Xᵀr, row 1 the intercept border Xᵀwgt (M is MXU-padded to
+    # 128 regardless, and M=2 trips the same Mosaic shape limit as N=1).
+    lane = jax.lax.broadcasted_iota(jnp.int32, (xb.shape[0], 128), 1)
+    rw = (
+        jnp.where(lane == 0, r, 0.0) + jnp.where(lane == 1, wgt, 0.0)
+    ).astype(xb.dtype)  # (bn, 128)
+    gw_ref[:] += jax.lax.dot_general(
+        rw, xb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hp,
+    )
+    # Hessian Xᵀdiag(wgt)X at fast DEFAULT precision: it is a
+    # preconditioner, not the answer (see models/logistic_regression.py) —
+    # the gradient above sets the fixed point.
+    xw = xb * wgt.astype(xb.dtype)
+    h_ref[:] += jax.lax.dot_general(
+        xw, xb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT,
+    )
+    slane = jax.lax.broadcasted_iota(jnp.int32, s_ref.shape, 1)
+    s_ref[:] += jnp.where(slane == 0, jnp.sum(r), 0.0) + jnp.where(
+        slane == 1, jnp.sum(wgt), 0.0
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def newton_stats_pallas(
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    w: jax.Array,
+    b: jax.Array,
+    block_n: int = NEWTON_STATS_BLOCK_N,
+    interpret: bool = False,
+):
+    """One binomial Newton-IRLS iteration's statistics in a single HBM pass.
+
+    The XLA lowering of the IRLS body reads x ~4× per iteration (z matvec,
+    gradient GEMM, weighted copy x·wgt, Hessian GEMM) — at d=1024 the step
+    is HBM-bound, not MXU-bound. Here z, p, and the per-row
+    residual/weight are computed in VMEM per row block and x feeds both
+    GEMMs from the same resident tile, so x streams through HBM exactly
+    once per Newton step. The (d, d) Hessian accumulator stays VMEM-
+    resident across the whole row grid (same design as
+    :func:`gram_colsum_pallas`).
+
+    x: (n, d) in the compute dtype — bfloat16 streams half the HBM bytes
+    and runs every dot single-pass on the MXU (the intended speed mode);
+    float32 keeps full-precision gradients. y/mask: (n,) f32 (mask
+    multiplies both residual and weight, so arbitrary row masks work, not
+    just valid-prefixes); w: (d,) f32; b: scalar f32 (prefetched to SMEM).
+
+    Returns raw (unnormalized, pre-psum) sums:
+    (grad_w (d,), grad_b (), h_ww (d, d), h_wb (d,), h_bb ()), all f32 —
+    the caller divides by the global row count, adds ridge terms, and
+    psums across the data axis.
+    """
+    n, d = x.shape
+    bn = min(block_n, n)
+    if n % bn:
+        raise ValueError(f"n={n} not divisible by block_n={bn}")
+    if d * d * 4 > NEWTON_STATS_VMEM_BUDGET:
+        raise ValueError(f"d={d}: (d, d) f32 Hessian exceeds the VMEM budget")
+    bvec = jnp.asarray(b, jnp.float32).reshape((1,))
+    wpad = jnp.zeros((128, d), x.dtype).at[0].set(w.astype(x.dtype))
+    gw, h, s = pl.pallas_call(
+        _newton_stats_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n // bn,),
+            in_specs=[
+                pl.BlockSpec((bn, d), lambda i, b: (i, 0)),
+                pl.BlockSpec((bn, 1), lambda i, b: (i, 0)),
+                pl.BlockSpec((bn, 1), lambda i, b: (i, 0)),
+                pl.BlockSpec((128, d), lambda i, b: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((128, d), lambda i, b: (0, 0)),
+                pl.BlockSpec((d, d), lambda i, b: (0, 0)),
+                pl.BlockSpec((1, 128), lambda i, b: (0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((128, d), jnp.float32),
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 2**20
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(
+        bvec,
+        x,
+        y.astype(jnp.float32).reshape(n, 1),
+        mask.astype(jnp.float32).reshape(n, 1),
+        wpad,
+    )
+    return gw[0], s[0, 0], h, gw[1], s[0, 1]
 
 
 # ---------------------------------------------------------------------------

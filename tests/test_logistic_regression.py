@@ -188,3 +188,27 @@ def test_streaming_checkpoint_resume(rng, mesh8, tmp_path):
     )
     assert not os.path.exists(ck)
     np.testing.assert_allclose(resumed.coefficients, full.coefficients, atol=1e-5)
+
+
+def test_pcg_solve_matches_direct(rng):
+    """_pcg_solve (the TPU-path inner solver) vs numpy direct solve — SPD
+    well-conditioned, warm/cold starts, and indefinite breakdown safety."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.logistic_regression import _pcg_solve
+
+    d = 96
+    a = rng.normal(size=(d, d)).astype(np.float32)
+    h = a @ a.T / d + np.eye(d, dtype=np.float32)
+    g = rng.normal(size=(d,)).astype(np.float32)
+    ref = np.linalg.solve(h, g)
+    cold = np.asarray(_pcg_solve(jnp.asarray(h), jnp.asarray(g), jnp.zeros(d), rtol=1e-6))
+    np.testing.assert_allclose(cold, ref, rtol=1e-3, atol=1e-4)
+    warm = np.asarray(
+        _pcg_solve(jnp.asarray(h), jnp.asarray(g), jnp.asarray(ref * 0.9), rtol=1e-6)
+    )
+    np.testing.assert_allclose(warm, ref, rtol=1e-3, atol=1e-4)
+    # Indefinite matrix: must stay finite (terminates on negative curvature)
+    hbad = h - 3.0 * np.eye(d, dtype=np.float32)
+    out = np.asarray(_pcg_solve(jnp.asarray(hbad), jnp.asarray(g), jnp.zeros(d)))
+    assert np.all(np.isfinite(out))

@@ -122,3 +122,40 @@ def test_lloyd_step_block_validation(rng):
     c = rng.normal(size=(128, 128)).astype(np.float32)
     with pytest.raises(ValueError, match="not divisible"):
         lloyd_step_pallas(x, c, 100, k=100, block_n=64, interpret=True)
+
+
+def test_newton_stats_parity(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import newton_stats_pallas
+
+    n, d = 1024, 256
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    mask[-100:] = 0.0  # arbitrary masked rows, not a block boundary
+    w = (rng.normal(size=(d,)) / np.sqrt(d)).astype(np.float32)
+    b = np.float32(0.3)
+    gw, gb, hww, hwb, hbb = newton_stats_pallas(
+        x, y, mask, w, b, block_n=256, interpret=True
+    )
+    z = x @ w + b
+    p = 1.0 / (1.0 + np.exp(-z))
+    r = (p - y) * mask
+    wgt = np.maximum(p * (1.0 - p), 1e-10) * mask
+    np.testing.assert_allclose(np.asarray(gw), x.T @ r, rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(float(gb), r.sum(), rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(hww), (x * wgt[:, None]).T @ x, rtol=1e-4, atol=1e-2
+    )
+    np.testing.assert_allclose(np.asarray(hwb), x.T @ wgt, rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(float(hbb), wgt.sum(), rtol=1e-4, atol=1e-2)
+
+
+def test_newton_stats_block_validation(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import newton_stats_pallas
+
+    x = rng.normal(size=(100, 128)).astype(np.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        newton_stats_pallas(
+            x, np.ones(100, np.float32), np.ones(100, np.float32),
+            np.zeros(128, np.float32), 0.0, block_n=64, interpret=True,
+        )
