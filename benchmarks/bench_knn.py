@@ -45,12 +45,19 @@ def main() -> None:
     config.set("accum_dtype", "float32")
     config.set("use_pallas", True)  # fused Lloyd step for the coarse quantizer
 
+    from spark_rapids_ml_tpu.models.knn import build_ivf_flat_device
+
     n_chips = len(jax.devices())
     rng = np.random.default_rng(0)
-    base = rng.normal(size=(N_BASE, D)).astype(np.float32)
-    queries = jnp.asarray(rng.normal(size=(N_QUERY, D)), dtype=jnp.float32)
+    queries = jnp.asarray(rng.standard_normal(size=(N_QUERY, D), dtype=np.float32))
 
-    index = build_ivf_flat(base, nlist=NLIST, seed=0)
+    # Base rows are generated AND bucketed on device (build_ivf_flat_device):
+    # the host path's 2×3 GB host↔device round-trip plus host-speed fancy
+    # indexing dominates bench wall-clock on slow build hosts, and the
+    # timed quantity is the query path either way.
+    base = jax.random.normal(jax.random.key(0), (N_BASE, D), jnp.float32)
+    index = build_ivf_flat_device(base, nlist=NLIST, seed=0)
+    del base  # free 3 GB of HBM — the index alone serves the queries
     dev = [
         jnp.asarray(index.centroids, dtype=jnp.float32),
         jnp.asarray(index.lists, dtype=jnp.float32),
@@ -60,19 +67,25 @@ def main() -> None:
     from benchmarks import slope_dt, sync
 
     query = _ivf_query_fn(K, NPROBE, "bfloat16", "float32")
-    # Row norms are index data: precompute once like a serving deployment
-    # would (the model path caches them on device automatically).
-    norms = jnp.sum(jnp.square(dev[1]), axis=2)
+    # Residual norms + the bf16 residual scan copy are index data:
+    # precompute once like a serving deployment would (the model path
+    # caches them on device via _ensure_dev_index).
+    from spark_rapids_ml_tpu.models.knn import _residual_index_data
+
+    norms, lists_lo = _residual_index_data(dev[1], dev[0], jnp.bfloat16)
 
     def run(n):
         ids = None
         for _ in range(n):
-            dists, ids = query(*dev, queries, list_norms=norms)
+            dists, ids = query(*dev, queries, resid_norms=norms, lists_lo=lists_lo)
         sync(ids)  # one sync; calls queue on device
         assert np.all(np.asarray(ids) >= 0)
         return ids
 
-    dt = slope_dt(run, 4, 8)
+    # 8 vs 24 calls: the wider slope keeps tunnel dispatch jitter (which
+    # rivals a single call's cost) out of the reported per-call rate.
+    reps = int(os.environ.get("SRML_BENCH_REPS", 8))
+    dt = slope_dt(run, reps, 3 * reps)
     emit(
         f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}_k{K}_nprobe{NPROBE}",
         N_QUERY / dt / n_chips,

@@ -17,8 +17,8 @@ BASELINE.json config #5: "Approx-KNN IVF-Flat on 10M×768 SBERT embeddings
   two-strategy (see ``_ivf_query_fn``): a dense masked block scan (exact
   within probed lists) when a large fraction of lists is probed, else
   ScaNN-style capacity-bucketed query grouping — batched per-list GEMMs
-  over only the assigned queries, a 2k-wide approximate shortlist, and an
-  exact f32 rerank.
+  over only the assigned queries (residual-encoded against the list
+  centroids), a 4k-wide approximate shortlist, and an exact f32 rerank.
 
 Output convention follows spark-rapids-ml's NearestNeighbors:
 ``kneighbors(queries) -> (distances, indices)`` with Euclidean distances.
@@ -318,6 +318,94 @@ def build_ivf_flat(
     return IVFFlatIndex(centroids, lists, list_ids, list_mask)
 
 
+def build_ivf_flat_device(
+    x,
+    nlist: int,
+    seed: int = 0,
+    train_rows: int = 2_000_000,
+) -> IVFFlatIndex:
+    """Device-side IVF-Flat build for data already resident on device.
+
+    ``build_ivf_flat`` buckets on the host — right when the database
+    arrives as host numpy, but a pure round-trip when rows are already on
+    device (generated there, or fed by the data-plane daemon): 2×3 GB
+    over PCIe/tunnel plus host-speed fancy indexing. Here everything —
+    quantizer Lloyd iterations, assignment, the sort-based bucketing
+    scatter — runs on device; only the (nlist,) counts come back to fix
+    the static ``maxlen``. Returns an IVFFlatIndex whose fields are
+    device arrays (same container; the model's device-index cache accepts
+    either).
+    """
+    from spark_rapids_ml_tpu.models.kmeans import _lloyd_fn
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    x = jnp.asarray(x, jnp.float32)
+    n, d = x.shape
+    key = jax.random.key(seed)
+    k_samp, k_init, k_shuf = jax.random.split(key, 3)
+    n_train = min(n, train_rows)
+    sample = (
+        x[jax.random.choice(k_samp, n, (n_train,), replace=False)]
+        if n > train_rows
+        else x
+    )
+    centers0 = sample[jax.random.choice(k_init, n_train, (nlist,), replace=False)]
+    mesh = make_mesh(data=1, model=1, devices=list(x.devices())[:1])
+    fn = _lloyd_fn(
+        mesh, nlist, 10, 1e-4, config.get("compute_dtype"),
+        config.get("accum_dtype"),
+        use_pallas=bool(config.get("use_pallas")),
+    )
+    centroids, _, _ = fn(sample, jnp.ones((n_train,), jnp.float32), centers0)
+    centroids = centroids.astype(jnp.float32)
+
+    @jax.jit
+    def _assign_chunk(chunk, centroids):
+        d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
+        return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    # Chunked assignment for ANY n (a whole-x call would materialize the
+    # (n, nlist) distance matrix); at most two compiled shapes (full chunk
+    # + remainder).
+    step = 1 << 18
+    assign = (
+        jnp.concatenate(
+            [
+                _assign_chunk(jax.lax.slice_in_dim(x, i, min(i + step, n)), centroids)
+                for i in range(0, n, step)
+            ]
+        )
+        if n > step
+        else _assign_chunk(x, centroids)
+    )
+    counts = jnp.zeros((nlist,), jnp.int32).at[assign].add(1)
+    maxlen = max(int(jax.device_get(counts.max())), 1)  # static for the jit below
+
+    @functools.partial(jax.jit, static_argnames=("maxlen",))
+    def _bucketize(x, assign, counts, key, maxlen):
+        # Same sort-based scatter as the host build, including the random
+        # tiebreak shuffle that spreads near-neighbors across row slots.
+        shuffle = jax.random.permutation(key, n)
+        order = shuffle[jnp.argsort(assign[shuffle], stable=True)]
+        sorted_assign = assign[order]
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:-1]).astype(jnp.int32)]
+        )
+        slots = jnp.arange(n, dtype=jnp.int32) - starts[sorted_assign]
+        lists = (
+            jnp.zeros((nlist, maxlen, d), x.dtype)
+            .at[sorted_assign, slots].set(x[order])
+        )
+        list_ids = (
+            jnp.full((nlist, maxlen), -1, jnp.int32)
+            .at[sorted_assign, slots].set(order.astype(jnp.int32))
+        )
+        return lists, list_ids, (list_ids >= 0).astype(jnp.float32)
+
+    lists, list_ids, list_mask = _bucketize(x, assign, counts, k_shuf, maxlen)
+    return IVFFlatIndex(centroids, lists, list_ids, list_mask)
+
+
 def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
     """Per-list query capacity C, lane-rounded.
 
@@ -342,17 +430,41 @@ def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
 
 
 def _bucketed_core(
-    qc, queries, probe, lists, list_ids, list_mask, list_norms,
+    queries, probe, probe_d2, lists, list_ids, list_mask, resid_norms,
     n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
-    list_block: int = 32,
+    list_block: int = 16, shortlist_mult: int = 2, *, lists_lo, centroids,
 ):
     """The capacity-bucketed scorer over ONE device's lists.
 
     ``probe``: (q, nprobe) list indices INTO ``lists``; -1 marks pairs this
     device does not own (the sharded executor localizes global probe ids
     and marks the rest -1 — they are dropped here and satisfied by the
-    owning device). Returns (dists (q, k) exact f32 ascending, ids (q, k);
+    owning device). ``probe_d2``: (q, nprobe) f32 ‖q − c_probe‖² from the
+    probe stage. Returns (dists (q, k) exact f32 ascending, ids (q, k);
     +inf/-1 where fewer than k candidates exist locally).
+
+    **Residual scoring** (FAISS's IVF convention, doubly needed at
+    bfloat16): clustered data has ‖row‖ ≫ ‖row − c_list‖, so scoring raw
+    rows at bf16 buries the within-list margins under rounding noise
+    proportional to the LARGE absolute magnitudes — measured recall@10
+    collapse 0.99 → 0.64 on clustered 128-d data. Instead
+    ‖q − row‖² = ‖δ‖² − 2(q − c)·δ + ‖q − c‖² with δ = row − c_list: the
+    GEMM runs on the SMALL residual operands (bf16 noise scales with
+    them), the last term is the probe stage's per-(q, list) constant
+    (added at candidate gather-back — it cannot change a within-list
+    argmin), and the exact f32 rerank still reads the raw rows.
+
+    ``lists_lo``: compute-dtype RESIDUAL copy of ``lists``
+    (lists − centroids[:, None, :]) for the scan GEMMs — index data,
+    cached on device by the model next to ``resid_norms`` (the f32
+    per-row ‖δ‖²). At bfloat16 it halves the scan's HBM traffic AND drops
+    the per-block cast. The public query() wrappers build both when a
+    caller has no cache. ``centroids``: this device's (nlist, d) f32
+    centroid rows, for the per-block query-residual subtraction.
+    ``list_block=16`` keeps each block's (block, C, maxlen) distance tile
+    small enough to stay on-chip between the GEMM and the shortlist
+    selection — measured 4× faster than 32 at the bench shape (block=8
+    over-fragments the pipeline and loses it back).
 
     See _ivf_query_fn's docstring for the full algorithm: eviction-ordered
     capacity bucketing, batched per-list-block GEMMs, position-only scan,
@@ -376,10 +488,19 @@ def _bucketed_core(
     flat_rank = jnp.tile(jnp.arange(nprobe, dtype=jnp.int32), q)
     rot = (flat_query + flat_rank * C) % q
     flat_rank = jnp.where(flat_query >= n_valid, nprobe, flat_rank)
-    # Lexicographic (list, rank, rot) via two stable argsorts.
-    o1 = jnp.argsort(rot, stable=True)
-    key2 = (flat_list * (nprobe + 2) + flat_rank)[o1]
-    order = o1[jnp.argsort(key2, stable=True)]
+    # Lexicographic (list, rank, rot) order. The combined int32 key is
+    # unique per pair (rot is a bijection of queries within each rank), so
+    # ONE unstable argsort replaces two stable ones (a stable sort ties
+    # every key to its index — effectively a wider sort — and this sort is
+    # a measurable slice of the query's critical path). Falls back to the
+    # two-pass form when the combined key range would overflow int32.
+    if (nlist + 1) * (nprobe + 2) + nprobe + 2 < (2**31 - 1) // max(q, 1):
+        combined = (flat_list * (nprobe + 2) + flat_rank) * q + rot
+        order = jnp.argsort(combined, stable=False)
+    else:
+        o1 = jnp.argsort(rot, stable=True)
+        key2 = (flat_list * (nprobe + 2) + flat_rank)[o1]
+        order = o1[jnp.argsort(key2, stable=True)]
     sl = flat_list[order]
     sq_ids = flat_query[order]
     counts = jnp.zeros((nlist + 1,), jnp.int32).at[flat_list].add(1)
@@ -405,16 +526,24 @@ def _bucketed_core(
     nblk = -(-nlist // list_block)
     pad = nblk * list_block - nlist
     lists_p = jnp.pad(lists, ((0, pad), (0, 0), (0, 0)))
+    lists_lo_p = jnp.pad(lists_lo, ((0, pad), (0, 0), (0, 0)))
+    cent_p = jnp.pad(centroids.astype(jnp.float32), ((0, pad), (0, 0)))
     ids_p = jnp.pad(list_ids, ((0, pad), (0, 0)), constant_values=-1)
     msk_p = jnp.pad(list_mask, ((0, pad), (0, 0)))
     bq_p = jnp.pad(bucket_q, ((0, pad), (0, 0)), constant_values=-1)
-    # Masked row norms (precomputed index data): padded rows carry a huge
-    # norm so they never win a top-k.
-    norms_p = jnp.pad(list_norms.astype(accum_dtype), ((0, pad), (0, 0)))
+    # Masked residual norms (precomputed index data): padded rows carry a
+    # huge norm so they never win a top-k.
+    norms_p = jnp.pad(resid_norms.astype(accum_dtype), ((0, pad), (0, 0)))
     r2_all = jnp.where(msk_p > 0, norms_p, jnp.asarray(1e30, accum_dtype))
-    # 2k-wide per-(list, slot) shortlist: selection runs on the compute
-    # dtype's noisy scores; the exact rerank recovers boundary swaps.
-    blk_k = min(2 * k, maxlen)
+    # mult·k-wide per-(list, slot) shortlist: selection runs on the
+    # compute dtype's noisy scores; the exact rerank recovers boundary
+    # swaps. Width is the bf16 recall/speed dial (config
+    # ann_shortlist_mult): noisy scores push true neighbors below the
+    # within-list cut, and widening the cut is what recovers them —
+    # measured on clustered 128-d data, mult 2 → recall@10 0.92 at ~115k
+    # q/s/chip, mult 4 → 0.98 at ~65k (f32 scans sit at the 0.99 probing
+    # ceiling already at mult 2).
+    blk_k = min(shortlist_mult * k, maxlen)
     if nprobe * blk_k < k:
         raise ValueError(
             f"k={k} exceeds the bucketed candidate pool nprobe*maxlen="
@@ -423,10 +552,17 @@ def _bucketed_core(
 
     def body(_, b):
         qidx = jax.lax.dynamic_slice(bq_p, (b * list_block, 0), (list_block, C))
-        qv = qc[jnp.maximum(qidx, 0)]  # (L, C, d) gather of query vectors
-        rows = jax.lax.dynamic_slice(
-            lists_p, (b * list_block, 0, 0), (list_block, maxlen, d)
+        # Query residuals q − c_list, formed in f32 BEFORE the compute-
+        # dtype cast: bf16-rounding q and c separately leaves absolute-
+        # magnitude noise that does not cancel in the subtraction.
+        cent = jax.lax.dynamic_slice(cent_p, (b * list_block, 0), (list_block, d))
+        qv = (
+            queries.astype(jnp.float32)[jnp.maximum(qidx, 0)]  # (L, C, d)
+            - cent[:, None, :]
         ).astype(compute_dtype)
+        rows = jax.lax.dynamic_slice(
+            lists_lo_p, (b * list_block, 0, 0), (list_block, maxlen, d)
+        )
         r2 = jax.lax.dynamic_slice(r2_all, (b * list_block, 0), (list_block, maxlen))
         # Batched MXU GEMM: each list scores only its assigned queries.
         # Full precision for f32 compute (TPU's DEFAULT is bf16-mantissa).
@@ -436,8 +572,9 @@ def _bucketed_core(
             qr = jnp.einsum(
                 "lcd,lmd->lcm", qv, rows, preferred_element_type=accum_dtype
             )
-        # Ranking score r2 - 2qr: the per-query ||q||^2 constant cannot
-        # change a per-row argmin and the rerank restores true distances.
+        # Within-list ranking score ‖δ‖² − 2(q−c)·δ: the per-(query, list)
+        # ‖q−c‖² constant joins at gather-back (it cannot change a
+        # within-list argmin) and the rerank restores true distances.
         d2 = r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
         # 0.95 within-list recall: recall_target=1.0 degenerates to a full
         # per-row sort (4x the einsum+selection cost); misses concentrate
@@ -456,9 +593,11 @@ def _bucketed_core(
     res_d = res_d.reshape(nblk * list_block, C, blk_k)
     res_p = res_p.reshape(nblk * list_block, C, blk_k)
 
-    # Gather each query's candidates back from its (list, slot) buckets.
+    # Gather each query's candidates back from its (list, slot) buckets,
+    # completing the residual identity with the probe stage's ‖q−c‖² term
+    # so scores are comparable ACROSS lists at the shortlist top-k.
     ps = jnp.maximum(pair_slot, 0)
-    cand_d = res_d[pair_list, ps]  # (q, nprobe, blk_k)
+    cand_d = res_d[pair_list, ps] + probe_d2.astype(accum_dtype)[:, :, None]
     cand_pos = res_p[pair_list, ps]
     dropped = (pair_slot < 0)[:, :, None]
     cand_d = jnp.where(dropped, jnp.inf, cand_d).reshape(q, nprobe * blk_k)
@@ -466,9 +605,9 @@ def _bucketed_core(
     cand_list = jnp.broadcast_to(
         pair_list[:, :, None], (q, nprobe, blk_k)
     ).reshape(q, nprobe * blk_k)
-    # Exact rerank (the ScaNN two-stage): select a 4k-wide shortlist by
-    # approximate score, rescore exactly in f32 from the stored rows.
-    R = min(4 * k, nprobe * blk_k)
+    # Exact rerank (the ScaNN two-stage): select a 2·mult·k-wide shortlist
+    # by approximate score, rescore exactly in f32 from the stored rows.
+    R = min(2 * shortlist_mult * k, nprobe * blk_k)
     negR, posR = jax.lax.top_k(-cand_d, R)
     wl = jnp.take_along_axis(cand_list, posR, axis=1)  # (q, R)
     wp = jnp.take_along_axis(cand_pos, posR, axis=1)
@@ -482,9 +621,46 @@ def _bucketed_core(
     return jnp.maximum(-neg, 0.0), win_ids
 
 
+def _residual_index_data(lists, centroids, compute_dtype, chunk: int = 64):
+    """(resid_norms f32, lists_lo compute-dtype) for the bucketed scan —
+    the residual-encoded index-side device data (see _bucketed_core).
+    ``lists`` may have more rows than ``centroids`` (sharding pad): pad
+    centroids with zeros — pad lists are never probed.
+
+    Large single-device indexes stream through a ``lax.map`` over list
+    chunks: the f32 residual intermediate of a multi-GB index would
+    otherwise transiently double the index's HBM footprint."""
+    nlist, maxlen, d = lists.shape
+    cpad = jnp.pad(
+        jnp.asarray(centroids, jnp.float32),
+        ((0, nlist - centroids.shape[0]), (0, 0)),
+    )
+    single = getattr(lists.sharding, "num_devices", 1) == 1 if hasattr(
+        lists, "sharding"
+    ) else True
+    while chunk > 1 and nlist % chunk:
+        chunk //= 2  # largest power-of-two divisor; 1 always divides
+    if single and nlist % chunk == 0 and lists.size * 4 > 2**30:
+        def f(args):
+            lb, cb = args
+            r = lb.astype(jnp.float32) - cb[:, None, :]
+            return jnp.sum(jnp.square(r), axis=2), r.astype(compute_dtype)
+
+        norms, lo = jax.lax.map(
+            f,
+            (
+                lists.reshape(nlist // chunk, chunk, maxlen, d),
+                cpad.reshape(nlist // chunk, chunk, d),
+            ),
+        )
+        return norms.reshape(nlist, maxlen), lo.reshape(nlist, maxlen, d)
+    resid = lists.astype(jnp.float32) - cpad[:, None, :]
+    return jnp.sum(jnp.square(resid), axis=2), resid.astype(compute_dtype)
+
+
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
-                  slack: float = 1.5):
+                  slack: float = 1.5, shortlist_mult: int = 2):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -578,36 +754,68 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         return dists, ids
 
     @jax.jit
-    def query_bucketed(centroids, lists, list_ids, list_mask, queries, n_valid, list_norms):
+    def probe_bucketed(centroids, queries):
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        # Full-f32 centroid distances: the values feed the residual
+        # identity's cross-list ‖q−c‖² term, where bf16-magnitude noise
+        # would corrupt the candidate shortlist ordering. The GEMM is
+        # (q, nlist, d) — trivial FLOPs next to the selection.
+        with mm_precision(jnp.float32):
+            cd2 = sq_euclidean(
+                queries.astype(jnp.float32), centroids.astype(jnp.float32),
+                accum_dtype=jnp.float32,
+            )
+        # Probing is this executor's approximation already; an exact top_k
+        # here costs more than the whole list scan (it sorts every
+        # (q, nlist) row), so select probes approximately too — misses are
+        # distant lists that contribute the least recall.
+        probe_d2, probe = jax.lax.approx_min_k(cd2, nprobe, recall_target=0.95)
+        return probe.astype(jnp.int32), probe_d2
+
+    @jax.jit
+    def core_bucketed(queries, probe, probe_d2, centroids, lists, list_ids,
+                      list_mask, n_valid, resid_norms, lists_lo):
         q = queries.shape[0]
         nlist = lists.shape[0]
         C = _bucketed_capacity(q, nprobe, nlist, slack)
-        qc = queries.astype(compute_dtype)
-        cd2 = sq_euclidean(qc, centroids.astype(compute_dtype), accum_dtype=accum_dtype)
-        _, probe = jax.lax.top_k(-cd2, nprobe)  # (q, nprobe)
         return _bucketed_core(
-            qc, queries, probe, lists, list_ids, list_mask, list_norms,
-            n_valid, k, nprobe, C, compute_dtype, accum_dtype,
-            list_block=LIST_BLOCK,
+            queries, probe, probe_d2, lists, list_ids, list_mask,
+            resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
+            list_block=16, shortlist_mult=shortlist_mult,
+            lists_lo=lists_lo, centroids=centroids,
+        )
+
+    def query_bucketed(centroids, lists, list_ids, list_mask, queries, n_valid,
+                       resid_norms, lists_lo):
+        # Two dispatches, not one fused jit: XLA schedules the monolithic
+        # probe+scan+rerank graph measurably worse (+20% wall) than the
+        # same stages compiled separately and pipelined by async dispatch.
+        probe, probe_d2 = probe_bucketed(centroids, queries)
+        return core_bucketed(
+            queries, probe, probe_d2, centroids, lists, list_ids, list_mask,
+            n_valid, resid_norms, lists_lo,
         )
 
     def query(centroids, lists, list_ids, list_mask, queries,
-              n_valid=None, list_norms=None):
+              n_valid=None, resid_norms=None, lists_lo=None):
         # Host-side dispatch on the index shape (static under each jit).
         # n_valid: true query count when the batch is padded (default: all
-        # rows are real). list_norms: precomputed Σrow² (nlist, maxlen) —
-        # computed here per call if absent.
+        # rows are real). resid_norms / lists_lo: precomputed index-side
+        # device data (f32 Σ(row−c)² and the compute-dtype RESIDUAL scan
+        # copy) — computed here per call if absent; serving callers cache
+        # them (the model does, via _ensure_dev_index).
         if mode == "dense" or (mode == "auto" and nprobe * 4 >= lists.shape[0]):
             return query_dense(centroids, lists, list_ids, list_mask, queries)
         if n_valid is None:
             n_valid = queries.shape[0]
-        if list_norms is None:
-            list_norms = jnp.sum(
-                jnp.square(lists.astype(accum_dtype)), axis=2
+        if resid_norms is None or lists_lo is None:
+            resid_norms, lists_lo = _residual_index_data(
+                lists, centroids, compute_dtype
             )
         return query_bucketed(
             centroids, lists, list_ids, list_mask, queries,
-            jnp.asarray(n_valid, jnp.int32), list_norms,
+            jnp.asarray(n_valid, jnp.int32), resid_norms, lists_lo,
         )
 
     return query
@@ -615,7 +823,8 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
 
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn_sharded(
-    k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5
+    k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5,
+    shortlist_mult: int = 2,
 ):
     """Sharded IVF query: inverted lists sharded over the ``data`` mesh
     axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
@@ -635,21 +844,37 @@ def _ivf_query_fn_sharded(
     accum_dtype = jnp.dtype(ad)
     n_data = mesh.shape[DATA_AXIS]
 
-    def shard(centroids, lists, list_ids, list_mask, list_norms, queries, n_valid):
+    def shard(cent_pad, lists, list_ids, list_mask, resid_norms, lists_lo,
+              queries, n_valid, n_real):
+        # cent_pad: (nlist_padded, d) f32 centroids, zero-padded to the
+        # sharded list count and replicated; pad lists (columns >= n_real)
+        # are masked to +inf so they are never probed.
         q = queries.shape[0]
         nlist_local = lists.shape[0]
-        qc = queries.astype(compute_dtype)
-        cd2 = sq_euclidean(
-            qc, centroids.astype(compute_dtype), accum_dtype=accum_dtype
-        )
-        _, probe = jax.lax.top_k(-cd2, nprobe)  # global list ids, replicated
+        from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+        with mm_precision(jnp.float32):  # exact ‖q−c‖² (see probe_bucketed)
+            cd2 = sq_euclidean(
+                queries.astype(jnp.float32), cent_pad, accum_dtype=jnp.float32
+            )
+        pad_col = jax.lax.broadcasted_iota(jnp.int32, cd2.shape, 1) >= n_real
+        cd2 = jnp.where(pad_col, jnp.inf, cd2)
+        # Approximate probe selection, same trade as the single-device
+        # bucketed executor (every device computes the identical set).
+        probe_d2, probe = jax.lax.approx_min_k(cd2, nprobe, recall_target=0.95)
+        probe = probe.astype(jnp.int32)  # global list ids, replicated
         lo = jax.lax.axis_index(DATA_AXIS).astype(jnp.int32) * nlist_local
         local = (probe >= lo) & (probe < lo + nlist_local)
         probe_local = jnp.where(local, probe - lo, -1)
+        cent_local = jax.lax.dynamic_slice(
+            cent_pad, (lo, jnp.zeros((), lo.dtype)), (nlist_local, cent_pad.shape[1])
+        )
         C = _bucketed_capacity(q, nprobe, nlist_local * n_data, slack)
         dists, ids = _bucketed_core(
-            qc, queries, probe_local, lists, list_ids, list_mask, list_norms,
-            n_valid, k, nprobe, C, compute_dtype, accum_dtype,
+            queries, probe_local, probe_d2, lists, list_ids, list_mask,
+            resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
+            shortlist_mult=shortlist_mult,
+            lists_lo=lists_lo, centroids=cent_local,
         )
         # Merge the per-device top-k: O(q·k·devices) over ICI.
         cat_d = jax.lax.all_gather(dists, DATA_AXIS, axis=1, tiled=True)
@@ -666,6 +891,8 @@ def _ivf_query_fn_sharded(
             P(DATA_AXIS, None),
             P(DATA_AXIS, None),
             P(DATA_AXIS, None),
+            P(DATA_AXIS, None, None),
+            P(),
             P(),
             P(),
         ),
@@ -675,14 +902,22 @@ def _ivf_query_fn_sharded(
     jitted = jax.jit(f)
 
     def query(centroids, lists, list_ids, list_mask, queries,
-              n_valid=None, list_norms=None):
+              n_valid=None, resid_norms=None, lists_lo=None):
         if n_valid is None:
             n_valid = queries.shape[0]
-        if list_norms is None:
-            list_norms = jnp.sum(jnp.square(lists.astype(accum_dtype)), axis=2)
+        if resid_norms is None or lists_lo is None:
+            resid_norms, lists_lo = _residual_index_data(
+                lists, centroids, compute_dtype
+            )
+        nlist_pad = lists.shape[0]
+        cent_pad = jnp.pad(
+            jnp.asarray(centroids, jnp.float32),
+            ((0, nlist_pad - centroids.shape[0]), (0, 0)),
+        )
         return jitted(
-            centroids, lists, list_ids, list_mask, list_norms, queries,
-            jnp.asarray(n_valid, jnp.int32),
+            cent_pad, lists, list_ids, list_mask, resid_norms, lists_lo,
+            queries, jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(centroids.shape[0], jnp.int32),
         )
 
     return query
@@ -807,29 +1042,34 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         lists = put(idx.lists, P(DATA_AXIS, None, None), ((0, pad), (0, 0), (0, 0)))
         ids = put(idx.list_ids, P(DATA_AXIS, None), ((0, pad), (0, 0)), fill=-1)
         mask = put(idx.list_mask, P(DATA_AXIS, None), ((0, pad), (0, 0)))
-        norms = jnp.sum(jnp.square(lists.astype(jnp.float32)), axis=2)
-        self._dev_index = (
-            jax.device_put(np.asarray(idx.centroids), NamedSharding(mesh, P())),
-            lists,
-            ids,
-            mask,
-            norms,
+        cent = jax.device_put(np.asarray(idx.centroids), NamedSharding(mesh, P()))
+        resid_norms, lists_lo = _residual_index_data(
+            lists, cent, jnp.dtype(config.get("compute_dtype"))
         )
+        self._dev_index = (cent, lists, ids, mask, resid_norms, lists_lo)
         self._shard_mesh = mesh
         return self
 
     def _ensure_dev_index(self):
-        """Upload the index (+ row norms) to device ONCE per model — the
-        reference re-uploads its model matrix every batch (SURVEY.md §3.2,
-        rapidsml_jni.cu:85); repeated query batches here reuse residents."""
+        """Upload the index (+ row norms + the compute-dtype scan copy) to
+        device ONCE per model — the reference re-uploads its model matrix
+        every batch (SURVEY.md §3.2, rapidsml_jni.cu:85); repeated query
+        batches here reuse residents. The bfloat16 scan copy costs +50%
+        of the f32 lists' HBM but halves the dominant scan traffic (the
+        exact rerank keeps reading the f32 rows)."""
         if self._dev_index is None:
             lists = jnp.asarray(self.index.lists)
+            cent = jnp.asarray(self.index.centroids)
+            resid_norms, lists_lo = _residual_index_data(
+                lists, cent, jnp.dtype(config.get("compute_dtype"))
+            )
             self._dev_index = (
-                jnp.asarray(self.index.centroids),
+                cent,
                 lists,
                 jnp.asarray(self.index.list_ids),
                 jnp.asarray(self.index.list_mask),
-                jnp.sum(jnp.square(lists.astype(jnp.float32)), axis=2),
+                resid_norms,
+                lists_lo,
             )
         return self._dev_index
 
@@ -865,15 +1105,18 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                 fn = _ivf_query_fn_sharded(
                     k, nprobe, config.get("compute_dtype"),
                     config.get("accum_dtype"), self._shard_mesh,
+                    shortlist_mult=int(config.get("ann_shortlist_mult")),
                 )
             else:
                 fn = _ivf_query_fn(
-                    k, nprobe, config.get("compute_dtype"), config.get("accum_dtype")
+                    k, nprobe, config.get("compute_dtype"),
+                    config.get("accum_dtype"),
+                    shortlist_mult=int(config.get("ann_shortlist_mult")),
                 )
-            cent, lists, ids_dev, mask, norms = self._ensure_dev_index()
+            cent, lists, ids_dev, mask, rnorms, lists_lo = self._ensure_dev_index()
             d2, ids = jax.device_get(
                 fn(cent, lists, ids_dev, mask, jnp.asarray(qp),
-                   n_valid=q, list_norms=norms)
+                   n_valid=q, resid_norms=rnorms, lists_lo=lists_lo)
             )
         return np.sqrt(np.maximum(d2[:q], 0)), ids[:q].astype(np.int64)
 

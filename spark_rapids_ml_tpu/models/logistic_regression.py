@@ -74,8 +74,9 @@ def _pcg_solve(h, g, x0, max_iter: Optional[int] = None, rtol: float = 1e-2):
     Hessian only preconditions), and the previous iteration's direction
     warm-starts the next. Terminates on negative-curvature breakdown
     (truncated-Newton style: fast-precision Hessians of near-separable
-    unregularized fits can be numerically indefinite) — the accumulated
-    ``x`` so far is still a descent-preconditioned direction.
+    unregularized fits can be numerically indefinite); if breakdown hits
+    before any CG step succeeds, returns the preconditioned gradient
+    instead of the stale warm start (Steihaug convention).
     """
     d = h.shape[0]
     if max_iter is None:
@@ -91,11 +92,11 @@ def _pcg_solve(h, g, x0, max_iter: Optional[int] = None, rtol: float = 1e-2):
     z0 = dinv * r0
 
     def cond(c):
-        _, r, _, _, it = c
+        _, r, _, _, it, _ = c
         return jnp.logical_and(it < max_iter, jnp.linalg.norm(r) > rtol * gnorm)
 
     def body(c):
-        x, r, p, rz, it = c
+        x, r, p, rz, it, nstep = c
         hp = h @ p
         php = p @ hp
         broke = php <= 0.0
@@ -107,12 +108,22 @@ def _pcg_solve(h, g, x0, max_iter: Optional[int] = None, rtol: float = 1e-2):
         p = z + (rz2 / jnp.where(rz != 0.0, rz, 1.0)) * p
         # On breakdown, force the loop to exit (it = max_iter) rather than
         # spinning out the remaining matvecs on a frozen residual.
-        return x, r, p, rz2, jnp.where(broke, max_iter, it + 1)
+        return (
+            x, r, p, rz2,
+            jnp.where(broke, max_iter, it + 1),
+            nstep + jnp.where(broke, 0, 1),
+        )
 
-    x, _, _, _, _ = jax.lax.while_loop(
-        cond, body, (x0, r0, z0, r0 @ z0, jnp.zeros((), jnp.int32))
+    x, _, _, _, _, nstep = jax.lax.while_loop(
+        cond, body, (x0, r0, z0, r0 @ z0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
     )
-    return x
+    # nstep == 0 means either the warm start already satisfied the
+    # tolerance (keep it — it IS the solution) or the very first curvature
+    # was non-positive (x is then the stale warm start, unrelated to the
+    # CURRENT gradient: fall back to the preconditioned gradient,
+    # Steihaug convention).
+    warm_ok = jnp.linalg.norm(r0) <= rtol * gnorm
+    return jnp.where((nstep > 0) | warm_ok, x, dinv * g)
 
 
 def _pallas_newton_applicable(shape, cd, ad, use_pallas: Optional[bool] = None) -> bool:

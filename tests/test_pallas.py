@@ -150,6 +150,42 @@ def test_newton_stats_parity(rng):
     np.testing.assert_allclose(float(hbb), wgt.sum(), rtol=1e-4, atol=1e-2)
 
 
+def test_newton_stats_parity_bf16(rng):
+    """The production mode: the fused fit path only engages the kernel at
+    compute_dtype=bfloat16 (models/logistic_regression._pallas_newton_applicable),
+    so parity must hold for bf16-stored x with its own rounding."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.pallas_kernels import newton_stats_pallas
+
+    n, d = 512, 256
+    x16 = jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16)
+    x = np.asarray(x16, np.float32)  # the rounded values ARE the data
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    mask[-60:] = 0.0
+    w = (rng.normal(size=(d,)) / np.sqrt(d)).astype(np.float32)
+    b = np.float32(-0.2)
+    gw, gb, hww, hwb, hbb = newton_stats_pallas(
+        x16, y, mask, w, b, block_n=256, interpret=True
+    )
+    # Oracle mirrors the kernel's bf16 rounding points: w and the
+    # residual/weight operands round to bf16 before their GEMMs.
+    w16 = np.asarray(jnp.asarray(w, jnp.bfloat16), np.float32)
+    z = x @ w16 + b
+    p = 1.0 / (1.0 + np.exp(-z))
+    r16 = np.asarray(jnp.asarray((p - y) * mask, jnp.bfloat16), np.float32)
+    wgt = np.maximum(p * (1.0 - p), 1e-10) * mask
+    wgt16 = np.asarray(jnp.asarray(wgt, jnp.bfloat16), np.float32)
+    np.testing.assert_allclose(np.asarray(gw), x.T @ r16, rtol=2e-2, atol=2e-1)
+    np.testing.assert_allclose(float(gb), ((p - y) * mask).sum(), rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(hww), (x * wgt16[:, None]).T @ x, rtol=2e-2, atol=5e-1
+    )
+    np.testing.assert_allclose(np.asarray(hwb), x.T @ wgt16, rtol=2e-2, atol=2e-1)
+    np.testing.assert_allclose(float(hbb), wgt.sum(), rtol=1e-3, atol=1e-2)
+
+
 def test_newton_stats_block_validation(rng):
     from spark_rapids_ml_tpu.ops.pallas_kernels import newton_stats_pallas
 
