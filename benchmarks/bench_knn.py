@@ -1,8 +1,8 @@
 """IVF-Flat approximate-KNN query throughput — BASELINE.json config #5
 (10M×768 SBERT-class embeddings; scaled to one chip's HBM here).
 
-Builds the IVF-Flat index (`models.knn.build_ivf_flat`: KMeans coarse
-quantizer + padded inverted lists), then times batched queries
+Builds the IVF-Flat index on device (`models.knn.build_ivf_flat_device`:
+KMeans coarse quantizer + on-device bucketing), then times batched queries
 (`_ivf_query_fn`: centroid GEMM → top-nprobe probe → per-list distance
 GEMMs → top-k), reporting queries/s/chip.
 
@@ -39,7 +39,7 @@ def main() -> None:
 
     from benchmarks import emit
     from spark_rapids_ml_tpu import config
-    from spark_rapids_ml_tpu.models.knn import _ivf_query_fn, build_ivf_flat
+    from spark_rapids_ml_tpu.models.knn import _ivf_query_fn
 
     config.set("compute_dtype", "bfloat16")
     config.set("accum_dtype", "float32")

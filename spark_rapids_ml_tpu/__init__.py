@@ -41,6 +41,20 @@ from spark_rapids_ml_tpu.models.knn import (
     ApproximateNearestNeighbors,
     ApproximateNearestNeighborsModel,
 )
+from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
+from spark_rapids_ml_tpu.pipeline import Pipeline, PipelineModel
+from spark_rapids_ml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
 
 __all__ = [
     "PCA",
@@ -55,6 +69,18 @@ __all__ = [
     "NearestNeighborsModel",
     "ApproximateNearestNeighbors",
     "ApproximateNearestNeighborsModel",
+    "StandardScaler",
+    "StandardScalerModel",
+    "Pipeline",
+    "PipelineModel",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
+    "RegressionEvaluator",
+    "BinaryClassificationEvaluator",
+    "MulticlassClassificationEvaluator",
     "config",
     "__version__",
 ]

@@ -94,6 +94,24 @@ def as_column(dataset: Any, col: str) -> np.ndarray:
     )
 
 
+def take_rows(dataset: Any, indices: np.ndarray) -> Any:
+    """Row-subset the dataset by integer indices, preserving container kind.
+
+    The fold-split primitive for CrossValidator/TrainValidationSplit
+    (tuning.py) — mirrors ``df.filter`` + randomSplit semantics without a
+    query engine."""
+    indices = np.asarray(indices)
+    if _is_arrow(dataset):
+        if isinstance(dataset, pa.RecordBatch):
+            dataset = pa.Table.from_batches([dataset])
+        return dataset.take(pa.array(indices))
+    if _is_pandas(dataset):
+        return dataset.iloc[indices].reset_index(drop=True)
+    if isinstance(dataset, dict):
+        return {k: np.asarray(v)[indices] for k, v in dataset.items()}
+    return np.asarray(dataset)[indices]
+
+
 def with_column(dataset: Any, name: str, values: np.ndarray) -> Any:
     """Return the dataset with ``values`` appended as column ``name``.
 

@@ -8,5 +8,6 @@ only the import. This module is the same shim for Python:
 """
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
 
-__all__ = ["PCA", "PCAModel"]
+__all__ = ["PCA", "PCAModel", "StandardScaler", "StandardScalerModel"]

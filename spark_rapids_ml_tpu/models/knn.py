@@ -341,9 +341,14 @@ def build_ivf_flat_device(
 
     x = jnp.asarray(x, jnp.float32)
     n, d = x.shape
+    n_train = min(n, train_rows)
+    if n_train < nlist:
+        raise ValueError(
+            f"effective train rows = {n_train} must be >= nlist = {nlist} "
+            f"(the quantizer needs at least one training row per list)"
+        )
     key = jax.random.key(seed)
     k_samp, k_init, k_shuf = jax.random.split(key, 3)
-    n_train = min(n, train_rows)
     sample = (
         x[jax.random.choice(k_samp, n, (n_train,), replace=False)]
         if n > train_rows
@@ -805,7 +810,17 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         # device data (f32 Σ(row−c)² and the compute-dtype RESIDUAL scan
         # copy) — computed here per call if absent; serving callers cache
         # them (the model does, via _ensure_dev_index).
-        if mode == "dense" or (mode == "auto" and nprobe * 4 >= lists.shape[0]):
+        dense_auto = (
+            nprobe * 4 >= lists.shape[0]
+            and jnp.dtype(compute_dtype) == jnp.float32
+        )
+        # At bfloat16 compute the dense executor's raw-magnitude scores
+        # suffer the recall collapse residual encoding exists to fix (its
+        # "exact within probed lists" contract only holds at f32), so auto
+        # routes everything to the bucketed executor there — with nprobe
+        # near nlist its capacity clamps at q and it degenerates to a
+        # dense-FLOPs scan WITH residual scoring + exact rerank.
+        if mode == "dense" or (mode == "auto" and dense_auto):
             return query_dense(centroids, lists, list_ids, list_mask, queries)
         if n_valid is None:
             n_valid = queries.shape[0]
@@ -988,7 +1003,8 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
     def __init__(self, index: Optional[IVFFlatIndex] = None, uid=None):
         super().__init__(uid=uid)
         self.index = index
-        self._dev_index = None  # device-resident index + norms cache
+        self._dev_index = None  # device-resident index cache
+        self._resid_cache = None  # bucketed executor's residual data (lazy)
         self._shard_mesh = None  # set by shard_index()
 
     def _model_data(self):
@@ -1012,6 +1028,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
     def _copy_extra_state(self, source):
         self.index = source.index
         self._dev_index = None
+        self._resid_cache = None
         # Re-run the sharded placement (it pads nlist to a device multiple
         # — an invariant _ensure_dev_index alone would not restore).
         src_mesh = getattr(source, "_shard_mesh", None)
@@ -1043,35 +1060,35 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         ids = put(idx.list_ids, P(DATA_AXIS, None), ((0, pad), (0, 0)), fill=-1)
         mask = put(idx.list_mask, P(DATA_AXIS, None), ((0, pad), (0, 0)))
         cent = jax.device_put(np.asarray(idx.centroids), NamedSharding(mesh, P()))
-        resid_norms, lists_lo = _residual_index_data(
-            lists, cent, jnp.dtype(config.get("compute_dtype"))
-        )
-        self._dev_index = (cent, lists, ids, mask, resid_norms, lists_lo)
+        self._dev_index = (cent, lists, ids, mask)
+        self._resid_cache = None  # built lazily, keyed by compute_dtype
         self._shard_mesh = mesh
         return self
 
     def _ensure_dev_index(self):
-        """Upload the index (+ row norms + the compute-dtype scan copy) to
-        device ONCE per model — the reference re-uploads its model matrix
-        every batch (SURVEY.md §3.2, rapidsml_jni.cu:85); repeated query
-        batches here reuse residents. The bfloat16 scan copy costs +50%
-        of the f32 lists' HBM but halves the dominant scan traffic (the
-        exact rerank keeps reading the f32 rows)."""
+        """Upload the index to device ONCE per model — the reference
+        re-uploads its model matrix every batch (SURVEY.md §3.2,
+        rapidsml_jni.cu:85); repeated query batches here reuse residents."""
         if self._dev_index is None:
-            lists = jnp.asarray(self.index.lists)
-            cent = jnp.asarray(self.index.centroids)
-            resid_norms, lists_lo = _residual_index_data(
-                lists, cent, jnp.dtype(config.get("compute_dtype"))
-            )
             self._dev_index = (
-                cent,
-                lists,
+                jnp.asarray(self.index.centroids),
+                jnp.asarray(self.index.lists),
                 jnp.asarray(self.index.list_ids),
                 jnp.asarray(self.index.list_mask),
-                resid_norms,
-                lists_lo,
             )
         return self._dev_index
+
+    def _ensure_resid_data(self, cd):
+        """The bucketed executor's residual scan copy + norms, built lazily
+        (dense-dispatch queries never pay its +50% index HBM) and KEYED BY
+        compute dtype — a config change between queries rebuilds it rather
+        than silently scanning at the stale precision."""
+        cd = jnp.dtype(cd)
+        cache = getattr(self, "_resid_cache", None)
+        if cache is None or cache[0] != cd:
+            cent, lists = self._dev_index[0], self._dev_index[1]
+            self._resid_cache = (cd, *_residual_index_data(lists, cent, cd))
+        return self._resid_cache[1], self._resid_cache[2]
 
     def kneighbors(
         self, queries: np.ndarray, k: Optional[int] = None
@@ -1113,7 +1130,16 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     config.get("accum_dtype"),
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
                 )
-            cent, lists, ids_dev, mask, rnorms, lists_lo = self._ensure_dev_index()
+            cent, lists, ids_dev, mask = self._ensure_dev_index()
+            cd = jnp.dtype(config.get("compute_dtype"))
+            # Mirror the executor's dispatch: dense (f32, wide probing)
+            # never reads the residual cache — don't build it.
+            dense = (
+                self._shard_mesh is None
+                and nprobe * 4 >= lists.shape[0]
+                and cd == jnp.float32
+            )
+            rnorms, lists_lo = (None, None) if dense else self._ensure_resid_data(cd)
             d2, ids = jax.device_get(
                 fn(cent, lists, ids_dev, mask, jnp.asarray(qp),
                    n_valid=q, resid_norms=rnorms, lists_lo=lists_lo)

@@ -1,0 +1,135 @@
+"""Pipeline — chained estimators/transformers, Spark ML semantics.
+
+Mirrors ``org.apache.spark.ml.Pipeline``: ``fit`` walks the stages in
+order, fitting each Estimator on the dataset as transformed by everything
+before it (and transforming through the fitted model so later stages see
+its output); Models/transformers pass through. The result is a
+``PipelineModel`` whose ``transform`` applies every fitted stage in order.
+
+The reference exposes a single drop-in estimator precisely so it can slot
+into Spark's own Pipeline machinery (README.md:27-37); since this
+framework replaces that machinery host-side, it carries the Pipeline
+contract itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from spark_rapids_ml_tpu.core.params import Estimator, Model, Params
+from spark_rapids_ml_tpu.core.persistence import (
+    DefaultParamsReader,
+    DefaultParamsWriter,
+    MLReadable,
+    MLWritable,
+)
+
+
+class _StagesMixin(Params):
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def _save_stages(self, path: str, stages) -> None:
+        if os.path.exists(path):
+            raise FileExistsError(f"path {path} already exists")
+        os.makedirs(path)
+        DefaultParamsWriter.save_metadata(
+            self, path, extra={"stageUids": [s.uid for s in stages]}
+        )
+        for i, stage in enumerate(stages):
+            if not isinstance(stage, MLWritable):
+                raise TypeError(f"stage {stage.uid} is not MLWritable")
+            stage.save(os.path.join(path, "stages", f"{i}_{stage.uid}"))
+
+    @staticmethod
+    def _load_stages(path: str, meta) -> list:
+        stages_dir = os.path.join(path, "stages")
+        loaded = []
+        for i, uid in enumerate(meta["stageUids"]):
+            loaded.append(
+                DefaultParamsReader.load_instance(
+                    os.path.join(stages_dir, f"{i}_{uid}")
+                )
+            )
+        return loaded
+
+
+class Pipeline(Estimator, _StagesMixin, MLWritable, MLReadable):
+    _uid_prefix = "Pipeline"
+
+    def __init__(self, stages: Optional[List] = None, uid=None):
+        super().__init__(uid=uid)
+        self._stages = list(stages or [])
+
+    def setStages(self, stages: List) -> "Pipeline":
+        self._stages = list(stages)
+        return self
+
+    def getStages(self) -> List:
+        return list(self._stages)
+
+    def _copy_extra_state(self, source):
+        self._stages = [s.copy() for s in getattr(source, "_stages", [])]
+
+    def _fit(self, dataset) -> "PipelineModel":
+        fitted = []
+        current = dataset
+        for i, stage in enumerate(self._stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+            elif isinstance(stage, Model):
+                model = stage
+            else:
+                raise TypeError(
+                    f"stage {i} ({type(stage).__name__}) is neither an "
+                    f"Estimator nor a Model/transformer"
+                )
+            fitted.append(model)
+            if i < len(self._stages) - 1:  # the last output is never consumed
+                current = model.transform(current)
+        pm = PipelineModel(stages=fitted)
+        pm.uid = self.uid
+        return pm
+
+    # -- persistence (stages are saved individually, like Spark) ----------
+    def save(self, path: str) -> None:
+        self._save_stages(path, self._stages)
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        meta = DefaultParamsReader.load_metadata(path)
+        obj = cls(stages=cls._load_stages(path, meta))
+        obj.uid = meta["uid"]
+        return obj
+
+
+class PipelineModel(Model, _StagesMixin, MLWritable, MLReadable):
+    _uid_prefix = "PipelineModel"
+
+    def __init__(self, stages: Optional[List] = None, uid=None):
+        super().__init__(uid=uid)
+        self._stages = list(stages or [])
+
+    @property
+    def stages(self) -> List:
+        return list(self._stages)
+
+    def _copy_extra_state(self, source):
+        self._stages = [s.copy() for s in getattr(source, "_stages", [])]
+
+    def _transform(self, dataset):
+        current = dataset
+        for stage in self._stages:
+            current = stage.transform(current)
+        return current
+
+    def save(self, path: str) -> None:
+        self._save_stages(path, self._stages)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        meta = DefaultParamsReader.load_metadata(path)
+        obj = cls(stages=cls._load_stages(path, meta))
+        obj.uid = meta["uid"]
+        return obj
