@@ -35,6 +35,7 @@ from spark_rapids_ml_tpu.spark.estimator import (
     SparkLogisticRegression,
     SparkNearestNeighbors,
     SparkApproximateNearestNeighbors,
+    SparkStandardScaler,
 )
 
 __all__ = [
@@ -47,4 +48,5 @@ __all__ = [
     "SparkLogisticRegression",
     "SparkNearestNeighbors",
     "SparkApproximateNearestNeighbors",
+    "SparkStandardScaler",
 ]

@@ -178,6 +178,7 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegression as _LogisticRegression,
 )
 from spark_rapids_ml_tpu.models.pca import PCA as _PCA
+from spark_rapids_ml_tpu.models.scaler import StandardScaler as _StandardScaler
 
 SparkPCA = _make_wrapper(
     "SparkPCA", _PCA, "PCA over PySpark DataFrames (ArrayType features column)."
@@ -198,4 +199,8 @@ SparkApproximateNearestNeighbors = _make_wrapper(
     "SparkApproximateNearestNeighbors",
     _ApproximateNearestNeighbors,
     "IVF-Flat approximate KNN over PySpark DataFrames.",
+)
+SparkStandardScaler = _make_wrapper(
+    "SparkStandardScaler", _StandardScaler,
+    "StandardScaler over PySpark DataFrames (ArrayType features column).",
 )
