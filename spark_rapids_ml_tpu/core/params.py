@@ -276,7 +276,17 @@ class Params:
         that._copy_extra_state(self)
         if extra:
             for param, value in extra.items():
-                that.set(param, value)
+                if isinstance(param, Param):
+                    # pyspark semantics: Param-keyed extras for params this
+                    # instance does not declare are silently skipped — a
+                    # grid built on a Pipeline stage's params must pass
+                    # through the Pipeline's own copy unharmed (the stage
+                    # copies pick them up).
+                    if that.hasParam(param.name):
+                        that.set(param, value)
+                else:
+                    # String keys keep the typo guard: unknown names raise.
+                    that.set(param, value)
         return that
 
     @classmethod

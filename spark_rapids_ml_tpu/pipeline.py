@@ -30,6 +30,19 @@ class _StagesMixin(Params):
     def __init__(self, uid=None):
         super().__init__(uid=uid)
 
+    def _copy_extra_state(self, source):
+        # Shallow share: copy() below always rebuilds the stage list (it is
+        # the only caller path), so no throwaway per-stage copies here.
+        self._stages = list(getattr(source, "_stages", []))
+
+    def copy(self, extra=None):
+        # Spark semantics: ``extra`` flows into the stage copies, so a
+        # CrossValidator grid keyed on a stage's params tunes the stage
+        # through the enclosing Pipeline(Model).
+        that = super().copy(extra)
+        that._stages = [s.copy(extra) for s in self._stages]
+        return that
+
     def _save_stages(self, path: str, stages) -> None:
         if os.path.exists(path):
             raise FileExistsError(f"path {path} already exists")
@@ -68,9 +81,6 @@ class Pipeline(Estimator, _StagesMixin, MLWritable, MLReadable):
 
     def getStages(self) -> List:
         return list(self._stages)
-
-    def _copy_extra_state(self, source):
-        self._stages = [s.copy() for s in getattr(source, "_stages", [])]
 
     def _fit(self, dataset) -> "PipelineModel":
         fitted = []
@@ -114,9 +124,6 @@ class PipelineModel(Model, _StagesMixin, MLWritable, MLReadable):
     @property
     def stages(self) -> List:
         return list(self._stages)
-
-    def _copy_extra_state(self, source):
-        self._stages = [s.copy() for s in getattr(source, "_stages", [])]
 
     def _transform(self, dataset):
         current = dataset
