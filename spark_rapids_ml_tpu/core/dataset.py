@@ -78,6 +78,17 @@ def as_matrix(dataset: Any, col: Optional[str] = None, n_cols: Optional[int] = N
     return arr
 
 
+def has_column(dataset: Any, col: str) -> bool:
+    """Whether the dataset carries a column named ``col``."""
+    if _is_arrow(dataset):
+        return col in dataset.schema.names
+    if _is_pandas(dataset):
+        return col in dataset.columns
+    if isinstance(dataset, dict):
+        return col in dataset
+    return False
+
+
 def as_column(dataset: Any, col: str) -> np.ndarray:
     """Extract a scalar column (labels, weights) as a 1-D ndarray."""
     if _is_arrow(dataset):

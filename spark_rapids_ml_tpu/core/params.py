@@ -73,34 +73,62 @@ class TypeConverters:
         return value
 
 
+class _Cmp:
+    """Picklable predicate (lambdas would break shipping params/models to
+    Spark executors through stdlib pickle)."""
+
+    __slots__ = ("op", "a", "b")
+
+    def __init__(self, op, a, b=None):
+        self.op, self.a, self.b = op, a, b
+
+    def __call__(self, v):
+        if self.op == "gt":
+            return v > self.a
+        if self.op == "gtEq":
+            return v >= self.a
+        if self.op == "lt":
+            return v < self.a
+        if self.op == "ltEq":
+            return v <= self.a
+        if self.op == "inRange":
+            return self.a <= v <= self.b
+        return v in self.a  # inList
+
+    def __getstate__(self):
+        return (self.op, self.a, self.b)
+
+    def __setstate__(self, state):
+        self.op, self.a, self.b = state
+
+
 class ParamValidators:
     """Value-validity predicates, mirroring org.apache.spark.ml.param.ParamValidators
     (the reference's inherited ``k`` uses ``gt(0)`` via Spark's PCAParams)."""
 
     @staticmethod
     def gt(lower):
-        return lambda v: v > lower
+        return _Cmp("gt", lower)
 
     @staticmethod
     def gtEq(lower):
-        return lambda v: v >= lower
+        return _Cmp("gtEq", lower)
 
     @staticmethod
     def lt(upper):
-        return lambda v: v < upper
+        return _Cmp("lt", upper)
 
     @staticmethod
     def ltEq(upper):
-        return lambda v: v <= upper
+        return _Cmp("ltEq", upper)
 
     @staticmethod
     def inRange(lower, upper):
-        return lambda v: lower <= v <= upper
+        return _Cmp("inRange", lower, upper)
 
     @staticmethod
     def inList(allowed):
-        allowed = tuple(allowed)
-        return lambda v: v in allowed
+        return _Cmp("inList", tuple(allowed))
 
 
 class Param(Generic[T]):
@@ -195,6 +223,22 @@ class Params:
                     setattr(self, attr_name, p)
                     self._params[decl.name] = p
 
+    # Attribute names reset when pickling (estimators/models ship to Spark
+    # executors inside transform/feed tasks): jitted-closure caches, device
+    # arrays, and mesh handles are process-local and rebuild lazily on the
+    # other side. Names ending in ``_cache`` reset to {} automatically;
+    # subclasses extend this tuple for other device-resident state.
+    _transient_attrs: tuple = ("_mesh",)
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        for name in list(state):
+            if name.endswith("_cache"):
+                state[name] = {} if isinstance(state[name], dict) else None
+            elif name in self._transient_attrs:
+                state[name] = None
+        return state
+
     # -- registry ----------------------------------------------------------
     @property
     def params(self) -> List[Param]:
@@ -277,12 +321,15 @@ class Params:
         if extra:
             for param, value in extra.items():
                 if isinstance(param, Param):
-                    # pyspark semantics: Param-keyed extras for params this
-                    # instance does not declare are silently skipped — a
-                    # grid built on a Pipeline stage's params must pass
-                    # through the Pipeline's own copy unharmed (the stage
-                    # copies pick them up).
-                    if that.hasParam(param.name):
+                    # pyspark semantics: ParamMaps key by (parent uid, name).
+                    # A Param-keyed extra applies only to the instance whose
+                    # uid it was bound to — a grid built on one Pipeline
+                    # stage's params must pass through the Pipeline's own
+                    # copy unharmed and must NOT hit same-named params on
+                    # other stages (e.g. LinearRegression.maxIter vs
+                    # KMeans.maxIter, or 'k' on PCA vs KMeans). Copies
+                    # preserve uids, so parent-uid equality is the right key.
+                    if that.hasParam(param.name) and param.parent == that.uid:
                         that.set(param, value)
                 else:
                     # String keys keep the typo guard: unknown names raise.
@@ -393,6 +440,34 @@ class HasPredictionCol(Params):
 
     def setPredictionCol(self, value: str):
         return self._set(predictionCol=value)
+
+
+class HasProbabilityCol(Params):
+    probabilityCol = ParamDecl(
+        "probabilityCol",
+        "column of predicted class conditional probabilities",
+        TypeConverters.toString,
+    )
+
+    def getProbabilityCol(self) -> str:
+        return self.getOrDefault(self.probabilityCol)
+
+    def setProbabilityCol(self, value: str):
+        return self._set(probabilityCol=value)
+
+
+class HasRawPredictionCol(Params):
+    rawPredictionCol = ParamDecl(
+        "rawPredictionCol",
+        "raw prediction (confidence / margin) column name",
+        TypeConverters.toString,
+    )
+
+    def getRawPredictionCol(self) -> str:
+        return self.getOrDefault(self.rawPredictionCol)
+
+    def setRawPredictionCol(self, value: str):
+        return self._set(rawPredictionCol=value)
 
 
 class HasSeed(Params):

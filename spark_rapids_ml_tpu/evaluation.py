@@ -10,14 +10,24 @@ from __future__ import annotations
 
 import numpy as np
 
-from spark_rapids_ml_tpu.core.dataset import as_column
+from spark_rapids_ml_tpu.core.dataset import as_column, as_matrix, has_column
 from spark_rapids_ml_tpu.core.params import (
     HasLabelCol,
     HasPredictionCol,
+    HasRawPredictionCol,
     ParamDecl,
     Params,
     TypeConverters,
 )
+
+
+def _is_vector_column(dataset, col: str) -> bool:
+    """True when ``col`` holds per-row vectors rather than scalars."""
+    try:
+        probe = np.asarray(as_column(dataset, col))
+    except (TypeError, ValueError, KeyError):
+        return True  # list/fixed_size_list columns as_column can't flatten
+    return probe.ndim > 1 or probe.dtype == object
 
 
 class Evaluator(Params):
@@ -74,12 +84,15 @@ class RegressionEvaluator(Evaluator, _MetricParams):
         return self.getMetricName() == "r2"
 
 
-class BinaryClassificationEvaluator(Evaluator, _MetricParams):
+class BinaryClassificationEvaluator(Evaluator, _MetricParams, HasRawPredictionCol):
     """areaUnderROC (default) | areaUnderPR over a score column.
 
-    ``predictionCol`` should hold a continuous score (Spark uses
-    rawPrediction/probability); hard 0/1 predictions still yield the
-    one-threshold AUC.
+    Like Spark, the score is read from ``rawPredictionCol`` (default
+    ``rawPrediction``) — a margin/score column emitted by classifiers
+    (LogisticRegressionModel.transform writes it). The column may hold a
+    per-class vector (the positive-class component is used) or a scalar
+    score. If the dataset has no such column, ``predictionCol`` is used as
+    a fallback score (hard 0/1 labels then yield the one-threshold AUC).
     """
 
     _uid_prefix = "BinaryClassificationEvaluator"
@@ -87,11 +100,24 @@ class BinaryClassificationEvaluator(Evaluator, _MetricParams):
     def __init__(self, uid=None):
         super().__init__(uid=uid)
         self.setDefault(
-            metricName="areaUnderROC", labelCol="label", predictionCol="prediction"
+            metricName="areaUnderROC",
+            labelCol="label",
+            predictionCol="prediction",
+            rawPredictionCol="rawPrediction",
         )
 
+    def _score(self, dataset) -> np.ndarray:
+        col = self.getRawPredictionCol()
+        if not has_column(dataset, col):
+            col = self.getPredictionCol()
+        raw = as_matrix(dataset, col) if _is_vector_column(dataset, col) else None
+        if raw is not None:
+            return np.asarray(raw[:, -1], np.float64)
+        return np.asarray(as_column(dataset, col), np.float64)
+
     def evaluate(self, dataset) -> float:
-        y, score = self._columns(dataset)
+        y = np.asarray(as_column(dataset, self.getLabelCol()), np.float64)
+        score = self._score(dataset)
         pos = y > 0.5
         n_pos, n_neg = int(pos.sum()), int((~pos).sum())
         if n_pos == 0 or n_neg == 0:

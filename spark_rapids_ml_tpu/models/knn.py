@@ -140,6 +140,8 @@ class NearestNeighbors(Estimator, _NNParams, MLWritable, MLReadable):
 
 class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
     _uid_prefix = "NearestNeighborsModel"
+    # device-resident index state rebuilds via _ensure_index after unpickle
+    _transient_attrs = ("_mesh", "_db_sharded", "_db_mask", "_db_ids", "_n_global")
 
     def __init__(self, database: Optional[np.ndarray] = None, mesh=None, uid=None):
         super().__init__(uid=uid)
@@ -999,6 +1001,8 @@ class ApproximateNearestNeighbors(Estimator, _ANNParams, MLWritable, MLReadable)
 
 class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable):
     _uid_prefix = "ApproximateNearestNeighborsModel"
+    # device index + residual cache rebuild via _ensure_dev_index on use
+    _transient_attrs = ("_mesh", "_dev_index", "_resid_cache", "_shard_mesh")
 
     def __init__(self, index: Optional[IVFFlatIndex] = None, uid=None):
         super().__init__(uid=uid)

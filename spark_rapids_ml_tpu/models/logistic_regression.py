@@ -36,6 +36,8 @@ from spark_rapids_ml_tpu.core.params import (
     HasLabelCol,
     HasMaxIter,
     HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
     HasRegParam,
     HasTol,
     Model,
@@ -694,6 +696,8 @@ class _LogisticRegressionParams(
     HasFeaturesCol,
     HasLabelCol,
     HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
     HasRegParam,
     HasFitIntercept,
     HasMaxIter,
@@ -705,6 +709,8 @@ class _LogisticRegressionParams(
             featuresCol="features",
             labelCol="label",
             predictionCol="prediction",
+            probabilityCol="probability",
+            rawPredictionCol="rawPrediction",
             regParam=0.0,
             fitIntercept=True,
             maxIter=100,
@@ -796,16 +802,37 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
         self.intercept = source.intercept
         self._summary = getattr(source, "_summary", None)
 
-    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+    def predict_raw(self, x: np.ndarray) -> np.ndarray:
+        """Per-class margins (logits) — Spark's rawPrediction vector.
+
+        Binary: ``[-z, z]`` with z the log-odds, matching Spark's
+        BinaryLogisticRegressionModel raw output.
+        """
         x = np.asarray(x, dtype=np.float64)
         if self.coefficients.ndim == 1:
             z = x @ self.coefficients + float(np.asarray(self.intercept).reshape(-1)[0])
-            p1 = 1.0 / (1.0 + np.exp(-z))
+            return np.stack([-z, z], axis=1)
+        return x @ self.coefficients.T + np.asarray(self.intercept)[None, :]
+
+    def _raw_to_proba(self, raw: np.ndarray) -> np.ndarray:
+        """Spark's raw2probability: binary -> sigmoid of the margin
+        (raw = [-z, z] so softmax would wrongly give sigmoid(2z));
+        multiclass -> softmax of the logits."""
+        if self.coefficients.ndim == 1:
+            z = raw[:, 1]
+            # overflow-safe sigmoid: exp only ever sees non-positive input
+            p1 = np.where(
+                z >= 0,
+                1.0 / (1.0 + np.exp(-np.abs(z))),
+                np.exp(-np.abs(z)) / (1.0 + np.exp(-np.abs(z))),
+            )
             return np.stack([1.0 - p1, p1], axis=1)
-        logits = x @ self.coefficients.T + np.asarray(self.intercept)[None, :]
-        logits -= logits.max(axis=1, keepdims=True)
+        logits = raw - raw.max(axis=1, keepdims=True)
         e = np.exp(logits)
         return e / e.sum(axis=1, keepdims=True)
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        return self._raw_to_proba(self.predict_raw(x))
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.argmax(self.predict_proba(x), axis=1)
@@ -814,4 +841,11 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
         if self.coefficients is None:
             raise RuntimeError("model has no coefficients (unfitted?)")
         x = as_matrix(dataset, self.getFeaturesCol())
-        return with_column(dataset, self.getPredictionCol(), self.predict(x))
+        raw = self.predict_raw(x)
+        proba = self._raw_to_proba(raw)
+        # Emit rawPrediction + probability + prediction like Spark's
+        # ProbabilisticClassificationModel (prediction last, so the
+        # bare-matrix dataset path still returns hard labels).
+        out = with_column(dataset, self.getRawPredictionCol(), raw)
+        out = with_column(out, self.getProbabilityCol(), proba)
+        return with_column(out, self.getPredictionCol(), np.argmax(proba, axis=1))

@@ -263,6 +263,15 @@ def fit_pca_stream(
     file exists: callers re-supply the same batch iterator and already-
     consumed batches are skipped. (Preemption safety the reference lacks —
     SURVEY.md §5 "failure detection".)
+
+    **Multi-host** (``jax.process_count() > 1``, e.g. a v5e-16 pod):
+    ``batches`` is THIS process's local stream — each host reads only its
+    own shard of the dataset. Batches are assembled into global arrays via
+    the multi-process branch of ``shard_rows`` and iterated in lockstep
+    (``lockstep_batches``: uneven stream lengths are fine — exhausted
+    hosts contribute empty batches). Checkpoints are written by process 0
+    only and must be resumable by every process (shared filesystem);
+    because the accumulator is fully replicated, one file restores all.
     """
     if not 0 < k <= n_cols:
         # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60
@@ -271,20 +280,30 @@ def fit_pca_stream(
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     solver = _resolve_solver(solver)  # fail fast, before consuming batches
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
-    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
-
-    require_single_process("fit_pca_stream (per-batch placement is host-driven)")
+    from spark_rapids_ml_tpu.parallel.sharding import lockstep_batches, shard_rows
 
     mesh = mesh or default_mesh()
+    multiproc = jax.process_count() > 1
     update = gram_ops.streaming_update(mesh)
     state = gram_ops.init_stats(n_cols)
-    n_data = mesh.shape[DATA_AXIS]
-    sharding = row_sharding(mesh)
-    mask_sharding = row_sharding(mesh, ndim=1)
     n_true = 0
     skip_batches = 0
     if checkpoint_path:
         restored = ckpt.load_state(checkpoint_path)
+        if multiproc:
+            # Every process must resume identically or the lockstep scans
+            # desync — a missing file on one host is a config error
+            # (non-shared checkpoint path), not a silent fresh start.
+            from jax.experimental import multihost_utils as mhu
+
+            flags = np.asarray(
+                mhu.process_allgather(np.asarray([int(restored is not None)]))
+            )
+            if flags.any() != flags.all():
+                raise RuntimeError(
+                    "checkpoint visible on some hosts but not others; "
+                    "checkpoint_path must be on a shared filesystem"
+                )
         if restored is not None:
             arrays, meta = restored
             if meta.get("n_cols") != n_cols:
@@ -300,23 +319,21 @@ def fit_pca_stream(
             n_true = int(meta["n_rows"])
             skip_batches = int(meta["n_batches"])
     with trace_span("compute cov"):
-        for i, batch in enumerate(batches):
+        for i, batch in enumerate(lockstep_batches(batches, n_cols)):
             if i < skip_batches:
                 continue
-            batch = np.asarray(batch)
-            n_true += batch.shape[0]
-            xb, mb = pad_rows(batch, n_data)
-            xs = jax.device_put(xb, sharding)
-            ms = jax.device_put(mb, mask_sharding)
+            xs, ms, n_b = shard_rows(batch, mesh)
+            n_true += n_b
             state = update(state, xs, ms)
             if checkpoint_path and (i + 1) % checkpoint_every == 0:
                 count, colsum, g = jax.device_get(state)
-                ckpt.save_state(
-                    checkpoint_path,
-                    {"count": count, "colsum": colsum, "gram": g},
-                    {"n_rows": n_true, "n_batches": i + 1, "n_cols": n_cols},
-                )
-    if checkpoint_path:
+                if not multiproc or jax.process_index() == 0:
+                    ckpt.save_state(
+                        checkpoint_path,
+                        {"count": count, "colsum": colsum, "gram": g},
+                        {"n_rows": n_true, "n_batches": i + 1, "n_cols": n_cols},
+                    )
+    if checkpoint_path and (not multiproc or jax.process_index() == 0):
         # Success: remove the checkpoint so a FUTURE fit against the same
         # path starts fresh instead of silently merging this run's
         # accumulator into different data.

@@ -100,6 +100,59 @@ def replicated_array(x: np.ndarray, mesh: Mesh):
     )
 
 
+def lockstep_batches(batches, n_cols: int):
+    """Iterate a host-local batch stream in multi-process LOCKSTEP.
+
+    Every process must execute the same sequence of SPMD updates or the
+    collectives desync — but hosts' local streams can have different
+    lengths (uneven Parquet shards, a straggling reader). This wrapper
+    yields until EVERY process's stream is exhausted; a process whose
+    stream ended early contributes empty (0, n_cols) batches, which the
+    masked kernels fold as zero rows. Single-process: plain iteration.
+
+    The multi-host face of the streaming fits (fit_pca_stream etc.) —
+    with it, the 100M×2048 north-star config streams on a v5e-16 pod with
+    each host reading only its own shard of the dataset.
+    """
+    if jax.process_count() == 1:
+        for batch in batches:
+            yield np.asarray(batch)
+        return
+    from jax.experimental import multihost_utils as mhu
+
+    # Filler batches must match the feeding hosts' dtype or the per-process
+    # jitted updates diverge (SPMD mismatch) — ride a dtype code on the
+    # same allgather as the has-batch flag and adopt the consensus.
+    codes = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+             np.dtype(np.float16): 2}
+    rev = {v: k for k, v in codes.items()}
+    it = iter(batches)
+    while True:
+        batch = next(it, None)
+        code = -1
+        if batch is not None:
+            batch = np.asarray(batch)
+            # A bad dtype must NOT raise before the allgather — the other
+            # hosts would already be inside the collective and hang. Ride
+            # an invalid-marker through it and raise on ALL hosts after.
+            code = codes.get(batch.dtype, -2)
+        flags = np.asarray(mhu.process_allgather(np.asarray([
+            0 if batch is None else 1, code,
+        ]))).reshape(-1, 2)
+        if (flags[:, 1] == -2).any():
+            bad = int(np.argmax(flags[:, 1] == -2))
+            raise TypeError(
+                f"lockstep_batches: process {bad} supplied an unsupported "
+                "batch dtype (expected float16/32/64)"
+            )
+        if not flags[:, 0].any():
+            return
+        if batch is None:
+            consensus = int(flags[flags[:, 0] == 1, 1].max())
+            batch = np.zeros((0, n_cols), dtype=rev[consensus])
+        yield batch
+
+
 def require_single_process(feature: str) -> None:
     """Fail fast (identically on every process) for code whose host-side
     preparation depends on local data — running it multi-process would

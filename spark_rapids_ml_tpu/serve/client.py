@@ -17,9 +17,16 @@ from spark_rapids_ml_tpu.serve import protocol
 
 
 class DataPlaneClient:
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 120.0,
+        token: Optional[str] = None,
+    ):
         self._addr = (host, int(port))
         self._timeout = timeout
+        self._token = token
         self._sock: Optional[socket.socket] = None
 
     # -- connection --------------------------------------------------------
@@ -46,6 +53,8 @@ class DataPlaneClient:
 
     def _roundtrip(self, req: Dict[str, Any], payload: Optional[bytes] = None):
         sock = self._conn()
+        if self._token is not None:
+            req = {**req, "token": self._token}
         protocol.send_json(sock, req)
         if payload is not None:
             protocol.send_frame(sock, payload)
@@ -62,20 +71,8 @@ class DataPlaneClient:
         resp, _ = self._roundtrip({"op": "ping"})
         return bool(resp["ok"])
 
-    def feed(
-        self,
-        job: str,
-        data,
-        algo: str = "pca",
-        input_col: str = "features",
-        label_col: str = "label",
-        n_cols: Optional[int] = None,
-        params: Optional[Dict[str, Any]] = None,
-    ) -> int:
-        """Feed one batch. ``data``: an Arrow Table/RecordBatch, or an
-        (n, d) ndarray (optionally a (x, y) tuple for linreg/logreg).
-        ``params`` configures job creation on the first feed (kmeans needs
-        {"k": ...}). Returns the job's total accumulated rows."""
+    @staticmethod
+    def _to_ipc(data, input_col: str, label_col: str) -> bytes:
         import pyarrow as pa
 
         from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
@@ -97,6 +94,30 @@ class DataPlaneClient:
         sink = pa.BufferOutputStream()
         with pa.ipc.new_stream(sink, table.schema) as writer:
             writer.write_table(table)
+        return sink.getvalue().to_pybytes()
+
+    def feed(
+        self,
+        job: str,
+        data,
+        algo: str = "pca",
+        input_col: str = "features",
+        label_col: str = "label",
+        n_cols: Optional[int] = None,
+        params: Optional[Dict[str, Any]] = None,
+        partition: Optional[int] = None,
+        attempt: int = 0,
+        pass_id: Optional[int] = None,
+    ) -> int:
+        """Feed one batch. ``data``: an Arrow Table/RecordBatch, or an
+        (n, d) ndarray (optionally a (x, y) tuple for linreg/logreg).
+        ``params`` configures job creation on the first feed (kmeans needs
+        {"k": ...}). With ``partition`` set, the batch goes to that
+        partition's staged state and only counts after :meth:`commit` —
+        the exactly-once path for Spark tasks (retries restart the stage,
+        duplicates of committed partitions are discarded). ``pass_id``
+        fences iterative feeds to the job's current pass. Returns the
+        job's total committed rows."""
         resp, _ = self._roundtrip(
             {
                 "op": "feed",
@@ -106,10 +127,53 @@ class DataPlaneClient:
                 "label_col": label_col,
                 "n_cols": n_cols,
                 "params": params or {},
+                "partition": partition,
+                "attempt": attempt,
+                "pass_id": pass_id,
             },
-            payload=sink.getvalue().to_pybytes(),
+            payload=self._to_ipc(data, input_col, label_col),
         )
         return int(resp["rows"])
+
+    def commit(
+        self, job: str, partition: int, attempt: int = 0,
+        pass_id: Optional[int] = None,
+    ) -> int:
+        """Commit a partition's staged feeds into the job state
+        (idempotent; see :meth:`feed`). Returns total committed rows."""
+        resp, _ = self._roundtrip(
+            {
+                "op": "commit",
+                "job": job,
+                "partition": partition,
+                "attempt": attempt,
+                "pass_id": pass_id,
+            }
+        )
+        return int(resp["rows"])
+
+    def seed_kmeans(
+        self,
+        job: str,
+        data,
+        k: int,
+        input_col: str = "features",
+        n_cols: Optional[int] = None,
+        params: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Deterministically seed a kmeans job's centers from a
+        driver-chosen batch of ≥ k rows (rows are NOT folded — they arrive
+        through the partition scan). Idempotent across retries."""
+        self._roundtrip(
+            {
+                "op": "seed",
+                "job": job,
+                "input_col": input_col,
+                "n_cols": n_cols,
+                "params": {**(params or {}), "k": k},
+            },
+            payload=self._to_ipc(data, input_col, "label"),
+        )
 
     def step(self, job: str, params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Pass boundary for iterative jobs (kmeans/logreg): apply the

@@ -18,17 +18,35 @@ run another pass. ``finalize`` then returns the model. This is the
 daemon-side face of models.kmeans.fit_kmeans_stream /
 models.logistic_regression.fit_logistic_stream.
 
-KMeans center seeding uses the FIRST batch that arrives: with several
-executors feeding concurrently, which batch wins the race is
-nondeterministic, so the same seed can yield different inits run to run.
-For a reproducible init, have the driver (or one designated task) feed a
-seeding batch of ≥ k rows before fanning out the rest.
+Exactly-once under Spark task retry: a feed may carry ``partition`` (the
+Spark partition id) + ``attempt``. Partitioned feeds fold into a staged
+per-partition state; ``commit`` merges the stage into the job state
+(associative add, the same property the reference's ``RDD.reduce`` leans
+on, RapidsRowMatrix.scala:139). A retried attempt restarts its stage; a
+feed or commit for an already-committed partition is discarded (ack'd but
+not folded), so task retries and speculative duplicates cannot
+double-count rows — the daemon owns the idempotency Spark's recompute
+model assumes. Iterative feeds also carry ``pass_id`` (= the job's
+iteration); stale-pass traffic from zombie tasks is rejected.
+
+KMeans center seeding: either the FIRST eager batch seeds the centers
+(single-feeder convenience; nondeterministic under concurrent feeds), or
+the driver sends an explicit ``seed`` op with ≥ k rows before fanning the
+scan out — the deterministic path the Spark wrapper uses.
+
+Operational hardening: jobs idle longer than ``ttl`` seconds are evicted
+by a reaper thread (a driver that crashes between feed and finalize no
+longer leaks d×d device buffers forever), and an optional shared-secret
+``token`` is checked on every op (the transport-trust story Spark gave
+the reference for free).
 """
 
 from __future__ import annotations
 
+import hmac
 import socket
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -63,6 +81,15 @@ class _Job:
         self.v_sharding = row_sharding(mesh, ndim=1)
         self.iteration = 0
         self.pass_rows = 0
+        self.touched = time.monotonic()
+        # Partition staging (exactly-once under task retry): keyed by
+        # (partition, attempt) so CONCURRENT attempts of one partition
+        # (Spark speculation runs a duplicate alongside the original)
+        # accumulate independently instead of wiping each other — the
+        # first to commit wins, the rest are discarded. Values:
+        # (staged state, staged rows); committed: partition → rows.
+        self.staged: Dict[tuple, Any] = {}
+        self.committed: Dict[int, int] = {}
         self._accum = jnp.dtype(config.get("accum_dtype"))
         if algo == "pca":
             self.state = gram_ops.init_stats(n_cols)
@@ -112,6 +139,30 @@ class _Job:
 
         return stream_zero_state(self.n_cols, self._accum)
 
+    def _zero_state(self):
+        if self.algo == "pca":
+            return gram_ops.init_stats(self.n_cols)
+        if self.algo == "linreg":
+            from spark_rapids_ml_tpu.models.linear_regression import (
+                init_normal_eq_stats,
+            )
+
+            return init_normal_eq_stats(self.n_cols)
+        if self.algo == "kmeans":
+            return self._kmeans_zero_state()
+        return self._logreg_zero_state()
+
+    @staticmethod
+    def _merge(a, b):
+        """Combine two accumulated states. Every job state in this daemon
+        is a tuple of additive sufficient statistics (counts, Σx, XᵀX,
+        Xᵀy, per-center sums, gradient/Hessian blocks, inertia …), so the
+        device-side combine is an elementwise add — the ``accumulateCov``
+        the reference declared but never built (RAPIDSML.scala:95-97)."""
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.add, a, b)
+
     def _bucket(self, n: int) -> int:
         """Pad target: next power of two (≥ data-axis size).
 
@@ -125,7 +176,46 @@ class _Job:
             b <<= 1
         return b
 
-    def fold(self, x: np.ndarray, y: Optional[np.ndarray]) -> None:
+    def _check_pass(self, pass_id: Optional[int]) -> None:
+        """Reject traffic from a zombie task of an earlier pass: its batch
+        was computed against a stale iterate and must not pollute this
+        pass's statistics."""
+        if pass_id is not None and int(pass_id) != self.iteration:
+            raise ValueError(
+                f"stale pass_id {pass_id} (job is on pass {self.iteration}); "
+                "feed rejected"
+            )
+
+    def seed_centers(self, x: np.ndarray) -> None:
+        """Deterministic kmeans init from a driver-chosen batch: centers
+        only, NO fold (the rows also live in some partition and will arrive
+        through the scan — folding here would double-count them)."""
+        if self.algo != "kmeans":
+            raise ValueError(f"seed only applies to kmeans jobs, not {self.algo!r}")
+        if x.shape[0] < self.k:
+            raise ValueError(f"seed batch has {x.shape[0]} rows < k={self.k}")
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.kmeans import _kmeans_plus_plus, _random_init
+
+        init_fn = _kmeans_plus_plus if self.init == "k-means++" else _random_init
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            if self.centers is not None:
+                return  # idempotent: a retried seed keeps the first init
+            c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
+            self.centers = jnp.asarray(c0, self._accum)
+            self.touched = time.monotonic()
+
+    def fold(
+        self,
+        x: np.ndarray,
+        y: Optional[np.ndarray],
+        partition: Optional[int] = None,
+        attempt: int = 0,
+        pass_id: Optional[int] = None,
+    ) -> None:
         if x.shape[1] != self.n_cols:
             raise ValueError(f"batch width {x.shape[1]} != job n_cols {self.n_cols}")
         if self.algo in ("linreg", "logreg") and y is None:
@@ -139,7 +229,17 @@ class _Job:
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped; rows not accepted")
+            self._check_pass(pass_id)
+            self.touched = time.monotonic()
+            if partition is not None and partition in self.committed:
+                return  # duplicate of a committed task (retry/speculation)
             if self.algo == "kmeans" and self.centers is None:
+                if partition is not None:
+                    raise ValueError(
+                        "partitioned kmeans feed before centers are seeded; "
+                        "send a 'seed' op from the driver first "
+                        "(deterministic init)"
+                    )
                 if n < self.k:
                     raise ValueError(
                         f"first kmeans batch has {n} rows < k={self.k}; "
@@ -157,24 +257,65 @@ class _Job:
                 )
                 c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
                 self.centers = jnp.asarray(c0, self._accum)
+            if partition is None:
+                state, extra_rows = self.state, 0
+            else:
+                prev = self.staged.get((partition, attempt))
+                if prev is not None:
+                    state, extra_rows = prev
+                else:
+                    state, extra_rows = self._zero_state(), 0
             xs = jax.device_put(xb, self.x_sharding)
             ms = jax.device_put(mb, self.v_sharding)
             if self.algo == "pca":
-                self.state = self.update(self.state, xs, ms)
+                state = self.update(state, xs, ms)
             elif self.algo == "kmeans":
-                self.state = self.update(self.state, self.centers, xs, ms)
+                state = self.update(state, self.centers, xs, ms)
             elif self.algo == "logreg":
                 yb = np.zeros((target,), dtype=np.float32)
                 yb[:n] = np.asarray(y).reshape(-1)
                 ys = jax.device_put(yb, self.v_sharding)
-                self.state = self.update(self.state, self.w, self.b, xs, ys, ms)
+                state = self.update(state, self.w, self.b, xs, ys, ms)
             else:
                 yb = np.zeros((target,), dtype=np.asarray(y).dtype)
                 yb[:n] = np.asarray(y).reshape(-1)
                 ys = jax.device_put(yb, self.v_sharding)
-                self.state = self.update(self.state, xs, ys, ms)
+                state = self.update(state, xs, ys, ms)
+            if partition is None:
+                self.state = state
+                self.rows += n
+                self.pass_rows += n
+            else:
+                self.staged[(partition, attempt)] = (state, extra_rows + n)
+
+    def commit(
+        self, partition: int, attempt: int = 0, pass_id: Optional[int] = None
+    ) -> int:
+        """Merge a partition's staged state into the job state. Idempotent:
+        recommits (lost ack → task retry) and commits for already-committed
+        partitions are acknowledged without folding. Returns total job rows."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            self._check_pass(pass_id)
+            self.touched = time.monotonic()
+            if partition in self.committed:
+                return self.rows
+            staged = self.staged.pop((partition, attempt), None)
+            if staged is None:
+                raise ValueError(
+                    f"commit for partition {partition} attempt {attempt} "
+                    "with no staged feed"
+                )
+            state, n = staged
+            self.state = self._merge(self.state, state)
+            self.committed[partition] = n
             self.rows += n
             self.pass_rows += n
+            # losing attempts' stages for this partition free their buffers
+            for key in [k for k in self.staged if k[0] == partition]:
+                del self.staged[key]
+            return self.rows
 
     def step(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Pass boundary for iterative jobs: apply the update at the end of
@@ -183,10 +324,16 @@ class _Job:
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
+            self.touched = time.monotonic()
             if self.algo not in ("kmeans", "logreg"):
                 raise ValueError(
                     f"algo {self.algo!r} is single-pass; step not applicable"
                 )
+            # A new pass re-feeds every partition against the new iterate:
+            # clear this pass's staging + committed set (zombie traffic from
+            # the finished pass is fenced by pass_id, not by these maps).
+            self.staged.clear()
+            self.committed.clear()
             if self.pass_rows == 0:
                 # A retried/premature step over an empty pass would corrupt
                 # the iterate (zero Hessian solve / moved2=0 fake converge).
@@ -304,13 +451,23 @@ class DataPlaneDaemon:
     way the reference trusts its executors).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, mesh=None):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        mesh=None,
+        ttl: Optional[float] = None,
+        token: Optional[str] = None,
+    ):
         self._host, self._port = host, port
         self._mesh = mesh
+        self._ttl = ttl
+        self._token = token
         self._jobs: Dict[str, _Job] = {}
         self._jobs_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
+        self._reaper_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -327,6 +484,11 @@ class DataPlaneDaemon:
             target=self._accept_loop, name="srml-dataplane-accept", daemon=True
         )
         self._accept_thread.start()
+        if self._ttl is not None:
+            self._reaper_thread = threading.Thread(
+                target=self._reap_loop, name="srml-dataplane-reaper", daemon=True
+            )
+            self._reaper_thread.start()
         logger.info("data-plane daemon listening on %s:%d", self._host, self._port)
         return self
 
@@ -343,6 +505,29 @@ class DataPlaneDaemon:
                 pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+        if self._reaper_thread is not None:
+            self._reaper_thread.join(timeout=5)
+
+    def _reap_loop(self) -> None:
+        """Evict jobs idle > ttl: a driver that crashed between feed and
+        finalize must not leak d×d device buffers forever."""
+        interval = max(min(self._ttl / 4.0, 30.0), 0.05)
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._jobs_lock:
+                stale = [
+                    name
+                    for name, job in self._jobs.items()
+                    if now - job.touched > self._ttl
+                ]
+                evicted = [(name, self._jobs.pop(name)) for name in stale]
+            for name, job in evicted:
+                with job.lock:
+                    job.dropped = True
+                logger.warning(
+                    "evicted idle job %r (%.1fs > ttl %.1fs, %d rows fed)",
+                    name, now - job.touched, self._ttl, job.rows,
+                )
 
     def __enter__(self):
         return self.start()
@@ -383,9 +568,28 @@ class DataPlaneDaemon:
                         return
 
     def _dispatch(self, conn, req: Dict[str, Any]) -> None:
+        if self._token is not None and not hmac.compare_digest(
+            str(req.get("token", "")), self._token
+        ):
+            # Constant-time compare; drain the payload frame of
+            # payload-carrying ops so the connection framing stays aligned
+            # for the error.
+            if req.get("op") in ("feed", "seed"):
+                protocol.recv_frame(conn)
+            raise PermissionError("unauthorized: bad or missing token")
         op = req.get("op")
         if op == "feed":
             self._op_feed(conn, req)
+        elif op == "seed":
+            self._op_seed(conn, req)
+        elif op == "commit":
+            job = self._get_job(req)
+            rows = job.commit(
+                int(req["partition"]),
+                int(req.get("attempt", 0)),
+                req.get("pass_id"),
+            )
+            protocol.send_json(conn, {"ok": True, "rows": rows})
         elif op == "finalize":
             self._op_finalize(conn, req)
         elif op == "step":
@@ -467,7 +671,42 @@ class DataPlaneDaemon:
             raise ValueError(
                 f"job {name!r} is algo {job.algo!r}; feed requested {req_algo!r}"
             )
-        job.fold(x, y)
+        part = req.get("partition")
+        job.fold(
+            x,
+            y,
+            partition=None if part is None else int(part),
+            attempt=int(req.get("attempt", 0)),
+            pass_id=req.get("pass_id"),
+        )
+        protocol.send_json(conn, {"ok": True, "rows": job.rows})
+
+    def _op_seed(self, conn, req: Dict[str, Any]) -> None:
+        """Driver-sent deterministic kmeans init: payload batch seeds the
+        centers, rows are NOT folded (they arrive through the scan)."""
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
+
+        payload = protocol.recv_frame(conn)
+        if payload is None:
+            raise protocol.ProtocolError("connection closed before seed payload")
+        with pa.ipc.open_stream(payload) as reader:
+            table = reader.read_all()
+        name = str(req["job"])
+        x = table_column_to_matrix(
+            table, req.get("input_col", "features"), req.get("n_cols")
+        )
+        params = req.get("params") or {}
+        k_req = int(params.get("k", 0))
+        if x.shape[0] < k_req:
+            raise ValueError(f"seed batch has {x.shape[0]} rows < k={k_req}")
+        with self._jobs_lock:
+            job = self._jobs.get(name)
+            if job is None:
+                job = _Job("kmeans", x.shape[1], self._mesh, params)
+                self._jobs[name] = job
+        job.seed_centers(x)
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
 
     def _op_finalize(self, conn, req: Dict[str, Any]) -> None:

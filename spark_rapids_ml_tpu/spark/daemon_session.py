@@ -1,0 +1,109 @@
+"""Daemon resolution for Spark-driven fits.
+
+Who runs the data-plane daemon depends on the deployment:
+
+* **Cluster**: each TPU host runs one ``DataPlaneDaemon`` (one process owns
+  the host's chips, like the reference's one-GPU-per-executor resource
+  model, README.md:110-113). The driver learns the address from
+  ``spark.srml.daemon.address`` / ``$SRML_DAEMON_ADDRESS`` and ships it to
+  tasks; an executor colocated with a *different* TPU host overrides the
+  target with its OWN host's daemon via the executor-local
+  ``$SRML_DAEMON_ADDRESS`` (the executor→local-host routing rule — data
+  flows executor → nearest TPU host; only the tiny partials cross hosts
+  through the jax.distributed mesh underneath the daemon's mesh).
+* **Local / tests**: nothing configured — the driver starts one in-process
+  daemon, shared across fits (jit caches stay warm), torn down at exit.
+
+An optional shared-secret token (``spark.srml.daemon.token`` /
+``$SRML_DAEMON_TOKEN``) is checked by the daemon on every op.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional, Tuple
+
+_lock = threading.Lock()
+_owned_daemon = None  # in-process daemon for local mode
+
+
+def _spark_conf_get(spark, key: str) -> Optional[str]:
+    try:
+        return spark.conf.get(key)
+    except Exception:
+        return None
+
+
+def _parse_addr(addr: str) -> Tuple[str, int]:
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"daemon address {addr!r} must be 'host:port' (e.g. "
+            "'tpu-host-0:9747')"
+        )
+    return host or "127.0.0.1", int(port)
+
+
+def resolve(spark=None) -> Tuple[str, int, Optional[str]]:
+    """Return (host, port, token) of the daemon this driver should use,
+    starting an in-process one if nothing is configured."""
+    addr = os.environ.get("SRML_DAEMON_ADDRESS")
+    if not addr and spark is not None:
+        addr = _spark_conf_get(spark, "spark.srml.daemon.address")
+    token = os.environ.get("SRML_DAEMON_TOKEN")
+    if token is None and spark is not None:
+        token = _spark_conf_get(spark, "spark.srml.daemon.token")
+    if addr:
+        return (*_parse_addr(addr), token)
+    return (*_local_daemon().address, token)
+
+
+def _local_daemon():
+    global _owned_daemon
+    with _lock:
+        if _owned_daemon is None:
+            from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+
+            _owned_daemon = DataPlaneDaemon(ttl=3600.0).start()
+            atexit.register(shutdown)
+        return _owned_daemon
+
+
+def shutdown() -> None:
+    """Stop the in-process daemon (idempotent)."""
+    global _owned_daemon
+    with _lock:
+        d, _owned_daemon = _owned_daemon, None
+    if d is not None:
+        d.stop()
+
+
+def task_context() -> Tuple[int, int]:
+    """(partition_id, attempt) for the CURRENT task, executor-side.
+
+    Uses pyspark's TaskContext when running inside a real executor;
+    otherwise falls back to ``$SRML_PARTITION_ID`` / ``$SRML_ATTEMPT``
+    (set by non-Spark task runners, e.g. the test harness)."""
+    try:
+        from pyspark import TaskContext
+
+        ctx = TaskContext.get()
+        if ctx is not None:
+            return int(ctx.partitionId()), int(ctx.attemptNumber())
+    except ImportError:
+        pass
+    return (
+        int(os.environ.get("SRML_PARTITION_ID", "0")),
+        int(os.environ.get("SRML_ATTEMPT", "0")),
+    )
+
+
+def executor_daemon_address(default_host: str, default_port: int) -> Tuple[str, int]:
+    """Executor-side routing rule: a task feeds ITS host's daemon when the
+    executor env names one, else the driver-resolved address."""
+    addr = os.environ.get("SRML_DAEMON_ADDRESS")
+    if addr:
+        return _parse_addr(addr)
+    return default_host, default_port

@@ -6,23 +6,35 @@ reference PCA.scala:27-37, README.md:27-37 — with the features column as
 ArrayType rather than Vector). These wrappers reproduce that contract for
 PySpark: ``SparkPCA().setInputCol("features").setK(3).fit(spark_df)``.
 
-Data path: the DataFrame's relevant columns are exchanged as Arrow
-(``spark.sql.execution.arrow.*``), flattened by the columnar bridge, and
-fed to the sharded TPU fit. ``transform`` runs the model on Arrow batches
-via ``mapInArrow`` when available (keeps the pipeline distributed and
-lazy, one batch per executor task — the analogue of the reference's
-columnar UDF, RapidsPCA.scala:128-161), falling back to a collect-based
-path for old PySpark.
+**fit is distributed**, reproducing the reference's defining property —
+per-partition work on executors with only O(d²) partials crossing the
+wire (RapidsRowMatrix.scala:118-139). Each partition task streams its
+Arrow batches to the TPU-host data-plane daemon (``serve/``) and commits;
+the driver finalizes and receives only the model. The dataset is NEVER
+collected to the driver. Iterative algorithms (KMeans/LogReg) run one
+Spark job per pass with a daemon ``step`` at each boundary — the Lloyd /
+Newton scan loop with Spark as the scan engine. Task retries and
+speculative duplicates are safe: feeds stage per (partition, attempt) and
+only ``commit`` folds them in (see serve/daemon.py).
+
+``transform`` runs the model on Arrow batches via ``mapInArrow`` (one
+batch per executor task — the analogue of the reference's columnar UDF,
+RapidsPCA.scala:128-161), falling back to a collect-based path for old
+PySpark.
 
 pyspark is optional: import of this module never requires it; calling
-``fit``/``transform`` with a Spark DataFrame does.
+``fit``/``transform`` with a Spark DataFrame does. Algorithms without a
+daemon protocol (KNN — the model IS the dataset) use an Arrow collect.
 """
 
 from __future__ import annotations
 
+import uuid
 from typing import Any, Optional
 
 import numpy as np
+
+from spark_rapids_ml_tpu.spark import daemon_session
 
 
 def _pyspark():
@@ -35,7 +47,20 @@ def _pyspark():
         return None
 
 
+# Extra DataFrame types treated as Spark-shaped (duck-typed stand-ins that
+# implement the same surface — the test harness's SimDataFrame registers
+# here so the REAL wrapper code paths run without a pyspark install).
+_EXTRA_DF_TYPES: tuple = ()
+
+
+def register_dataframe_type(cls) -> None:
+    global _EXTRA_DF_TYPES
+    _EXTRA_DF_TYPES = tuple(set(_EXTRA_DF_TYPES) | {cls})
+
+
 def _is_spark_df(dataset: Any) -> bool:
+    if _EXTRA_DF_TYPES and isinstance(dataset, _EXTRA_DF_TYPES):
+        return True
     df_cls = _pyspark()
     return df_cls is not None and isinstance(dataset, df_cls)
 
@@ -66,6 +91,61 @@ def _df_to_arrow(df, columns):
     return pa.Table.from_pandas(pdf, preserve_index=False)
 
 
+class _FeedTask:
+    """The executor-side partition feeder (a plain-pickle-able callable —
+    shipped to tasks by Spark's closure serializer; imports happen on the
+    executor).
+
+    One task = one partition = one daemon connection: stream every Arrow
+    batch to the stage keyed (partition, attempt), then commit. Retries
+    restart the stage; duplicates of committed partitions are discarded
+    daemon-side — Spark's at-least-once task execution becomes
+    exactly-once accumulation (see serve/daemon.py)."""
+
+    def __init__(self, host, port, token, job, algo, input_col, label_col,
+                 params, pass_id):
+        self.host, self.port, self.token = host, port, token
+        self.job, self.algo = job, algo
+        self.input_col, self.label_col = input_col, label_col
+        self.params, self.pass_id = params, pass_id
+
+    def __call__(self, batches):
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+        from spark_rapids_ml_tpu.spark import daemon_session as ds
+
+        pid, attempt = ds.task_context()
+        h, p = ds.executor_daemon_address(self.host, self.port)
+        rows = 0
+        with DataPlaneClient(h, p, token=self.token) as c:
+            for batch in batches:
+                if batch.num_rows == 0:
+                    continue
+                c.feed(
+                    self.job,
+                    pa.Table.from_batches([batch]),
+                    algo=self.algo,
+                    input_col=self.input_col,
+                    label_col=self.label_col,
+                    params=self.params,
+                    partition=pid,
+                    attempt=attempt,
+                    pass_id=self.pass_id,
+                )
+                rows += batch.num_rows
+            if rows > 0:
+                c.commit(
+                    self.job, partition=pid, attempt=attempt, pass_id=self.pass_id
+                )
+        yield pa.RecordBatch.from_pydict(
+            {
+                "partition": pa.array([pid], pa.int32()),
+                "rows": pa.array([rows], pa.int64()),
+            }
+        )
+
+
 class _SparkAdapter:
     """Wraps a core estimator class with Spark DataFrame in/out.
 
@@ -75,6 +155,9 @@ class _SparkAdapter:
 
     _core_cls = None  # override
     _model_attr = "model"
+    # Daemon wire protocol this estimator's fit speaks; None → Arrow
+    # collect (KNN: the fitted model IS the dataset; scaler: trivial).
+    _daemon_algo: Optional[str] = None
 
     def __init__(self, **kwargs):
         self._core = type(self)._core_cls(**kwargs)
@@ -92,9 +175,12 @@ class _SparkAdapter:
 
     def fit(self, dataset):
         if _is_spark_df(dataset):
-            cols = self._input_columns()
-            table = _df_to_arrow(dataset, cols)
-            core_model = self._core.fit(table)
+            if self._daemon_algo is not None:
+                core_model = self._fit_distributed(dataset)
+            else:
+                cols = self._input_columns()
+                table = _df_to_arrow(dataset, cols)
+                core_model = self._core.fit(table)
         else:
             _check_not_orphan_spark_df(dataset)
             core_model = self._core.fit(dataset)
@@ -113,6 +199,186 @@ class _SparkAdapter:
             ):
                 cols.append(self._core.getOrDefault(name))
         return cols
+
+    # -- distributed fit ---------------------------------------------------
+
+    def _fit_distributed(self, df):
+        """Executor-fed fit: partition batches flow task→daemon, the
+        driver sees only O(d²) finalize output — the reference's
+        partition-Gram + small-partials property (RapidsRowMatrix.scala:
+        118-139) with the daemon replacing the JVM tree-reduce."""
+        core = self._core
+        algo = self._daemon_algo
+        spark = getattr(df, "sparkSession", None)
+        host, port, token = daemon_session.resolve(spark)
+        job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
+        input_col = core.getOrDefault(
+            "inputCol" if core.hasParam("inputCol") else "featuresCol"
+        )
+        label_col = (
+            core.getOrDefault("labelCol") if algo in ("linreg", "logreg") else None
+        )
+        cols = [input_col] + ([label_col] if label_col else [])
+        sel = df.select(*cols)
+        multi_pass = algo in ("kmeans", "logreg")
+        if multi_pass:
+            sel = sel.persist()
+
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+        feed_params = {}
+        client = DataPlaneClient(host, port, token=token)
+        try:
+            if algo == "kmeans":
+                k = core.getK()
+                feed_params = {
+                    "k": k,
+                    "seed": core.getSeed(),
+                    "init": core.getInitMode(),
+                }
+                # Deterministic driver-side seeding: a small prefix sample
+                # (≥ k rows) — ONE tiny Spark job, like the reference's
+                # numCols probe (RapidsPCA.scala:73-74).
+                seed_n = max(k, min(4096, 32 * k))
+                seed_tbl = _df_to_arrow(sel.limit(seed_n), [input_col])
+                client.seed_kmeans(
+                    job, seed_tbl, k=k, input_col=input_col, params=feed_params
+                )
+
+            def run_pass(pass_id):
+                fn = _FeedTask(
+                    host, port, token, job, algo, input_col,
+                    label_col or "label", feed_params, pass_id,
+                )
+                acks = sel.mapInArrow(fn, "partition int, rows long").collect()
+                return sum(r["rows"] for r in acks)
+
+            if algo == "pca":
+                n = run_pass(None)
+                if n == 0:
+                    raise ValueError("cannot fit on an empty DataFrame")
+                arrays = client.finalize_pca(
+                    job,
+                    k=core.getK(),
+                    mean_center=core.getMeanCentering(),
+                    solver=core.getSolver(),
+                )
+                from spark_rapids_ml_tpu.models.pca import PCAModel
+
+                model = PCAModel(
+                    pc=arrays["pc"],
+                    explained_variance=arrays["explained_variance"],
+                    mean=arrays["mean"],
+                )
+            elif algo == "linreg":
+                n = run_pass(None)
+                if n == 0:
+                    raise ValueError("cannot fit on an empty DataFrame")
+                arrays, rows = client.finalize(
+                    job,
+                    {
+                        "reg": core.getRegParam(),
+                        "elastic_net": core.getElasticNetParam(),
+                        "fit_intercept": core.getFitIntercept(),
+                        "max_iter": core.getMaxIter(),
+                        "tol": core.getTol(),
+                    },
+                )
+                from spark_rapids_ml_tpu.models.linear_regression import (
+                    LinearRegressionModel,
+                    LinearRegressionTrainingSummary,
+                )
+
+                model = LinearRegressionModel(
+                    coefficients=arrays["coefficients"],
+                    intercept=float(arrays["intercept"][0]),
+                )
+                model._summary = LinearRegressionTrainingSummary(
+                    rmse=float(arrays["rmse"][0]),
+                    r2=float(arrays["r2"][0]),
+                    rss=float("nan"),
+                    tss=float("nan"),
+                    n_rows=rows,
+                )
+            elif algo == "kmeans":
+                tol2 = core.getTol() ** 2
+                info = {"cost": float("nan"), "iteration": 0}
+                for it in range(core.getMaxIter()):
+                    if run_pass(it) == 0:
+                        raise ValueError("cannot fit on an empty DataFrame")
+                    info = client.step(job)
+                    if info["moved2"] <= tol2:
+                        break
+                arrays = client.finalize_kmeans(job)
+                from spark_rapids_ml_tpu.models.kmeans import (
+                    KMeansModel,
+                    KMeansSummary,
+                )
+
+                model = KMeansModel(centers=arrays["centers"])
+                model._training_cost = info["cost"]
+                model._n_iter = info["iteration"]
+                model._summary = KMeansSummary(
+                    trainingCost=info["cost"],
+                    numIter=info["iteration"],
+                    k=core.getK(),
+                    n_rows=info.get("pass_rows", 0),
+                )
+            else:  # logreg
+                info = {"loss": float("nan"), "iteration": 0}
+                step_params = {
+                    "reg": core.getRegParam(),
+                    "fit_intercept": core.getFitIntercept(),
+                }
+                rows = 0
+                for it in range(core.getMaxIter()):
+                    rows = run_pass(it)
+                    if rows == 0:
+                        raise ValueError("cannot fit on an empty DataFrame")
+                    info = client.step(job, params=step_params)
+                    if info["delta"] <= core.getTol():
+                        break
+                arrays = client.finalize_logreg(job)
+                from spark_rapids_ml_tpu.models.logistic_regression import (
+                    LogisticRegressionModel,
+                    LogisticTrainingSummary,
+                )
+
+                model = LogisticRegressionModel(
+                    coefficients=arrays["coefficients"],
+                    intercept=float(arrays["intercept"][0]),
+                )
+                model._summary = LogisticTrainingSummary(
+                    loss=info["loss"], numIter=info["iteration"], n_rows=rows
+                )
+        finally:
+            try:
+                client.drop(job)  # no-op when finalize already dropped it
+            except Exception:
+                pass
+            client.close()
+            if multi_pass:
+                sel.unpersist()
+        model.uid = core.uid
+        core._copy_params_to(model)
+        return model
+
+
+class _TransformTask:
+    """Executor-side batch transform (pickle-able: the model's fitted
+    arrays ride the closure to each task, resident for the task's
+    lifetime — no per-batch re-upload, fixing rapidsml_jni.cu:85)."""
+
+    def __init__(self, core_model):
+        self._core = core_model
+
+    def __call__(self, batches):
+        import pyarrow as pa
+
+        for batch in batches:
+            table = pa.Table.from_batches([batch])
+            out = self._core.transform(table)
+            yield from out.to_batches()
 
 
 class _SparkModelAdapter:
@@ -140,18 +406,15 @@ class _SparkModelAdapter:
         if hasattr(dataset, "mapInArrow"):
             # Distributed, lazy: one Arrow batch per executor partition —
             # the columnar-UDF analogue (RapidsPCA.scala:128-161).
-
-            def transform_batches(batches):
-                for batch in batches:
-                    table = pa.Table.from_batches([batch])
-                    out = core.transform(table)
-                    yield from out.to_batches()
-
+            transform_batches = _TransformTask(core)
             sample = _df_to_arrow(dataset.limit(1), dataset.columns)
             out_sample = core.transform(sample)
-            from pyspark.sql.pandas.types import from_arrow_schema
+            try:
+                from pyspark.sql.pandas.types import from_arrow_schema
 
-            schema = from_arrow_schema(out_sample.schema)
+                schema = from_arrow_schema(out_sample.schema)
+            except ImportError:  # duck-typed DF harness: arrow schema is fine
+                schema = out_sample.schema
             return dataset.mapInArrow(transform_batches, schema)
 
         # Fallback: collect → transform → recreate (local mode only).
@@ -161,8 +424,12 @@ class _SparkModelAdapter:
         return spark.createDataFrame(out.to_pandas())
 
 
-def _make_wrapper(name, core_cls, doc):
-    cls = type(name, (_SparkAdapter,), {"_core_cls": core_cls, "__doc__": doc})
+def _make_wrapper(name, core_cls, doc, daemon_algo=None):
+    cls = type(
+        name,
+        (_SparkAdapter,),
+        {"_core_cls": core_cls, "__doc__": doc, "_daemon_algo": daemon_algo},
+    )
     return cls
 
 
@@ -181,16 +448,20 @@ from spark_rapids_ml_tpu.models.pca import PCA as _PCA
 from spark_rapids_ml_tpu.models.scaler import StandardScaler as _StandardScaler
 
 SparkPCA = _make_wrapper(
-    "SparkPCA", _PCA, "PCA over PySpark DataFrames (ArrayType features column)."
+    "SparkPCA", _PCA, "PCA over PySpark DataFrames (ArrayType features column).",
+    daemon_algo="pca",
 )
 SparkKMeans = _make_wrapper(
-    "SparkKMeans", _KMeans, "KMeans over PySpark DataFrames."
+    "SparkKMeans", _KMeans, "KMeans over PySpark DataFrames.",
+    daemon_algo="kmeans",
 )
 SparkLinearRegression = _make_wrapper(
-    "SparkLinearRegression", _LinearRegression, "LinearRegression over PySpark DataFrames."
+    "SparkLinearRegression", _LinearRegression,
+    "LinearRegression over PySpark DataFrames.", daemon_algo="linreg",
 )
 SparkLogisticRegression = _make_wrapper(
-    "SparkLogisticRegression", _LogisticRegression, "LogisticRegression over PySpark DataFrames."
+    "SparkLogisticRegression", _LogisticRegression,
+    "LogisticRegression over PySpark DataFrames.", daemon_algo="logreg",
 )
 SparkNearestNeighbors = _make_wrapper(
     "SparkNearestNeighbors", _NearestNeighbors, "Exact KNN over PySpark DataFrames."

@@ -19,6 +19,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+import os
+
 from spark_rapids_ml_tpu.core.dataset import num_rows, take_rows
 from spark_rapids_ml_tpu.core.params import (
     Estimator,
@@ -28,7 +30,63 @@ from spark_rapids_ml_tpu.core.params import (
     ParamDecl,
     TypeConverters,
 )
+from spark_rapids_ml_tpu.core.persistence import (
+    DefaultParamsReader,
+    DefaultParamsWriter,
+    MLReadable,
+    MLWritable,
+)
 from spark_rapids_ml_tpu.evaluation import Evaluator
+
+
+class _TunedModelPersistence(MLWritable, MLReadable):
+    """Nested save/load for tuned models, mirroring the Pipeline layout
+    (pipeline.py::_StagesMixin): metrics ride the metadata JSON; the best
+    model is persisted via its own writer under ``bestModel/``. Spark's
+    CrossValidatorModel/TrainValidationSplitModel are MLWritable the same
+    way (metadata + nested bestModel path)."""
+
+    _metrics_attr = "avgMetrics"  # subclass overrides
+
+    def save(self, path: str) -> None:
+        # Validate BEFORE touching the filesystem: a failed save must not
+        # leave a partial directory that blocks every retry.
+        if self.bestModel is None:
+            raise ValueError("cannot save a tuned model with no bestModel")
+        if not isinstance(self.bestModel, MLWritable):
+            raise TypeError(f"bestModel {self.bestModel.uid} is not MLWritable")
+        if os.path.exists(path):
+            raise FileExistsError(f"path {path} already exists")
+        os.makedirs(path)
+        try:
+            DefaultParamsWriter.save_metadata(
+                self, path,
+                extra={self._metrics_attr: list(getattr(self, self._metrics_attr))},
+            )
+            self.bestModel.save(os.path.join(path, "bestModel"))
+        except BaseException:
+            # A nested-writer failure (e.g. a non-MLWritable Pipeline
+            # stage) must not leave a partial directory that blocks every
+            # retry with FileExistsError.
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)
+            raise
+
+    @classmethod
+    def load(cls, path: str):
+        meta = DefaultParamsReader.load_metadata(path)
+        best = DefaultParamsReader.load_instance(os.path.join(path, "bestModel"))
+        obj = cls(bestModel=best)
+        obj.uid = meta["uid"]
+        setattr(obj, cls._metrics_attr, list(meta.get(cls._metrics_attr, [])))
+        for name, value in meta.get("defaultParamMap", {}).items():
+            if obj.hasParam(name):
+                obj.setDefault(**{name: value})
+        for name, value in meta.get("paramMap", {}).items():
+            if obj.hasParam(name):
+                obj._set(**{name: value})
+        return obj
 
 
 class ParamGridBuilder:
@@ -158,8 +216,9 @@ class CrossValidator(Estimator, _ValidatorParams):
         return out
 
 
-class CrossValidatorModel(Model):
+class CrossValidatorModel(Model, _TunedModelPersistence):
     _uid_prefix = "CrossValidatorModel"
+    _metrics_attr = "avgMetrics"
 
     def __init__(self, bestModel=None, avgMetrics=None, uid=None):
         super().__init__(uid=uid)
@@ -222,8 +281,9 @@ class TrainValidationSplit(Estimator, _ValidatorParams):
         return out
 
 
-class TrainValidationSplitModel(Model):
+class TrainValidationSplitModel(Model, _TunedModelPersistence):
     _uid_prefix = "TrainValidationSplitModel"
+    _metrics_attr = "validationMetrics"
 
     def __init__(self, bestModel=None, validationMetrics=None, uid=None):
         super().__init__(uid=uid)

@@ -50,6 +50,16 @@ def main() -> None:
     mesh = global_mesh()
     sol = fit_pca(x[lo:hi], k=k, mean_center=True, mesh=mesh)
 
+    # STREAMED multi-host fit (VERDICT round-1 gap #5): each process
+    # streams only its local slice, in UNEVEN batch counts (process 0
+    # gets 3 batches, process 1 gets 2) — lockstep_batches levels them.
+    from spark_rapids_ml_tpu.models.pca import fit_pca_stream
+
+    local = x[lo:hi]
+    n_batches = 3 if proc_id == 0 else 2
+    stream = np.array_split(local, n_batches)
+    ssol = fit_pca_stream(iter(stream), k=k, n_cols=d, mesh=mesh)
+
     # Exact KNN: each process indexes its local slice; queries identical
     # everywhere; returned ids are global row positions.
     from spark_rapids_ml_tpu.models.knn import NearestNeighbors
@@ -65,6 +75,8 @@ def main() -> None:
                     "pc": np.asarray(sol.pc).tolist(),
                     "ev": np.asarray(sol.explained_variance).tolist(),
                     "n_rows": sol.n_rows,
+                    "stream_pc": np.asarray(ssol.pc).tolist(),
+                    "stream_n_rows": ssol.n_rows,
                     "knn_idx": np.asarray(idx).tolist(),
                     "knn_d": np.asarray(dists).tolist(),
                 }

@@ -72,6 +72,13 @@ def test_two_process_pca_matches_single_process():
         np.asarray(result["ev"]), ref.explained_variance, atol=1e-10
     )
 
+    # Multi-host STREAMED fit (uneven per-process batch counts) must also
+    # match — the round-1 gap where fit_pca_stream was single-process only.
+    assert result["stream_n_rows"] == 603
+    np.testing.assert_allclose(
+        np.abs(np.asarray(result["stream_pc"])), np.abs(ref.pc), atol=1e-8
+    )
+
     # Exact KNN across processes: global ids must match a single-process
     # model over the full database.
     from spark_rapids_ml_tpu.models.knn import NearestNeighbors

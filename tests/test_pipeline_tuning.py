@@ -152,6 +152,35 @@ def test_binary_evaluator_auc():
     assert ev.evaluate({"label": y, "prediction": np.full(4, 0.5)}) == pytest.approx(0.5)
 
 
+def test_binary_evaluator_uses_raw_prediction(rng, mesh8):
+    # LogReg transform now emits rawPrediction/probability; the evaluator's
+    # default reads the rawPrediction vector (positive-class margin), giving
+    # a real threshold-sweep AUC rather than the hard-label one.
+    n, d = 400, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d,))
+    y = (x @ w + 0.5 * rng.normal(size=n) > 0).astype(np.float64)
+    ds = {"features": x, "label": y}
+    model = LogisticRegression(mesh=mesh8).setMaxIter(25).fit(ds)
+    out = model.transform(ds)
+    assert out["rawPrediction"].shape == (n, 2)
+    assert out["probability"].shape == (n, 2)
+    np.testing.assert_allclose(out["probability"].sum(axis=1), 1.0, atol=1e-12)
+    # rawPrediction[:, 1] is the log-odds; softmax of raw == probability.
+    np.testing.assert_allclose(
+        1 / (1 + np.exp(-out["rawPrediction"][:, 1])),
+        out["probability"][:, 1],
+        atol=1e-12,
+    )
+    auc_raw = BinaryClassificationEvaluator().evaluate(out)
+    hard_only = {"label": y, "prediction": out["prediction"].astype(np.float64)}
+    auc_hard = BinaryClassificationEvaluator().evaluate(hard_only)
+    assert auc_raw > 0.8
+    # The score-based AUC is at least as informative as the one-threshold AUC
+    # and generally differs from it (it sweeps thresholds).
+    assert auc_raw >= auc_hard - 1e-9
+
+
 def test_multiclass_evaluator():
     ds = {"label": np.array([0, 1, 2, 1.0]), "prediction": np.array([0, 1, 1, 1.0])}
     ev = MulticlassClassificationEvaluator()
@@ -182,6 +211,34 @@ def test_cross_validator_picks_better_reg(reg_data, mesh8):
     assert "prediction" in out
 
 
+def test_copy_extra_keys_by_parent_uid():
+    """Param-keyed extras apply by (parent uid, name), like Spark ParamMaps.
+
+    Regression: a grid keyed on one estimator's maxIter must not set the
+    same-named param on an unrelated estimator, and 'k' must not collide
+    between PCA and KMeans when both sit in one Pipeline.
+    """
+    from spark_rapids_ml_tpu import KMeans
+
+    lr = LinearRegression().setMaxIter(7)
+    km = KMeans().setMaxIter(11)
+    # Extra keyed on lr.maxIter: applies to lr copies only.
+    lr2 = lr.copy({lr.getParam("maxIter"): 99})
+    km2 = km.copy({lr.getParam("maxIter"): 99})
+    assert lr2.getMaxIter() == 99
+    assert km2.getMaxIter() == 11
+    # Same-class different-instance is also skipped (Spark strictness).
+    other = LinearRegression()
+    lr3 = lr.copy({other.getParam("maxIter"): 55})
+    assert lr3.getMaxIter() == 7
+    # Through a Pipeline: extras reach exactly the stage they were keyed on.
+    pca, km4 = PCA().setK(3), KMeans().setK(8)
+    pipe = Pipeline(stages=[pca, km4])
+    tuned = pipe.copy({km4.getParam("k"): 5})
+    assert tuned.getStages()[0].getK() == 3
+    assert tuned.getStages()[1].getK() == 5
+
+
 def test_cross_validator_validation():
     lr = LinearRegression()
     cv = CrossValidator(estimator=lr, evaluator=RegressionEvaluator(), numFolds=1)
@@ -189,6 +246,44 @@ def test_cross_validator_validation():
         cv.fit({"features": np.zeros((10, 2), np.float32), "label": np.zeros(10)})
     with pytest.raises(ValueError, match="estimator and evaluator"):
         CrossValidator(estimator=lr).fit({"features": np.zeros((10, 2), np.float32)})
+
+
+def test_tuned_model_persistence(reg_data, mesh8, tmp_path):
+    from spark_rapids_ml_tpu import CrossValidatorModel, TrainValidationSplitModel
+
+    lr = LinearRegression()
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 10.0]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(), numFolds=2, seed=3,
+    )
+    model = cv.fit(reg_data)
+    path = str(tmp_path / "cvm")
+    model.save(path)
+    loaded = CrossValidatorModel.load(path)
+    assert loaded.uid == model.uid
+    assert loaded.avgMetrics == pytest.approx(model.avgMetrics)
+    np.testing.assert_allclose(
+        loaded.bestModel.coefficients, model.bestModel.coefficients
+    )
+    out = loaded.transform(reg_data)
+    np.testing.assert_allclose(
+        out["prediction"], model.transform(reg_data)["prediction"]
+    )
+
+    tvs = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(), trainRatio=0.7, seed=3,
+    )
+    tmodel = tvs.fit(reg_data)
+    tpath = str(tmp_path / "tvsm")
+    tmodel.save(tpath)
+    tloaded = TrainValidationSplitModel.load(tpath)
+    assert tloaded.uid == tmodel.uid
+    assert tloaded.validationMetrics == pytest.approx(tmodel.validationMetrics)
+    np.testing.assert_allclose(
+        tloaded.bestModel.coefficients, tmodel.bestModel.coefficients
+    )
 
 
 def test_train_validation_split_logreg(rng, mesh8):

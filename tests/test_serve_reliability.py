@@ -1,0 +1,279 @@
+"""Daemon reliability: exactly-once under retry, eviction, auth, failures.
+
+The reference delegates all of this to Spark (task retry recomputes pure
+map stages; the transport is Spark's own RPC — RapidsRowMatrix.scala:122-139).
+This framework owns its transport, so it must own the failure semantics:
+these tests kill feeders mid-stream, replay retried attempts, race
+speculative duplicates, expire abandoned jobs, and reject unauthenticated
+callers — asserting the final model is bit-identical to the single-shot
+in-memory fit every time.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.pca import fit_pca
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+from spark_rapids_ml_tpu.serve import protocol
+
+
+@pytest.fixture
+def daemon(mesh8):
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        yield d
+
+
+def _client(daemon, **kw):
+    return DataPlaneClient(*daemon.address, **kw)
+
+
+@pytest.fixture
+def data(rng):
+    n, d = 480, 16
+    basis = rng.normal(size=(d, d)) * np.logspace(0, -1.5, d)
+    return rng.normal(size=(n, d)) @ basis
+
+
+def _assert_matches_batch_fit(daemon, data, mesh8, job, k=3):
+    with _client(daemon) as c:
+        out = c.finalize_pca(job, k=k)
+    ref = fit_pca(data, k=k, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-8)
+    np.testing.assert_allclose(out["mean"], ref.mean, atol=1e-10)
+
+
+# ------------------------- staged commit protocol ---------------------------
+
+
+def test_partitioned_feed_commit_matches_batch_fit(daemon, data, mesh8):
+    parts = np.array_split(data, 4)
+
+    def task(pid, part):
+        with _client(daemon) as c:
+            for sub in np.array_split(part, 2):
+                c.feed("j", sub, algo="pca", partition=pid)
+            c.commit("j", partition=pid)
+
+    threads = [threading.Thread(target=task, args=(i, p)) for i, p in enumerate(parts)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    with _client(daemon) as c:
+        assert c.status("j")["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "j")
+
+
+def test_uncommitted_stage_never_counts(daemon, data, mesh8):
+    """A task that fed its stage but died before commit contributes nothing."""
+    parts = np.array_split(data, 3)
+    with _client(daemon) as c:
+        # partition 0: feeds WRONG data (a doomed attempt), never commits
+        c.feed("j", np.full_like(parts[0], 1e6), algo="pca", partition=0, attempt=0)
+        # retry of partition 0 with the real data, new attempt
+        c.feed("j", parts[0], algo="pca", partition=0, attempt=1)
+        c.commit("j", partition=0, attempt=1)
+        for pid, part in enumerate(parts[1:], start=1):
+            c.feed("j", part, algo="pca", partition=pid)
+            c.commit("j", partition=pid)
+        assert c.status("j")["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "j")
+
+
+def test_duplicate_feed_and_commit_discarded(daemon, data, mesh8):
+    """Speculative duplicate of a committed task must not double-count."""
+    parts = np.array_split(data, 2)
+    with _client(daemon) as c:
+        c.feed("j", parts[0], algo="pca", partition=0)
+        c.commit("j", partition=0)
+        # duplicate task replays the same partition (same + newer attempt)
+        c.feed("j", parts[0], algo="pca", partition=0, attempt=0)
+        c.feed("j", parts[0], algo="pca", partition=0, attempt=7)
+        c.commit("j", partition=0, attempt=7)
+        c.feed("j", parts[1], algo="pca", partition=1)
+        c.commit("j", partition=1)
+        assert c.status("j")["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "j")
+
+
+def test_concurrent_speculative_attempts_interleaved(daemon, data, mesh8):
+    """Spark speculation runs a duplicate attempt ALONGSIDE the original.
+    Interleaved feeds from two live attempts must accumulate independently
+    (per-(partition, attempt) stages); whichever commits first wins with
+    its COMPLETE data, the loser is discarded."""
+    parts = np.array_split(data, 2)
+    sub = np.array_split(parts[0], 2)
+    with _client(daemon) as c:
+        # interleave: A0 feeds half, A1 feeds half, A0 feeds rest, A1 rest
+        c.feed("j", sub[0], algo="pca", partition=0, attempt=0)
+        c.feed("j", sub[0], algo="pca", partition=0, attempt=1)
+        c.feed("j", sub[1], algo="pca", partition=0, attempt=0)
+        c.feed("j", sub[1], algo="pca", partition=0, attempt=1)
+        # original commits first — must carry BOTH its batches
+        c.commit("j", partition=0, attempt=0)
+        # speculative duplicate commits late — discarded
+        c.commit("j", partition=0, attempt=1)
+        c.feed("j", parts[1], algo="pca", partition=1)
+        c.commit("j", partition=1)
+        assert c.status("j")["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "j")
+
+
+def test_seed_with_bad_token_keeps_framing(mesh8, data):
+    """A rejected seed op (payload-carrying) must drain its payload so the
+    connection stays usable for subsequent framed requests."""
+    with DataPlaneDaemon(mesh=mesh8, token="tk") as d:
+        with DataPlaneClient(*d.address, token="bad") as c:
+            with pytest.raises(RuntimeError, match="unauthorized"):
+                c.seed_kmeans("km", data, k=3)
+            # same connection: framing intact, next op parses correctly
+            with pytest.raises(RuntimeError, match="unauthorized"):
+                c.ping()
+        with DataPlaneClient(*d.address, token="tk") as c:
+            assert c.ping()
+
+
+def test_commit_without_stage_rejected(daemon, data):
+    with _client(daemon) as c:
+        c.feed("j", data, algo="pca", partition=0)
+        with pytest.raises(RuntimeError, match="no staged feed"):
+            c.commit("j", partition=3)
+
+
+def test_commit_attempt_mismatch_rejected(daemon, data):
+    with _client(daemon) as c:
+        c.feed("j", data, algo="pca", partition=0, attempt=2)
+        with pytest.raises(RuntimeError, match="attempt"):
+            c.commit("j", partition=0, attempt=1)
+        # the stage survives a bad commit; the right attempt still lands
+        assert c.commit("j", partition=0, attempt=2) == data.shape[0]
+
+
+def test_feeder_killed_mid_frame_leaves_job_consistent(daemon, data, mesh8):
+    """A feeder whose socket dies mid-Arrow-payload must not corrupt the
+    job: the daemon drops the half-read connection, the stage is absent,
+    and a clean retry produces the exact model."""
+    parts = np.array_split(data, 2)
+    with _client(daemon) as c:
+        c.feed("j", parts[0], algo="pca", partition=0)
+        c.commit("j", partition=0)
+
+    # raw socket: send the feed JSON + a truncated payload frame, then die
+    s = socket.create_connection(daemon.address, timeout=10)
+    protocol.send_json(
+        s, {"op": "feed", "job": "j", "algo": "pca", "partition": 1}
+    )
+    s.sendall((123456).to_bytes(4, "big"))  # promises 123456 bytes...
+    s.sendall(b"x" * 1000)  # ...delivers 1000
+    s.close()
+    time.sleep(0.2)
+
+    with _client(daemon) as c:
+        assert c.status("j")["rows"] == parts[0].shape[0]  # nothing leaked in
+        c.feed("j", parts[1], algo="pca", partition=1, attempt=1)
+        c.commit("j", partition=1, attempt=1)
+        assert c.status("j")["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "j")
+
+
+# ------------------------- iterative pass fencing ---------------------------
+
+
+def test_stale_pass_feed_rejected(daemon, rng):
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    with _client(daemon) as c:
+        c.seed_kmeans("km", x, k=3, params={"seed": 0})
+        c.feed("km", x, algo="kmeans", partition=0, pass_id=0, params={"k": 3})
+        c.commit("km", partition=0, pass_id=0)
+        c.step("km")
+        # a zombie task from pass 0 arrives late
+        with pytest.raises(RuntimeError, match="stale pass"):
+            c.feed("km", x, algo="kmeans", partition=0, pass_id=0, params={"k": 3})
+        with pytest.raises(RuntimeError, match="stale pass"):
+            c.commit("km", partition=0, pass_id=0)
+        # current-pass traffic flows
+        c.feed("km", x, algo="kmeans", partition=0, pass_id=1, params={"k": 3})
+        c.commit("km", partition=0, pass_id=1)
+
+
+def test_seeded_kmeans_deterministic_across_feed_orders(daemon, rng, mesh8):
+    """Driver-side seeding makes the fit independent of partition arrival
+    order — the reproducibility gap of first-batch-wins seeding."""
+    x = rng.normal(size=(240, 6)).astype(np.float32)
+    parts = np.array_split(x, 4)
+    results = []
+    for job, order in (("a", [0, 1, 2, 3]), ("b", [3, 2, 1, 0])):
+        with _client(daemon) as c:
+            c.seed_kmeans(job, x[:50], k=4, params={"seed": 7})
+            for it in range(3):
+                for pid in order:
+                    c.feed(job, parts[pid], algo="kmeans", partition=pid,
+                           pass_id=it, params={"k": 4})
+                    c.commit(job, partition=pid, pass_id=it)
+                c.step(job)
+            results.append(c.finalize_kmeans(job)["centers"])
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_partitioned_kmeans_requires_seed(daemon, rng):
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    with _client(daemon) as c:
+        with pytest.raises(RuntimeError, match="seed"):
+            c.feed("km2", x, algo="kmeans", partition=0, params={"k": 3})
+
+
+# ------------------------------ ttl eviction --------------------------------
+
+
+def test_ttl_evicts_abandoned_job(mesh8, data):
+    with DataPlaneDaemon(mesh=mesh8, ttl=0.3) as d:
+        with DataPlaneClient(*d.address) as c:
+            c.feed("leak", data, algo="pca")
+            assert c.status("leak")["rows"] == data.shape[0]
+        # driver "crashes" here; reaper collects the orphan
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                with DataPlaneClient(*d.address) as c:
+                    c.status("leak")
+                time.sleep(0.1)
+            except RuntimeError:
+                break
+        else:
+            pytest.fail("abandoned job was never evicted")
+
+
+def test_active_job_survives_ttl(mesh8, data):
+    with DataPlaneDaemon(mesh=mesh8, ttl=1.0) as d:
+        with DataPlaneClient(*d.address) as c:
+            parts = np.array_split(data, 4)
+            for pid, part in enumerate(parts):
+                c.feed("live", part, algo="pca", partition=pid)
+                c.commit("live", partition=pid)
+                time.sleep(0.4)  # slower than ttl/4, faster than ttl
+            assert c.status("live")["rows"] == data.shape[0]
+
+
+# --------------------------------- auth -------------------------------------
+
+
+def test_token_required_when_configured(mesh8, data):
+    with DataPlaneDaemon(mesh=mesh8, token="s3cret") as d:
+        with DataPlaneClient(*d.address) as c:
+            with pytest.raises(RuntimeError, match="unauthorized"):
+                c.ping()
+        with DataPlaneClient(*d.address, token="wrong") as c:
+            with pytest.raises(RuntimeError, match="unauthorized"):
+                c.feed("j", data, algo="pca")
+        with DataPlaneClient(*d.address, token="s3cret") as c:
+            assert c.ping()
+            c.feed("j", data, algo="pca")
+            out = c.finalize_pca("j", k=2)
+            assert out["pc"].shape == (data.shape[1], 2)
+
+
+def test_no_token_daemon_ignores_client_token(daemon):
+    with _client(daemon, token="anything") as c:
+        assert c.ping()

@@ -1,0 +1,71 @@
+"""Wrapper integration against a REAL SparkSession (local[4]).
+
+Skipped automatically when pyspark is absent (this repo's dev image
+cannot install it — see README "Spark integration testing"); the CI
+Docker image has pyspark and runs these. Mirrors the reference's
+PCASuite (PCASuite.scala:42-88): ArrayType input, fit on a
+multi-partition DataFrame through the executor-fed daemon path,
+mapInArrow transform, CPU-oracle parity, sign-invariant 1e-5.
+"""
+
+import numpy as np
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+
+from pyspark.sql import SparkSession  # noqa: E402
+
+from spark_rapids_ml_tpu.models.pca import fit_pca  # noqa: E402
+from spark_rapids_ml_tpu.spark.estimator import SparkPCA, SparkLinearRegression  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = (
+        SparkSession.builder.master("local[4]")
+        .appName("srml-tpu-it")
+        .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+        .getOrCreate()
+    )
+    yield s
+    s.stop()
+    from spark_rapids_ml_tpu.spark import daemon_session
+
+    daemon_session.shutdown()
+
+
+@pytest.fixture
+def pca_df(spark, rng):
+    n, d = 2000, 16
+    basis = rng.normal(size=(d, d)) * np.logspace(0, -1.5, d)
+    x = (rng.normal(size=(n, d)) @ basis).astype(np.float64)
+    rows = [(row.tolist(),) for row in x]
+    df = spark.createDataFrame(rows, ["features"]).repartition(4)
+    return df, x
+
+
+def test_real_spark_pca_fit_and_transform(pca_df, mesh8):
+    df, x = pca_df
+    model = SparkPCA().setInputCol("features").setK(3).fit(df)
+    ref = fit_pca(x, k=3, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(model.pc), np.abs(ref.pc), atol=1e-5)
+    out = model.transform(df)
+    assert "pca_features" in out.columns
+    got = np.asarray(out.select("pca_features").toPandas()["pca_features"].tolist())
+    want = x @ model.pc  # Spark PCA transform does not mean-center
+    # row order is not preserved across repartition; compare norms sorted
+    np.testing.assert_allclose(
+        np.sort(np.abs(got).sum(axis=1)), np.sort(np.abs(want).sum(axis=1)),
+        atol=1e-4,
+    )
+
+
+def test_real_spark_linreg_fit(spark, rng, mesh8):
+    n, d = 1500, 8
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=(d,))
+    y = x @ w + 0.25
+    rows = [(xi.tolist(), float(yi)) for xi, yi in zip(x, y)]
+    df = spark.createDataFrame(rows, ["features", "label"]).repartition(4)
+    model = SparkLinearRegression().setRegParam(1e-6).fit(df)
+    np.testing.assert_allclose(model.coefficients, w, atol=1e-4)
