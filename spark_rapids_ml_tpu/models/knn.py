@@ -245,6 +245,49 @@ class IVFFlatIndex(NamedTuple):
     list_mask: np.ndarray  # (nlist, maxlen) 1.0 valid
 
 
+# Padded-list capacity bound, as a multiple of the mean list size n/nlist.
+# The rectangular (nlist, maxlen, d) device layout pays nlist×maxlen×d for
+# the HOTTEST list: on clustered data (the data IVF exists for) the coarse
+# quantizer routinely drops several natural clusters into one list and a
+# maxlen of 20-30× the mean follows — a 24 GB index for 3 GB of rows.
+# Lists are therefore capacity-bounded: rows past a list's cap spill to
+# their next-nearest centroid (FAISS keeps ragged lists instead; a fixed
+# cap is the TPU-native answer, same trade as the query side's bucket
+# capacity C). A query probing nprobe lists generally probes the spill
+# target too, so the recall cost is small — and the scan cost drops with
+# maxlen, so balance is also a throughput win.
+IVF_MAX_LOAD_FACTOR = 2.0
+_IVF_SPILL_CANDIDATES = 4
+
+
+def _balance_assignments(cand: np.ndarray, nlist: int, cap: int) -> np.ndarray:
+    """Greedy capacity-bounded assignment from preference-ordered
+    candidates ``cand`` (n, T): round t gives every still-unassigned row
+    its t-th nearest list while capacity remains; leftovers after T rounds
+    fill the least-loaded lists (guaranteed to fit: cap·nlist ≥ n)."""
+    n, T = cand.shape
+    assign = np.full(n, -1, np.int64)
+    load = np.zeros(nlist, np.int64)
+    pending = np.arange(n)
+    for t in range(T):
+        want = cand[pending, t].astype(np.int64)
+        order = np.argsort(want, kind="stable")
+        sw = want[order]
+        run_start = np.searchsorted(sw, np.arange(nlist))
+        pos_in_run = np.arange(len(sw)) - run_start[sw]
+        ok = pos_in_run < np.maximum(cap - load[sw], 0)
+        assign[pending[order[ok]]] = sw[ok]
+        load += np.bincount(sw[ok], minlength=nlist)
+        pending = pending[order[~ok]]
+        if pending.size == 0:
+            break
+    if pending.size:
+        spare = np.maximum(cap - load, 0)
+        slots = np.repeat(np.arange(nlist), spare)
+        assign[pending] = slots[: pending.size]
+    return assign
+
+
 def build_ivf_flat(
     x: np.ndarray,
     nlist: int,
@@ -286,19 +329,70 @@ def build_ivf_flat(
     # 1M×768×1024 the host-numpy version is minutes of CPU); only the
     # (n,) argmin comes back. The scatter into padded lists stays on host.
     n = x.shape[0]
-    assign = np.empty((n,), dtype=np.int64)
+    T = min(_IVF_SPILL_CANDIDATES, nlist)
     cdev = jnp.asarray(centroids, jnp.float32)
 
     @jax.jit
-    def _assign_chunk(chunk, cdev):
+    def _argmin_chunk(chunk, cdev):
         d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
-        return jnp.argmin(d2, axis=1)
+        return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    @jax.jit
+    def _cand_chunk(chunk, cdev):
+        d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
+        # approx_min_k, not top_k: exact top-k lowers to a full per-row
+        # sort of the nlist-wide row — minutes at 1M×1024 — and the
+        # preference order only feeds capacity balancing (the primary
+        # assignment above stays an EXACT argmin).
+        _, idx = jax.lax.approx_min_k(d2, T, recall_target=0.95)
+        return idx.astype(jnp.int32)
 
     step = 1 << 18
-    for i in range(0, n, step):
-        chunk = jnp.asarray(x[i : i + step], jnp.float32)
-        assign[i : i + step] = np.asarray(_assign_chunk(chunk, cdev))
+
+    def _chunked(fn, width):
+        out = np.empty((n, width) if width > 1 else (n,), dtype=np.int32)
+        for i in range(0, n, step):
+            chunk = jnp.asarray(x[i : i + step], jnp.float32)
+            out[i : i + step] = np.asarray(fn(chunk, cdev))
+        return out
+
+    @jax.jit
+    def _recenter_chunk(xc, ac, sums, cnt):
+        onehot = jax.nn.one_hot(ac, nlist, dtype=jnp.bfloat16)
+        sums = sums + jax.lax.dot_general(
+            onehot, xc.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        cnt = cnt + jnp.sum(onehot.astype(jnp.float32), axis=0)
+        return sums, cnt
+
+    def _recenter(assign_np, cdev):
+        sums = jnp.zeros((nlist, x.shape[1]), jnp.float32)
+        cnt = jnp.zeros((nlist,), jnp.float32)
+        for i in range(0, n, step):
+            sums, cnt = _recenter_chunk(
+                jnp.asarray(x[i : i + step], jnp.float32),
+                jnp.asarray(assign_np[i : i + step], jnp.int32),
+                sums, cnt,
+            )
+        return jnp.where((cnt > 0)[:, None],
+                         sums / jnp.maximum(cnt, 1.0)[:, None], cdev)
+
+    assign = _chunked(_argmin_chunk, 1).astype(np.int64)
     counts = np.bincount(assign, minlength=nlist)
+    cap = max(int(np.ceil(IVF_MAX_LOAD_FACTOR * n / nlist)), -(-n // nlist))
+    if int(counts.max()) > cap:
+        # Balanced-Lloyd refinement (see build_ivf_flat_device): recentring
+        # from the balanced assignment is what keeps recall — plain spill
+        # scatters a hot list's overflow to far lists.
+        for _ in range(3):
+            cand = _chunked(_cand_chunk, T)
+            assign = _balance_assignments(cand, nlist, cap)
+            cdev = _recenter(assign, cdev)
+        cand = _chunked(_cand_chunk, T)
+        assign = _balance_assignments(cand, nlist, cap)
+        counts = np.bincount(assign, minlength=nlist)
+        centroids = np.asarray(jax.device_get(cdev), dtype=centroids.dtype)
     maxlen = max(int(counts.max()), 1)
     d = x.shape[1]
     lists = np.zeros((nlist, maxlen, d), dtype=x.dtype)
@@ -366,27 +460,89 @@ def build_ivf_flat_device(
     centroids, _, _ = fn(sample, jnp.ones((n_train,), jnp.float32), centers0)
     centroids = centroids.astype(jnp.float32)
 
+    T = min(_IVF_SPILL_CANDIDATES, nlist)
+
     @jax.jit
-    def _assign_chunk(chunk, centroids):
+    def _argmin_chunk(chunk, centroids):
         d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
         return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    @jax.jit
+    def _cand_chunk(chunk, centroids):
+        d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
+        # approx_min_k (not top_k: that is a full per-row sort — minutes
+        # at 1M×1024); the preference order only feeds capacity balancing —
+        # the unbalanced path's primary assignment stays an EXACT argmin.
+        _, idx = jax.lax.approx_min_k(d2, T, recall_target=0.95)
+        return idx.astype(jnp.int32)
 
     # Chunked assignment for ANY n (a whole-x call would materialize the
     # (n, nlist) distance matrix); at most two compiled shapes (full chunk
     # + remainder).
     step = 1 << 18
-    assign = (
-        jnp.concatenate(
-            [
-                _assign_chunk(jax.lax.slice_in_dim(x, i, min(i + step, n)), centroids)
-                for i in range(0, n, step)
-            ]
+
+    def _chunked(fn, centroids):
+        return (
+            jnp.concatenate(
+                [
+                    fn(jax.lax.slice_in_dim(x, i, min(i + step, n)), centroids)
+                    for i in range(0, n, step)
+                ]
+            )
+            if n > step
+            else fn(x, centroids)
         )
-        if n > step
-        else _assign_chunk(x, centroids)
-    )
+
+    @jax.jit
+    def _recenter_chunk(xc, ac, sums, cnt):
+        # One-hot MXU matmul, not scatter-add: the (chunk, nlist) one-hot
+        # GEMM is milliseconds where a 1M-row scatter is minutes.
+        onehot = jax.nn.one_hot(ac, nlist, dtype=jnp.bfloat16)
+        sums = sums + jax.lax.dot_general(
+            onehot, xc.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        cnt = cnt + jnp.sum(onehot.astype(jnp.float32), axis=0)
+        return sums, cnt
+
+    def _recenter(assign, centroids):
+        sums = jnp.zeros((nlist, d), jnp.float32)
+        cnt = jnp.zeros((nlist,), jnp.float32)
+        for i in range(0, n, step):
+            sums, cnt = _recenter_chunk(
+                jax.lax.slice_in_dim(x, i, min(i + step, n)),
+                jax.lax.slice_in_dim(assign, i, min(i + step, n)),
+                sums, cnt,
+            )
+        return jnp.where(
+            (cnt > 0)[:, None], sums / jnp.maximum(cnt, 1.0)[:, None], centroids
+        )
+
+    assign = _chunked(_argmin_chunk, centroids)
     counts = jnp.zeros((nlist,), jnp.int32).at[assign].add(1)
-    maxlen = max(int(jax.device_get(counts.max())), 1)  # static for the jit below
+    natural_max = int(jax.device_get(counts.max()))
+    cap = max(int(np.ceil(IVF_MAX_LOAD_FACTOR * n / nlist)), -(-n // nlist))
+    if natural_max > cap:
+        # BALANCED-LLOYD refinement: capacity-greedy assignment (host; the
+        # (n, T) int32 round-trip is tiny next to the index) followed by
+        # centroid recomputation from the balanced assignment. The
+        # recentering is what keeps recall: a plain spill leaves the hot
+        # centroid mid-mega-cluster and scatters its overflow to far
+        # lists, while a recentred quantizer MOVES centroids toward their
+        # bounded share of the data, so spill targets become genuinely
+        # near rows that land in them (balanced k-means).
+        cand = _chunked(_cand_chunk, centroids)
+        for _ in range(3):
+            assign_np = _balance_assignments(np.asarray(cand), nlist, cap)
+            assign = jnp.asarray(assign_np, jnp.int32)
+            centroids = _recenter(assign, centroids)
+            cand = _chunked(_cand_chunk, centroids)
+        assign_np = _balance_assignments(np.asarray(cand), nlist, cap)
+        assign = jnp.asarray(assign_np, jnp.int32)
+        counts = jnp.zeros((nlist,), jnp.int32).at[assign].add(1)
+        maxlen = max(int(jax.device_get(counts.max())), 1)
+    else:
+        maxlen = max(natural_max, 1)  # static for the jit below
 
     @functools.partial(jax.jit, static_argnames=("maxlen",))
     def _bucketize(x, assign, counts, key, maxlen):
@@ -439,7 +595,8 @@ def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
 def _bucketed_core(
     queries, probe, probe_d2, lists, list_ids, list_mask, resid_norms,
     n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
-    list_block: int = 16, shortlist_mult: int = 2, *, lists_lo, centroids,
+    list_block: int = 16, shortlist_mult: int = 2, rerank: bool = True,
+    *, lists_lo, centroids,
 ):
     """The capacity-bucketed scorer over ONE device's lists.
 
@@ -612,6 +769,18 @@ def _bucketed_core(
     cand_list = jnp.broadcast_to(
         pair_list[:, :, None], (q, nprobe, blk_k)
     ).reshape(q, nprobe * blk_k)
+    if not rerank:
+        # Residual-identity scores ARE comparable across lists (the probe
+        # term was added above); answering from them skips the (q, R, d)
+        # raw-row gather — the most expensive post-scan op (+25-30% q/s
+        # for <0.01 recall@10 measured on clustered 768-d, config
+        # ann_rerank).
+        neg, pos = jax.lax.top_k(-cand_d, k)
+        wl = jnp.take_along_axis(cand_list, pos, axis=1)
+        wp = jnp.take_along_axis(cand_pos, pos, axis=1)
+        ids_k = ids_p[wl, wp]
+        win_ids = jnp.where(jnp.isinf(neg), -1, ids_k)
+        return jnp.maximum(-neg, 0.0), win_ids
     # Exact rerank (the ScaNN two-stage): select a 2·mult·k-wide shortlist
     # by approximate score, rescore exactly in f32 from the stored rows.
     R = min(2 * shortlist_mult * k, nprobe * blk_k)
@@ -667,7 +836,8 @@ def _residual_index_data(lists, centroids, compute_dtype, chunk: int = 64):
 
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
-                  slack: float = 1.5, shortlist_mult: int = 2):
+                  slack: float = 1.5, shortlist_mult: int = 2,
+                  rerank: bool = True):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -789,7 +959,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         return _bucketed_core(
             queries, probe, probe_d2, lists, list_ids, list_mask,
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
-            list_block=16, shortlist_mult=shortlist_mult,
+            list_block=16, shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=centroids,
         )
 
@@ -842,6 +1012,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
 def _ivf_query_fn_sharded(
     k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5,
     shortlist_mult: int = 2,
+    rerank: bool = True,
 ):
     """Sharded IVF query: inverted lists sharded over the ``data`` mesh
     axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
@@ -890,7 +1061,7 @@ def _ivf_query_fn_sharded(
         dists, ids = _bucketed_core(
             queries, probe_local, probe_d2, lists, list_ids, list_mask,
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
-            shortlist_mult=shortlist_mult,
+            shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=cent_local,
         )
         # Merge the per-device top-k: O(q·k·devices) over ICI.
@@ -1127,12 +1298,14 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     k, nprobe, config.get("compute_dtype"),
                     config.get("accum_dtype"), self._shard_mesh,
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
+                    rerank=bool(config.get("ann_rerank")),
                 )
             else:
                 fn = _ivf_query_fn(
                     k, nprobe, config.get("compute_dtype"),
                     config.get("accum_dtype"),
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
+                    rerank=bool(config.get("ann_rerank")),
                 )
             cent, lists, ids_dev, mask = self._ensure_dev_index()
             cd = jnp.dtype(config.get("compute_dtype"))

@@ -145,6 +145,16 @@ def lockstep_batches(batches, n_cols: int):
                 f"lockstep_batches: process {bad} supplied an unsupported "
                 "batch dtype (expected float16/32/64)"
             )
+        live = flags[flags[:, 0] == 1, 1]
+        if live.size and live.min() != live.max():
+            # Two live hosts feeding different dtypes would trace different
+            # SPMD programs — raise identically on every host instead of
+            # hanging in a diverged collective.
+            raise TypeError(
+                "lockstep_batches: feeding hosts disagree on batch dtype "
+                f"(codes {sorted(set(int(v) for v in live))}); make every "
+                "host's loader produce the same dtype"
+            )
         if not flags[:, 0].any():
             return
         if batch is None:

@@ -523,6 +523,13 @@ class DataPlaneDaemon:
                 evicted = [(name, self._jobs.pop(name)) for name in stale]
             for name, job in evicted:
                 with job.lock:
+                    # Revalidate under job.lock: an op ack'd between the
+                    # stale scan and here refreshed `touched` — its rows
+                    # were accepted, so the job must survive (reinsert).
+                    if now - job.touched <= self._ttl:
+                        with self._jobs_lock:
+                            self._jobs.setdefault(name, job)
+                        continue
                     job.dropped = True
                 logger.warning(
                     "evicted idle job %r (%.1fs > ttl %.1fs, %d rows fed)",
