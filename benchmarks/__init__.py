@@ -33,7 +33,9 @@ def setup_platform() -> None:
         jax.config.update("jax_platforms", plat)
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+def emit(metric: str, value: float, unit: str, vs_baseline: float, **extras) -> None:
+    """Print the one-JSON-line bench contract; ``extras`` appends further
+    keys (recall, component rates, flags) to the same line."""
     print(
         json.dumps(
             {
@@ -41,6 +43,7 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
                 "value": round(value, 4),
                 "unit": unit,
                 "vs_baseline": round(vs_baseline, 4),
+                **extras,
             }
         )
     )

@@ -41,7 +41,7 @@ A100_H2D_ROWS_PER_SEC = 20e9 / (D * 4)
 
 
 def main() -> None:
-    from benchmarks import emit, setup_platform, sync
+    from benchmarks import setup_platform, sync
 
     setup_platform()
     import jax
@@ -105,21 +105,32 @@ def main() -> None:
     sync(state)
     pipe_dt = (time.perf_counter() - t0) / N_BATCHES
 
+    # Transfer-only timing for the tunneled flag: a device_put of one
+    # batch, synced. On the axon dev harness this crosses a network tunnel
+    # at single-digit MB/s — the pipeline number then measures the TUNNEL,
+    # not the architecture. Deriving the flag from the pipeline rate would
+    # also fire on compute-bound smoke runs; measure the hop itself.
+    t0 = time.perf_counter()
+    xt = jax.device_put(host, x_sh)
+    sync(xt)
+    transfer_dt = time.perf_counter() - t0
+    transfer_bps = BATCH_ROWS * D * 4 / transfer_dt
+
+    from benchmarks import emit
+
     pipeline_rate = BATCH_ROWS / pipe_dt
     emit(
         f"ingest_pipeline_rows_per_sec_d{D}",
         pipeline_rate,
         "rows/s",
         pipeline_rate / A100_H2D_ROWS_PER_SEC,
-    )
-    # Companion diagnostics on stderr (the driver contract wants exactly
-    # one JSON line on stdout).
-    print(
-        f"# bridge-only: {BATCH_ROWS / bridge_dt:.0f} rows/s; "
-        f"compute-only: {BATCH_ROWS / compute_dt:.0f} rows/s; "
-        f"pipeline/limit ratio: "
-        f"{pipe_dt and min(bridge_dt, compute_dt) / pipe_dt:.2f}",
-        file=sys.stderr,
+        bridge_rows_per_sec=round(BATCH_ROWS / bridge_dt, 1),
+        compute_rows_per_sec=round(BATCH_ROWS / compute_dt, 1),
+        # host→device below PCIe-class ⇒ a tunnel sits in the path and the
+        # pipeline number is not an architecture measurement. Only judged
+        # when the probe transfer is big enough (≥16 MB) to amortize the
+        # fixed sync round-trip — tiny smoke batches would false-positive.
+        tunneled=bool(BATCH_ROWS * D * 4 >= (1 << 24) and transfer_bps < 1e9),
     )
 
 

@@ -24,8 +24,6 @@ import sys
 if __package__ in (None, ""):  # direct script run: python benchmarks/bench_*.py
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import json
-
 import numpy as np
 
 D = int(os.environ.get("SRML_BENCH_D", 768))
@@ -126,18 +124,16 @@ def main() -> None:
     # rivals a single call's cost) out of the reported per-call rate.
     reps = int(os.environ.get("SRML_BENCH_REPS", 8))
     dt = slope_dt(run, reps, 3 * reps)
+    from benchmarks import emit
+
     qps = N_QUERY / dt / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}"
-                          f"_k{K}_nprobe{NPROBE}_clustered",
-                "value": round(qps, 4),
-                "unit": "queries/s/chip",
-                "vs_baseline": round(qps / A100_QUERIES_PER_SEC, 4),
-                "recall_at_10": round(recall, 4),
-            }
-        )
+    emit(
+        f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}"
+        f"_k{K}_nprobe{NPROBE}_clustered",
+        qps,
+        "queries/s/chip",
+        qps / A100_QUERIES_PER_SEC,
+        recall_at_10=round(recall, 4),
     )
 
 

@@ -76,10 +76,10 @@ _DEFAULTS: Dict[str, Any] = {
     "ann_shortlist_mult": _env("ANN_SHORTLIST_MULT", 2, int),
     # IVF bucketed-query exact rerank: re-score the 2·mult·k shortlist from
     # the raw f32 rows. Skipping it ("off") answers straight from the
-    # residual-identity scores — measured +25-30% q/s for <0.01 recall@10
-    # on clustered 768-d data (the gather of (q, R, d) raw rows is the
-    # single most expensive post-scan op). Keep "on" when bf16 score noise
-    # matters more than throughput (tight margins, tiny d).
+    # residual-identity scores — measured 1.3–1.8× q/s for 0.005–0.017
+    # recall@10 (1.8× / −0.017 at the clustered 768-d bench shape; the
+    # (q, R, d) raw-row gather is the single most expensive post-scan op).
+    # Keep "on" when bf16 score noise matters more than throughput.
     "ann_rerank": _env("ANN_RERANK", True, lambda v: str(v).lower() not in ("0", "false", "off")),
 }
 

@@ -283,9 +283,31 @@ def _balance_assignments(cand: np.ndarray, nlist: int, cap: int) -> np.ndarray:
             break
     if pending.size:
         spare = np.maximum(cap - load, 0)
-        slots = np.repeat(np.arange(nlist), spare)
+        order = np.argsort(-spare, kind="stable")  # least-loaded lists first
+        slots = np.repeat(order, spare[order])
         assign[pending] = slots[: pending.size]
     return assign
+
+
+def _ivf_cap(n: int, nlist: int) -> int:
+    """Per-list row capacity: load-factor × mean, floored so cap·nlist ≥ n."""
+    return max(int(np.ceil(IVF_MAX_LOAD_FACTOR * n / nlist)), -(-n // nlist))
+
+
+def _balanced_refine(get_cand, recenter, nlist: int, cap: int, rounds: int = 3):
+    """Balanced-Lloyd refinement shared by the host and device builders:
+    alternate capacity-greedy assignment with centroid recomputation FROM
+    the balanced assignment. The recentering is what keeps recall: plain
+    spill leaves a hot centroid mid-mega-cluster and scatters its overflow
+    to far lists, while a recentred quantizer moves centroids toward their
+    bounded share of the data, so spill targets become genuinely near rows
+    (balanced k-means). ``get_cand()`` → (n, T) preference-ordered
+    candidates for the CURRENT centroids; ``recenter(assign)`` updates the
+    builder's centroids. Returns the final balanced (n,) assignment."""
+    for _ in range(rounds):
+        assign = _balance_assignments(np.asarray(get_cand()), nlist, cap)
+        recenter(assign)
+    return _balance_assignments(np.asarray(get_cand()), nlist, cap)
 
 
 def build_ivf_flat(
@@ -380,17 +402,15 @@ def build_ivf_flat(
 
     assign = _chunked(_argmin_chunk, 1).astype(np.int64)
     counts = np.bincount(assign, minlength=nlist)
-    cap = max(int(np.ceil(IVF_MAX_LOAD_FACTOR * n / nlist)), -(-n // nlist))
+    cap = _ivf_cap(n, nlist)
     if int(counts.max()) > cap:
-        # Balanced-Lloyd refinement (see build_ivf_flat_device): recentring
-        # from the balanced assignment is what keeps recall — plain spill
-        # scatters a hot list's overflow to far lists.
-        for _ in range(3):
-            cand = _chunked(_cand_chunk, T)
-            assign = _balance_assignments(cand, nlist, cap)
-            cdev = _recenter(assign, cdev)
-        cand = _chunked(_cand_chunk, T)
-        assign = _balance_assignments(cand, nlist, cap)
+        def _recenter_cb(assign_np):
+            nonlocal cdev
+            cdev = _recenter(assign_np, cdev)
+
+        assign = _balanced_refine(
+            lambda: _chunked(_cand_chunk, T), _recenter_cb, nlist, cap
+        )
         counts = np.bincount(assign, minlength=nlist)
         centroids = np.asarray(jax.device_get(cdev), dtype=centroids.dtype)
     maxlen = max(int(counts.max()), 1)
@@ -521,23 +541,18 @@ def build_ivf_flat_device(
     assign = _chunked(_argmin_chunk, centroids)
     counts = jnp.zeros((nlist,), jnp.int32).at[assign].add(1)
     natural_max = int(jax.device_get(counts.max()))
-    cap = max(int(np.ceil(IVF_MAX_LOAD_FACTOR * n / nlist)), -(-n // nlist))
+    cap = _ivf_cap(n, nlist)
     if natural_max > cap:
-        # BALANCED-LLOYD refinement: capacity-greedy assignment (host; the
-        # (n, T) int32 round-trip is tiny next to the index) followed by
-        # centroid recomputation from the balanced assignment. The
-        # recentering is what keeps recall: a plain spill leaves the hot
-        # centroid mid-mega-cluster and scatters its overflow to far
-        # lists, while a recentred quantizer MOVES centroids toward their
-        # bounded share of the data, so spill targets become genuinely
-        # near rows that land in them (balanced k-means).
-        cand = _chunked(_cand_chunk, centroids)
-        for _ in range(3):
-            assign_np = _balance_assignments(np.asarray(cand), nlist, cap)
-            assign = jnp.asarray(assign_np, jnp.int32)
-            centroids = _recenter(assign, centroids)
-            cand = _chunked(_cand_chunk, centroids)
-        assign_np = _balance_assignments(np.asarray(cand), nlist, cap)
+        # Balanced-Lloyd refinement (_balanced_refine); the (n, T) int32
+        # candidate round-trip to the host balancer is tiny next to the
+        # index.
+        def _recenter_cb(assign_np):
+            nonlocal centroids
+            centroids = _recenter(jnp.asarray(assign_np, jnp.int32), centroids)
+
+        assign_np = _balanced_refine(
+            lambda: _chunked(_cand_chunk, centroids), _recenter_cb, nlist, cap
+        )
         assign = jnp.asarray(assign_np, jnp.int32)
         counts = jnp.zeros((nlist,), jnp.int32).at[assign].add(1)
         maxlen = max(int(jax.device_get(counts.max())), 1)
@@ -772,15 +787,18 @@ def _bucketed_core(
     if not rerank:
         # Residual-identity scores ARE comparable across lists (the probe
         # term was added above); answering from them skips the (q, R, d)
-        # raw-row gather — the most expensive post-scan op (+25-30% q/s
-        # for <0.01 recall@10 measured on clustered 768-d, config
-        # ann_rerank).
+        # raw-row gather — the most expensive post-scan op (1.3-1.8x q/s
+        # for 0.005-0.017 recall@10; 1.8x / -0.017 measured at the
+        # clustered 768-d bench shape — config ann_rerank).
         neg, pos = jax.lax.top_k(-cand_d, k)
         wl = jnp.take_along_axis(cand_list, pos, axis=1)
         wp = jnp.take_along_axis(cand_pos, pos, axis=1)
         ids_k = ids_p[wl, wp]
-        win_ids = jnp.where(jnp.isinf(neg), -1, ids_k)
-        return jnp.maximum(-neg, 0.0), win_ids
+        # Padded-row candidates carry the finite r2 sentinel (~1e30), not
+        # inf — map them to the documented (+inf, -1) missing contract.
+        missing = jnp.isinf(neg) | (ids_k < 0)
+        win_ids = jnp.where(missing, -1, ids_k)
+        return jnp.where(missing, jnp.inf, jnp.maximum(-neg, 0.0)), win_ids
     # Exact rerank (the ScaNN two-stage): select a 2·mult·k-wide shortlist
     # by approximate score, rescore exactly in f32 from the stored rows.
     R = min(2 * shortlist_mult * k, nprobe * blk_k)

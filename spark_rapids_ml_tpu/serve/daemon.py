@@ -528,7 +528,20 @@ class DataPlaneDaemon:
                     # were accepted, so the job must survive (reinsert).
                     if now - job.touched <= self._ttl:
                         with self._jobs_lock:
-                            self._jobs.setdefault(name, job)
+                            cur = self._jobs.setdefault(name, job)
+                        if cur is not job:
+                            # A feed recreated the name in the window; the
+                            # old job's state cannot be merged into the
+                            # new one — poison it LOUDLY so late feeds /
+                            # finalize on it error instead of silently
+                            # diverging from the fresh job.
+                            job.dropped = True
+                            logger.error(
+                                "job %r was recreated while the reaper held "
+                                "its evicted predecessor; %d previously-fed "
+                                "rows are lost — finalize will see only the "
+                                "new job's rows", name, job.rows,
+                            )
                         continue
                     job.dropped = True
                 logger.warning(
