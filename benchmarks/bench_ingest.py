@@ -41,7 +41,7 @@ A100_H2D_ROWS_PER_SEC = 20e9 / (D * 4)
 
 
 def main() -> None:
-    from benchmarks import setup_platform, sync
+    from benchmarks import emit, setup_platform, sync
 
     setup_platform()
     import jax
@@ -115,8 +115,6 @@ def main() -> None:
     sync(xt)
     transfer_dt = time.perf_counter() - t0
     transfer_bps = BATCH_ROWS * D * 4 / transfer_dt
-
-    from benchmarks import emit
 
     pipeline_rate = BATCH_ROWS / pipe_dt
     emit(

@@ -38,7 +38,7 @@ A100_QUERIES_PER_SEC = 2e5
 
 
 def main() -> None:
-    from benchmarks import setup_platform
+    from benchmarks import emit, setup_platform
 
     setup_platform()
     import jax
@@ -124,8 +124,6 @@ def main() -> None:
     # rivals a single call's cost) out of the reported per-call rate.
     reps = int(os.environ.get("SRML_BENCH_REPS", 8))
     dt = slope_dt(run, reps, 3 * reps)
-    from benchmarks import emit
-
     qps = N_QUERY / dt / n_chips
     emit(
         f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}"

@@ -45,3 +45,24 @@ def load_state(path: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
         meta = json.loads(bytes(z["__meta__"]).decode())
     return arrays, meta
+
+
+def require_consistent_visibility(restored) -> None:
+    """Multi-host guard: every process must see the same restored-or-not
+    state, or the lockstep scans desync — a checkpoint visible on some
+    hosts but not others means checkpoint_path is not on a shared
+    filesystem. No-op single-process. Raises identically on all hosts."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils as mhu
+
+    flags = np.asarray(
+        mhu.process_allgather(np.asarray([int(restored is not None)]))
+    )
+    if flags.any() != flags.all():
+        raise RuntimeError(
+            "checkpoint visible on some hosts but not others; "
+            "checkpoint_path must be on a shared filesystem"
+        )

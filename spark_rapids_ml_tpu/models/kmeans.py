@@ -445,11 +445,18 @@ def fit_kmeans_stream(
     With ``checkpoint_path``, centers are persisted after every iteration
     and an interrupted fit resumes at the saved iteration (the
     preemption-safety gap noted in SURVEY.md §5 "failure detection").
+
+    **Multi-host** (``jax.process_count() > 1``): ``batch_source`` yields
+    THIS process's local stream; scans run in lockstep
+    (``lockstep_batches`` — uneven stream lengths are fine) and the init
+    sample is assembled from every host's stream head (allgathered, f32),
+    so all processes compute identical centers. Checkpoints are written
+    by process 0 and must be on a shared filesystem to resume.
     """
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
-    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
+    from spark_rapids_ml_tpu.parallel.sharding import lockstep_batches
 
-    require_single_process("fit_kmeans_stream (per-batch scans are host-driven)")
+    multiproc = jax.process_count() > 1
     if k <= 0:
         raise ValueError(f"k = {k} must be > 0")
     if init not in ("k-means++", "random"):
@@ -462,6 +469,8 @@ def fit_kmeans_stream(
     start_iter = 0
     centers = None
     restored = ckpt.load_state(checkpoint_path) if checkpoint_path else None
+    if checkpoint_path:
+        ckpt.require_consistent_visibility(restored)
     if restored is not None:
         arrays, meta = restored
         if meta.get("n_cols") != n_cols or meta.get("k") != k:
@@ -472,18 +481,43 @@ def fit_kmeans_stream(
         centers = np.asarray(arrays["centers"])
         start_iter = int(meta["it"])
     if centers is None:
-        # Init on a bounded host sample drawn from the stream's head.
+        # Init on a bounded host sample drawn from the stream's head —
+        # multi-host: every host contributes its share and the allgathered
+        # global sample makes all processes compute IDENTICAL centers.
         rng = np.random.default_rng(seed)
+        per = (
+            -(-init_sample_rows // jax.process_count())
+            if multiproc
+            else init_sample_rows
+        )
         head = []
         got = 0
         for batch in batch_source():
             head.append(np.asarray(batch))
             got += head[-1].shape[0]
-            if got >= init_sample_rows:
+            if got >= per:
                 break
-        if not head:
+        local = (
+            np.concatenate(head)[:per].astype(np.float32)
+            if head
+            else np.zeros((0, n_cols), np.float32)
+        )
+        if multiproc:
+            from jax.experimental import multihost_utils as mhu
+
+            counts = np.asarray(
+                mhu.process_allgather(np.asarray([local.shape[0]]))
+            ).reshape(-1)
+            buf = np.zeros((per, n_cols), np.float32)
+            buf[: local.shape[0]] = local
+            gathered = np.asarray(mhu.process_allgather(buf))
+            sample = np.concatenate(
+                [gathered[p, : counts[p]] for p in range(len(counts))]
+            )
+        else:
+            sample = local
+        if sample.shape[0] == 0:
             raise ValueError("batch_source yielded no batches")
-        sample = np.concatenate(head)[:init_sample_rows]
         if k > sample.shape[0]:
             raise ValueError(
                 f"k = {k} exceeds the {sample.shape[0]}-row init sample; "
@@ -499,9 +533,10 @@ def fit_kmeans_stream(
     def scan(centers_dev):
         state = stream_zero_state(k, n_cols, accum_dtype)
         n_rows = 0
-        for batch in batch_source():
+        for batch in lockstep_batches(batch_source(), n_cols):
             # shard_rows pads, casts f64→f32 via the threaded native bridge
-            # (halving host→device bytes for f64 sources), and places.
+            # (halving host→device bytes for f64 sources), and places;
+            # multi-process it assembles the global array from local rows.
             xs, ms, n_b = shard_rows(np.asarray(batch), mesh, dtype=np.float32)
             n_rows += n_b
             state = update(state, centers_dev, xs, ms)
@@ -516,7 +551,7 @@ def fit_kmeans_stream(
             centers_dev, moved2 = apply_lloyd_update(sums, counts, centers_dev)
             moved2 = float(moved2)
             n_iter = it + 1
-            if checkpoint_path:
+            if checkpoint_path and (not multiproc or jax.process_index() == 0):
                 ckpt.save_state(
                     checkpoint_path,
                     {"centers": np.asarray(jax.device_get(centers_dev))},
@@ -526,7 +561,7 @@ def fit_kmeans_stream(
                 break
         # Exact cost at the final centers (one cost-only scan).
         (_, _, cost), n_true = scan(centers_dev)
-    if checkpoint_path:
+    if checkpoint_path and (not multiproc or jax.process_index() == 0):
         import os
 
         if os.path.exists(checkpoint_path):

@@ -602,11 +602,16 @@ def fit_logistic_stream(
 
     With ``checkpoint_path``, (w, b) persist after every iteration and an
     interrupted fit resumes at the saved iteration.
+
+    **Multi-host**: ``batch_source`` yields this process's local (x, y)
+    stream; scans run in lockstep (``lockstep_labeled_batches`` — uneven
+    lengths fine, label validation propagates collectively). Checkpoints
+    are written by process 0 (shared filesystem to resume).
     """
     from spark_rapids_ml_tpu.core import checkpoint as ckpt
-    from spark_rapids_ml_tpu.parallel.sharding import require_single_process
+    from spark_rapids_ml_tpu.parallel.sharding import lockstep_labeled_batches
 
-    require_single_process("fit_logistic_stream (per-batch scans are host-driven)")
+    multiproc = jax.process_count() > 1
     mesh = mesh or default_mesh()
     ad = config.get("accum_dtype")
     accum = jnp.dtype(ad)
@@ -617,6 +622,8 @@ def fit_logistic_stream(
     b = jnp.zeros((), accum)
     start_iter = 0
     restored = ckpt.load_state(checkpoint_path) if checkpoint_path else None
+    if checkpoint_path:
+        ckpt.require_consistent_visibility(restored)
     if restored is not None:
         arrays, meta = restored
         if meta.get("n_cols") != n_cols:
@@ -630,19 +637,27 @@ def fit_logistic_stream(
 
     labels_checked = False
 
+    def _check_labels(_x, y):
+        if labels_checked:  # first scan only — data is fixed across scans
+            return None
+        try:
+            validate_binary_labels(y)
+        except ValueError as e:
+            return str(e)
+        return None
+
     def scan(w_dev, b_dev):
         nonlocal labels_checked
         state = stream_zero_state(n_cols, accum)
         n_rows = 0
-        for xb_host, yb_host in batch_source():
-            yb_host = np.asarray(yb_host).reshape(-1)
-            if not labels_checked:  # first scan only — data is fixed across scans
-                validate_binary_labels(yb_host)
-            n_rows += yb_host.shape[0]
+        for xb_host, yb_host in lockstep_labeled_batches(
+            batch_source(), n_cols, check=_check_labels
+        ):
             # shard_rows pads, casts f64→f32 via the threaded native bridge,
-            # and places row-sharded.
-            xs, ms, _ = shard_rows(np.asarray(xb_host), mesh, dtype=np.float32)
+            # and places row-sharded (global assembly when multi-process).
+            xs, ms, n_b = shard_rows(np.asarray(xb_host), mesh, dtype=np.float32)
             ys, _, _ = shard_rows(yb_host.astype(np.float32), mesh)
+            n_rows += n_b
             state = update(state, w_dev, b_dev, xs, ys, ms)
         labels_checked = True
         return state, n_rows
@@ -657,7 +672,7 @@ def fit_logistic_stream(
             loss = stream_objective(lsum, n, reg, w)
             w, b, delta = newton_step(gw, gb, hww, hwb, hbb, n, w, b)
             n_iter = it + 1
-            if checkpoint_path:
+            if checkpoint_path and (not multiproc or jax.process_index() == 0):
                 ckpt.save_state(
                     checkpoint_path,
                     {
@@ -673,7 +688,7 @@ def fit_logistic_stream(
             # restored iterate once for a faithful (n_rows, loss).
             (_, _, _, _, _, lsum, n), n_true = scan(w, b)
             loss = stream_objective(lsum, n, reg, w)
-    if checkpoint_path:
+    if checkpoint_path and (not multiproc or jax.process_index() == 0):
         import os
 
         if os.path.exists(checkpoint_path):

@@ -112,55 +112,77 @@ def lockstep_batches(batches, n_cols: int):
 
     The multi-host face of the streaming fits (fit_pca_stream etc.) —
     with it, the 100M×2048 north-star config streams on a v5e-16 pod with
-    each host reading only its own shard of the dataset.
+    each host reading only its own shard of the dataset. Thin wrapper
+    over :func:`lockstep_labeled_batches` (one core loop, no drift).
+    """
+    _dummy_y = np.zeros((0,), np.float32)
+    for x, _ in lockstep_labeled_batches(
+        ((b, _dummy_y) for b in batches), n_cols
+    ):
+        yield x
+
+
+def lockstep_labeled_batches(batches, n_cols: int, check=None):
+    """``lockstep_batches`` for (x, y) pair streams (linreg/logreg scans).
+
+    ``check(x, y)`` — optional per-batch validator returning an error
+    string or None; a failure is carried THROUGH the allgather so every
+    process raises the same error together instead of one host dying
+    locally while the rest hang in the next collective.
     """
     if jax.process_count() == 1:
-        for batch in batches:
-            yield np.asarray(batch)
+        for x, y in batches:
+            x, y = np.asarray(x), np.asarray(y).reshape(-1)
+            if check is not None:
+                err = check(x, y)
+                if err:
+                    raise ValueError(err)
+            yield x, y
         return
     from jax.experimental import multihost_utils as mhu
 
-    # Filler batches must match the feeding hosts' dtype or the per-process
-    # jitted updates diverge (SPMD mismatch) — ride a dtype code on the
-    # same allgather as the has-batch flag and adopt the consensus.
     codes = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
              np.dtype(np.float16): 2}
     rev = {v: k for k, v in codes.items()}
     it = iter(batches)
     while True:
-        batch = next(it, None)
-        code = -1
-        if batch is not None:
-            batch = np.asarray(batch)
-            # A bad dtype must NOT raise before the allgather — the other
-            # hosts would already be inside the collective and hang. Ride
-            # an invalid-marker through it and raise on ALL hosts after.
-            code = codes.get(batch.dtype, -2)
+        pair = next(it, None)
+        code, ok = -1, 1
+        if pair is not None:
+            x, y = np.asarray(pair[0]), np.asarray(pair[1]).reshape(-1)
+            code = codes.get(x.dtype, -2)
+            if check is not None and check(x, y):
+                ok = 0
         flags = np.asarray(mhu.process_allgather(np.asarray([
-            0 if batch is None else 1, code,
-        ]))).reshape(-1, 2)
+            0 if pair is None else 1, code, ok,
+        ]))).reshape(-1, 3)
+        if (flags[:, 2] == 0).any():
+            bad = int(np.argmax(flags[:, 2] == 0))
+            # Re-derive the local message when this host is the bad one.
+            msg = (check(x, y) if pair is not None and ok == 0 else None)
+            raise ValueError(
+                msg or f"batch validation failed on process {bad}"
+            )
         if (flags[:, 1] == -2).any():
             bad = int(np.argmax(flags[:, 1] == -2))
             raise TypeError(
-                f"lockstep_batches: process {bad} supplied an unsupported "
-                "batch dtype (expected float16/32/64)"
+                f"lockstep: process {bad} supplied an unsupported batch "
+                "dtype (expected float16/32/64)"
             )
         live = flags[flags[:, 0] == 1, 1]
         if live.size and live.min() != live.max():
-            # Two live hosts feeding different dtypes would trace different
-            # SPMD programs — raise identically on every host instead of
-            # hanging in a diverged collective.
             raise TypeError(
-                "lockstep_batches: feeding hosts disagree on batch dtype "
-                f"(codes {sorted(set(int(v) for v in live))}); make every "
-                "host's loader produce the same dtype"
+                "lockstep: feeding hosts disagree on batch dtype; make "
+                "every host's loader produce the same dtype"
             )
         if not flags[:, 0].any():
             return
-        if batch is None:
+        if pair is None:
             consensus = int(flags[flags[:, 0] == 1, 1].max())
-            batch = np.zeros((0, n_cols), dtype=rev[consensus])
-        yield batch
+            yield (np.zeros((0, n_cols), rev[consensus]),
+                   np.zeros((0,), np.float32))
+        else:
+            yield x, y
 
 
 def require_single_process(feature: str) -> None:

@@ -405,6 +405,16 @@ class _Job:
                 "intercept": np.asarray(jax.device_get(self.b)).reshape(1),
                 "n_iter": np.asarray([self.iteration]),
             }
+        if self.algo == "pca" and params.get("raw_moments"):
+            # Raw accumulated moments, no eigensolve — a StandardScaler
+            # fit is a strict subset of the PCA statistics (count, Σx,
+            # diag XᵀX), so scaler fits ride the pca job protocol.
+            count, colsum, g = jax.device_get(self.state)
+            return {
+                "count": np.asarray([float(count)]),
+                "colsum": np.asarray(colsum),
+                "gram_diag": np.diagonal(np.asarray(g)).copy(),
+            }
         if self.algo == "pca":
             from spark_rapids_ml_tpu.models.pca import finalize_pca_stats
 

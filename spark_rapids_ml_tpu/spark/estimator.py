@@ -209,6 +209,9 @@ class _SparkAdapter:
         118-139) with the daemon replacing the JVM tree-reduce."""
         core = self._core
         algo = self._daemon_algo
+        # A scaler fit is a strict subset of the pca job's statistics —
+        # it feeds the pca protocol and finalizes raw moments.
+        wire_algo = "pca" if algo == "scaler" else algo
         spark = getattr(df, "sparkSession", None)
         host, port, token = daemon_session.resolve(spark)
         job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
@@ -247,13 +250,29 @@ class _SparkAdapter:
 
             def run_pass(pass_id):
                 fn = _FeedTask(
-                    host, port, token, job, algo, input_col,
+                    host, port, token, job, wire_algo, input_col,
                     label_col or "label", feed_params, pass_id,
                 )
                 acks = sel.mapInArrow(fn, "partition int, rows long").collect()
                 return sum(r["rows"] for r in acks)
 
-            if algo == "pca":
+            if algo == "scaler":
+                n = run_pass(None)
+                if n == 0:
+                    raise ValueError("cannot fit on an empty DataFrame")
+                arrays, _ = client.finalize(job, {"raw_moments": True})
+                from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
+
+                cnt = float(arrays["count"][0])
+                mean = np.asarray(arrays["colsum"], np.float64) / cnt
+                var = (
+                    np.asarray(arrays["gram_diag"], np.float64)
+                    - cnt * mean * mean
+                ) / max(cnt - 1.0, 1.0)
+                model = StandardScalerModel(
+                    mean=mean, std=np.sqrt(np.maximum(var, 0.0))
+                )
+            elif algo == "pca":
                 n = run_pass(None)
                 if n == 0:
                     raise ValueError("cannot fit on an empty DataFrame")
@@ -474,4 +493,5 @@ SparkApproximateNearestNeighbors = _make_wrapper(
 SparkStandardScaler = _make_wrapper(
     "SparkStandardScaler", _StandardScaler,
     "StandardScaler over PySpark DataFrames (ArrayType features column).",
+    daemon_algo="scaler",
 )

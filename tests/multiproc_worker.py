@@ -60,6 +60,29 @@ def main() -> None:
     stream = np.array_split(local, n_batches)
     ssol = fit_pca_stream(iter(stream), k=k, n_cols=d, mesh=mesh)
 
+    # Multi-host STREAMED KMeans: local streams with uneven batch counts;
+    # allgathered init sample makes every process compute the same centers.
+    from spark_rapids_ml_tpu.models.kmeans import fit_kmeans_stream
+
+    ksol = fit_kmeans_stream(
+        lambda: iter(np.array_split(local.astype(np.float32), n_batches)),
+        k=3, n_cols=d, max_iter=5, seed=0,
+    )
+
+    # Multi-host STREAMED LogReg: local (x, y) streams in lockstep.
+    from spark_rapids_ml_tpu.models.logistic_regression import fit_logistic_stream
+
+    w_true = np.linspace(-1, 1, d)
+    y = (x @ w_true > 0).astype(np.float64)
+    ylocal = y[lo:hi]
+
+    def labeled():
+        xs = np.array_split(local.astype(np.float32), n_batches)
+        ys = np.array_split(ylocal, n_batches)
+        return iter(zip(xs, ys))
+
+    lsol = fit_logistic_stream(labeled, n_cols=d, reg=1e-3, max_iter=8)
+
     # Exact KNN: each process indexes its local slice; queries identical
     # everywhere; returned ids are global row positions.
     from spark_rapids_ml_tpu.models.knn import NearestNeighbors
@@ -77,6 +100,10 @@ def main() -> None:
                     "n_rows": sol.n_rows,
                     "stream_pc": np.asarray(ssol.pc).tolist(),
                     "stream_n_rows": ssol.n_rows,
+                    "kmeans_centers": np.asarray(ksol.centers).tolist(),
+                    "kmeans_n_rows": ksol.n_rows,
+                    "logreg_coef": np.asarray(lsol.coefficients).tolist(),
+                    "logreg_n_rows": lsol.n_rows,
                     "knn_idx": np.asarray(idx).tolist(),
                     "knn_d": np.asarray(dists).tolist(),
                 }

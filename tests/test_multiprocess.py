@@ -79,6 +79,19 @@ def test_two_process_pca_matches_single_process():
         np.abs(np.asarray(result["stream_pc"])), np.abs(ref.pc), atol=1e-8
     )
 
+    # Multi-host streamed KMeans / LogReg: full-row coverage and sane fits
+    # (exact-match oracles live in the single-process stream tests; here
+    # the property is that the lockstep multi-host scans converge on the
+    # same data they were given).
+    assert result["kmeans_n_rows"] == 603
+    assert np.asarray(result["kmeans_centers"]).shape == (3, 16)
+    assert result["logreg_n_rows"] == 603
+    w_true = np.linspace(-1, 1, 16)
+    coef = np.asarray(result["logreg_coef"])
+    # learned direction correlates strongly with the generating weights
+    cos = coef @ w_true / (np.linalg.norm(coef) * np.linalg.norm(w_true))
+    assert cos > 0.9
+
     # Exact KNN across processes: global ids must match a single-process
     # model over the full database.
     from spark_rapids_ml_tpu.models.knn import NearestNeighbors

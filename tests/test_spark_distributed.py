@@ -166,6 +166,24 @@ def test_spark_transform_map_in_arrow_no_collect(pca_data, mesh8):
     np.testing.assert_allclose(np.abs(got), np.abs(want), atol=1e-6)
 
 
+def test_spark_scaler_fit_distributed_matches_core(rng, mesh8):
+    from spark_rapids_ml_tpu.models.scaler import StandardScaler
+    from spark_rapids_ml_tpu.spark.estimator import SparkStandardScaler
+
+    n, d = 700, 9
+    x = (rng.normal(size=(n, d)) * np.logspace(0, 1, d) + 3.0).astype(np.float64)
+    df = simdf_from_numpy(x, n_partitions=4)
+    model = SparkStandardScaler().setWithMean(True).fit(df)
+    assert df.sparkSession.driver_rows_materialized == 0
+    ref = StandardScaler(mesh=mesh8).setWithMean(True).fit({"features": x})
+    np.testing.assert_allclose(model.mean, ref.mean, atol=1e-8)
+    np.testing.assert_allclose(model.std, ref.std, atol=1e-8)
+    out = model.transform(df).collect()
+    got = np.asarray([r["scaled_features"] for r in out])
+    want = (x - ref.mean) / np.where(ref.std > 0, ref.std, 1.0)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
 def test_spark_fit_empty_dataframe_raises(mesh8):
     df = simdf_from_numpy(np.zeros((0, 4)), n_partitions=1)
     with pytest.raises(ValueError, match="empty"):
