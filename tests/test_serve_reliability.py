@@ -277,3 +277,21 @@ def test_token_required_when_configured(mesh8, data):
 def test_no_token_daemon_ignores_client_token(daemon):
     with _client(daemon, token="anything") as c:
         assert c.ping()
+
+
+def test_raw_moments_finalize_for_scaler(daemon, data, mesh8):
+    """A scaler fit rides the pca job protocol: finalize with raw_moments
+    returns the accumulated (count, colsum, gram diagonal) without an
+    eigensolve — the moments SparkStandardScaler derives mean/std from."""
+    parts = np.array_split(data, 3)
+    with _client(daemon) as c:
+        for pid, part in enumerate(parts):
+            c.feed("sc", part, algo="pca", partition=pid)
+            c.commit("sc", partition=pid)
+        arrays, rows = c.finalize("sc", {"raw_moments": True})
+    assert rows == data.shape[0]
+    assert float(arrays["count"][0]) == data.shape[0]
+    np.testing.assert_allclose(arrays["colsum"], data.sum(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(
+        arrays["gram_diag"], (data * data).sum(axis=0), rtol=1e-10
+    )
