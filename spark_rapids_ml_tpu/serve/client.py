@@ -53,6 +53,7 @@ class DataPlaneClient:
 
     def _roundtrip(self, req: Dict[str, Any], payload: Optional[bytes] = None):
         sock = self._conn()
+        req = {"v": protocol.PROTOCOL_VERSION, **req}
         if self._token is not None:
             req = {**req, "token": self._token}
         protocol.send_json(sock, req)
@@ -68,7 +69,17 @@ class DataPlaneClient:
     # -- ops ---------------------------------------------------------------
 
     def ping(self) -> bool:
+        """Hello: liveness + version handshake. ``ping`` is the one
+        version-exempt op; the server echoes the protocol version it
+        speaks, and a mismatch raises here rather than on the first real
+        op (docs/protocol.md)."""
         resp, _ = self._roundtrip({"op": "ping"})
+        server_v = resp.get("v")
+        if server_v is not None and server_v != protocol.PROTOCOL_VERSION:
+            raise protocol.ProtocolError(
+                f"daemon speaks protocol v{server_v}; this client speaks "
+                f"v{protocol.PROTOCOL_VERSION}"
+            )
         return bool(resp["ok"])
 
     @staticmethod

@@ -60,6 +60,20 @@ from spark_rapids_ml_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.daemon")
 
+#: Ops whose request JSON is followed by one Arrow-IPC payload frame
+#: (docs/protocol.md). Rejection paths must drain that frame to keep the
+#: connection framing aligned.
+_PAYLOAD_OPS = ("feed", "seed")
+
+
+def _opt(req: Dict[str, Any], key: str, default):
+    """Optional request field: docs/protocol.md promises that omitted and
+    JSON null are equivalent, so a present-but-null field takes the
+    default too (a third-party client may serialize absent options as
+    null)."""
+    value = req.get(key)
+    return default if value is None else value
+
 
 class _Job:
     """One accumulation job: device state + its fold function + a lock."""
@@ -598,16 +612,32 @@ class DataPlaneDaemon:
                         return
 
     def _dispatch(self, conn, req: Dict[str, Any]) -> None:
+        op = req.get("op")
+
+        def _drain_payload():
+            # Keep the connection framing aligned for the error response:
+            # payload-carrying ops already have their payload frame in
+            # flight when the JSON header is rejected.
+            if op in _PAYLOAD_OPS:
+                protocol.recv_frame(conn)
+
+        # Auth first: an unauthenticated peer learns nothing (not even the
+        # protocol version) beyond "unauthorized". Constant-time compare.
         if self._token is not None and not hmac.compare_digest(
             str(req.get("token", "")), self._token
         ):
-            # Constant-time compare; drain the payload frame of
-            # payload-carrying ops so the connection framing stays aligned
-            # for the error.
-            if req.get("op") in ("feed", "seed"):
-                protocol.recv_frame(conn)
+            _drain_payload()
             raise PermissionError("unauthorized: bad or missing token")
-        op = req.get("op")
+        if op != "ping" and req.get("v") != protocol.PROTOCOL_VERSION:
+            # ping is version-exempt (it's the hello: clients discover the
+            # server version from its response before speaking further).
+            # Missing v is rejected too: the freeze starts at v1 and every
+            # conforming client declares its dialect (docs/protocol.md).
+            _drain_payload()
+            raise protocol.ProtocolError(
+                f"protocol version mismatch: server speaks v{protocol.PROTOCOL_VERSION}, "
+                f"request carried v={req.get('v')!r}; see docs/protocol.md"
+            )
         if op == "feed":
             self._op_feed(conn, req)
         elif op == "seed":
@@ -616,7 +646,7 @@ class DataPlaneDaemon:
             job = self._get_job(req)
             rows = job.commit(
                 int(req["partition"]),
-                int(req.get("attempt", 0)),
+                int(_opt(req, "attempt", 0)),
                 req.get("pass_id"),
             )
             protocol.send_json(conn, {"ok": True, "rows": rows})
@@ -624,7 +654,7 @@ class DataPlaneDaemon:
             self._op_finalize(conn, req)
         elif op == "step":
             job = self._get_job(req)
-            info = job.step(req.get("params", {}))
+            info = job.step(_opt(req, "params", {}))
             protocol.send_json(conn, {"ok": True, **info})
         elif op == "status":
             job = self._get_job(req)
@@ -639,7 +669,7 @@ class DataPlaneDaemon:
                     job.dropped = True
             protocol.send_json(conn, {"ok": True, "dropped": job is not None})
         elif op == "ping":
-            protocol.send_json(conn, {"ok": True})
+            protocol.send_json(conn, {"ok": True, "v": protocol.PROTOCOL_VERSION})
         else:
             raise ValueError(f"unknown op {op!r}")
 
@@ -661,15 +691,15 @@ class DataPlaneDaemon:
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         name = str(req["job"])
-        input_col = req.get("input_col", "features")
+        input_col = _opt(req, "input_col", "features")
         x = table_column_to_matrix(table, input_col, req.get("n_cols"))
-        req_algo = str(req.get("algo", "pca"))
+        req_algo = str(_opt(req, "algo", "pca"))
         # Validate the batch BEFORE registering a job, so a rejected first
         # feed doesn't leave an orphan empty job (with its d×d device
         # buffers) parked under the name forever.
         y = None
         if req_algo in ("linreg", "logreg"):
-            label_col = req.get("label_col", "label")
+            label_col = _opt(req, "label_col", "label")
             if label_col not in table.column_names:
                 raise KeyError(f"label column {label_col!r} not in batch")
             y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
@@ -706,7 +736,7 @@ class DataPlaneDaemon:
             x,
             y,
             partition=None if part is None else int(part),
-            attempt=int(req.get("attempt", 0)),
+            attempt=int(_opt(req, "attempt", 0)),
             pass_id=req.get("pass_id"),
         )
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
@@ -725,7 +755,7 @@ class DataPlaneDaemon:
             table = reader.read_all()
         name = str(req["job"])
         x = table_column_to_matrix(
-            table, req.get("input_col", "features"), req.get("n_cols")
+            table, _opt(req, "input_col", "features"), req.get("n_cols")
         )
         params = req.get("params") or {}
         k_req = int(params.get("k", 0))
@@ -741,8 +771,8 @@ class DataPlaneDaemon:
 
     def _op_finalize(self, conn, req: Dict[str, Any]) -> None:
         job = self._get_job(req)
-        drop = bool(req.get("drop", True))
-        arrays = job.finalize(req.get("params", {}), drop=drop)
+        drop = bool(_opt(req, "drop", True))
+        arrays = job.finalize(_opt(req, "params", {}), drop=drop)
         # Unregister BEFORE sending: if the client disconnects mid-response
         # the name must not stay poisoned (dropped=True) in _jobs forever.
         if drop:

@@ -5,6 +5,17 @@ one JSON frame, optionally followed by one Arrow IPC stream frame (op
 "feed"). A response is one JSON frame, optionally followed by raw-buffer
 frames for each array listed in the JSON's ``arrays`` spec (op
 "finalize"). Max frame size bounds a malformed/hostile length prefix.
+
+**The protocol is FROZEN at PROTOCOL_VERSION** (see ``docs/protocol.md``
+for the full op-by-op frame contract — the document third-party clients,
+e.g. a Scala/JVM implementation, build against). Every request carries a
+``"v"`` field; the daemon rejects mismatches with a message naming the
+version it speaks. ``ping`` is version-exempt and echoes the server
+version, so a client can discover it before committing to a dialect.
+Any change to frames, fields, or semantics of existing ops bumps the
+version; additive new ops keep it. ``tests/test_protocol_golden.py``
+replays a recorded v1 byte transcript against a live daemon — if that
+test fails, the frozen contract broke.
 """
 
 from __future__ import annotations
@@ -14,6 +25,11 @@ import struct
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+#: Frozen wire-protocol version. Bump ONLY on breaking changes to
+#: existing ops' frames or semantics; new ops are additive under the
+#: same version.
+PROTOCOL_VERSION = 1
 
 MAX_FRAME = 1 << 31  # 2 GB — one Spark partition's batch comfortably fits
 

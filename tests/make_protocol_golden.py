@@ -1,0 +1,165 @@
+"""Generate the FROZEN v1 wire-protocol transcript fixture.
+
+Writes ``tests/fixtures/protocol_v1.bin``: the exact client→daemon byte
+stream of one session exercising every v1 op (ping, feed eager, feed
+partitioned, commit, seed, step, status, finalize, drop). The committed
+fixture is the conformance artifact third-party clients (e.g. a JVM
+implementation, README "Scala interop") are tested against:
+``tests/test_protocol_golden.py`` replays these recorded bytes against a
+live daemon and asserts the responses — if the daemon stops accepting
+them, the frozen contract broke and PROTOCOL_VERSION must be bumped.
+
+Run ``python -m tests.make_protocol_golden`` ONLY when deliberately
+re-freezing (version bump); never regenerate to make a red test green.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "protocol_v1.bin")
+
+V = 1  # frozen: generator is pinned to v1, independent of the live code
+
+
+def golden_matrix() -> np.ndarray:
+    """8×3 deterministic data, two distinct 4-row partitions."""
+    rng = np.random.default_rng(20260731)
+    return rng.normal(size=(8, 3)).astype(np.float64)
+
+
+def _ipc_bytes(x: np.ndarray) -> bytes:
+    import pyarrow as pa
+
+    col = pa.FixedSizeListArray.from_arrays(
+        pa.array(np.ascontiguousarray(x).reshape(-1)), x.shape[1]
+    )
+    table = pa.table({"features": col})
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def frame_bytes(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+def transcript_frames() -> tuple[list, list]:
+    """Returns (request frames, per-request response expectations).
+
+    Each request frame is ("json", bytes) or ("arrow", bytes) — the kind
+    matters to the drift test: JSON frames are frozen byte-for-byte, Arrow
+    payload frames are frozen *semantically* (any valid Arrow IPC encoding
+    of the same table conforms; pyarrow version bumps may re-encode).
+    Each expectation is (kind, checks) where kind is "json" or "arrays"
+    and checks is a dict of response fields the replay asserts.
+    """
+    x = golden_matrix()
+    p0, p1 = x[:4], x[4:]
+    frames: list = []
+    expect: list = []
+
+    def _req(obj: dict, payload: bytes | None = None) -> None:
+        frames.append(("json", json.dumps(obj).encode()))
+        if payload is not None:
+            frames.append(("arrow", payload))
+
+    # 1. hello: version discovery (the one version-exempt op)
+    _req({"v": V, "op": "ping"})
+    expect.append(("json", {"ok": True, "v": V}))
+
+    # 2-3. eager feeds: two batches on one job, rows accumulate immediately
+    _req(
+        {"v": V, "op": "feed", "job": "g-eager", "algo": "pca",
+         "input_col": "features", "label_col": "label", "n_cols": None,
+         "params": {}, "partition": None, "attempt": 0, "pass_id": None},
+        _ipc_bytes(p0),
+    )
+    expect.append(("json", {"ok": True, "rows": 4}))
+    _req(
+        {"v": V, "op": "feed", "job": "g-eager", "algo": "pca",
+         "input_col": "features", "label_col": "label", "n_cols": None,
+         "params": {}, "partition": None, "attempt": 0, "pass_id": None},
+        _ipc_bytes(p1),
+    )
+    expect.append(("json", {"ok": True, "rows": 8}))
+
+    # 4-7. partitioned exactly-once path: feed→commit per partition;
+    # rows count only after commit
+    for pid, part, rows_after in ((0, p0, 4), (1, p1, 8)):
+        _req(
+            {"v": V, "op": "feed", "job": "g-part", "algo": "pca",
+             "input_col": "features", "label_col": "label", "n_cols": None,
+             "params": {}, "partition": pid, "attempt": 0, "pass_id": None},
+            _ipc_bytes(part),
+        )
+        expect.append(("json", {"ok": True}))
+        _req({"v": V, "op": "commit", "job": "g-part",
+                   "partition": pid, "attempt": 0, "pass_id": None})
+        expect.append(("json", {"ok": True, "rows": rows_after}))
+
+    # 8. status
+    _req({"v": V, "op": "status", "job": "g-part"})
+    expect.append(("json", {"ok": True, "rows": 8, "algo": "pca", "n_cols": 3}))
+
+    # 9-10. finalize both jobs (k=2); arrays follow the JSON header
+    for job in ("g-eager", "g-part"):
+        _req({"v": V, "op": "finalize", "job": job,
+                   "params": {"k": 2, "mean_center": True}, "drop": True})
+        expect.append(("arrays", {"ok": True, "rows": 8}))
+
+    # 11. kmeans seed: deterministic centers, rows NOT folded
+    _req(
+        {"v": V, "op": "seed", "job": "g-km", "input_col": "features",
+         "n_cols": None, "params": {"k": 2, "seed": 7, "init": "k-means++"}},
+        _ipc_bytes(x),
+    )
+    expect.append(("json", {"ok": True, "rows": 0}))
+
+    # 12-17. two Lloyd passes: feed(pass_id)→commit→step
+    for pass_id in (0, 1):
+        _req(
+            {"v": V, "op": "feed", "job": "g-km", "algo": "kmeans",
+             "input_col": "features", "label_col": "label", "n_cols": None,
+             "params": {"k": 2, "seed": 7, "init": "k-means++"},
+             "partition": 0, "attempt": 0, "pass_id": pass_id},
+            _ipc_bytes(x),
+        )
+        expect.append(("json", {"ok": True}))
+        _req({"v": V, "op": "commit", "job": "g-km",
+                   "partition": 0, "attempt": 0, "pass_id": pass_id})
+        expect.append(("json", {"ok": True, "rows": 8 * (pass_id + 1)}))
+        _req({"v": V, "op": "step", "job": "g-km", "params": {}})
+        expect.append(("json", {"ok": True, "iteration": pass_id + 1}))
+
+    # 18. finalize kmeans without drop, then explicit drop
+    _req({"v": V, "op": "finalize", "job": "g-km", "params": {},
+               "drop": False})
+    expect.append(("arrays", {"ok": True}))
+    _req({"v": V, "op": "drop", "job": "g-km"})
+    expect.append(("json", {"ok": True, "dropped": True}))
+
+    return frames, expect
+
+
+def transcript() -> tuple[bytes, list]:
+    """(full request byte stream, response expectations)."""
+    frames, expect = transcript_frames()
+    return b"".join(frame_bytes(p) for _, p in frames), expect
+
+
+def main() -> None:
+    os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+    data, expect = transcript()
+    with open(FIXTURE, "wb") as f:
+        f.write(data)
+    print(f"wrote {FIXTURE}: {len(data)} bytes, {len(expect)} requests")
+
+
+if __name__ == "__main__":
+    main()

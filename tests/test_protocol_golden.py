@@ -1,0 +1,180 @@
+"""Frozen-protocol conformance: replay the recorded v1 byte transcript.
+
+The fixture ``fixtures/protocol_v1.bin`` is the exact byte stream a v1
+client emitted at freeze time (see ``make_protocol_golden.py``). These
+tests are the executable form of docs/protocol.md's compatibility
+promise: a third-party client built against the v1 frames keeps working.
+
+If a test here fails, the wire contract broke — either revert the
+breaking change or bump ``protocol.PROTOCOL_VERSION`` and re-freeze
+(``python -m tests.make_protocol_golden``) as a deliberate major change.
+"""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+from spark_rapids_ml_tpu.serve import protocol
+
+from tests.make_protocol_golden import FIXTURE, golden_matrix, transcript
+
+
+@pytest.fixture
+def daemon(mesh8):
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        yield d
+
+
+def test_fixture_is_committed():
+    assert os.path.exists(FIXTURE), (
+        "tests/fixtures/protocol_v1.bin is missing — it is a FROZEN "
+        "artifact and must be committed, not regenerated per-run"
+    )
+
+
+def test_generator_matches_committed_fixture():
+    """The in-repo generator and the committed bytes must agree frame by
+    frame; drift means someone edited the generator without re-freezing
+    (or vice versa). JSON frames are compared as parsed objects (key
+    order is not part of the contract) and Arrow payload frames
+    semantically (the contract requires *a valid Arrow IPC stream*, not
+    specific bytes — a pyarrow upgrade may legitimately re-encode)."""
+    import io
+    import json
+
+    import pyarrow as pa
+
+    from tests.make_protocol_golden import transcript_frames
+
+    frames, _ = transcript_frames()
+    with open(FIXTURE, "rb") as f:
+        committed = f.read()
+    stream = io.BytesIO(committed)
+
+    def next_committed_frame():
+        header = stream.read(4)
+        assert len(header) == 4, "fixture truncated"
+        (n,) = __import__("struct").unpack(">I", header)
+        payload = stream.read(n)
+        assert len(payload) == n, "fixture truncated mid-frame"
+        return payload
+
+    for kind, generated in frames:
+        recorded = next_committed_frame()
+        if kind == "json":
+            assert json.loads(generated) == json.loads(recorded)
+        else:
+            with pa.ipc.open_stream(generated) as r:
+                gen_table = r.read_all()
+            with pa.ipc.open_stream(recorded) as r:
+                rec_table = r.read_all()
+            assert gen_table.equals(rec_table)
+    assert stream.read() == b"", "fixture has extra frames"
+
+
+def test_replay_golden_transcript(daemon):
+    """Byte-replay the frozen session; assert every response."""
+    with open(FIXTURE, "rb") as f:
+        stream = f.read()
+    _, expect = transcript()
+
+    sock = socket.create_connection(daemon.address, timeout=60)
+    try:
+        sock.sendall(stream)
+        results = []
+        for kind, checks in expect:
+            resp = protocol.recv_json(sock)
+            assert resp is not None, "daemon closed mid-transcript"
+            for key, want in checks.items():
+                assert resp.get(key) == want, (
+                    f"response {resp} missing/mismatched {key}={want!r}"
+                )
+            if kind == "arrays":
+                results.append((protocol.recv_arrays(sock, resp), resp))
+    finally:
+        sock.close()
+
+    # Numeric conformance: the two PCA finalizes (eager vs partitioned
+    # exactly-once) must agree with each other and with the local oracle.
+    (eager, _), (part, _), (km, _) = results
+    x = golden_matrix()
+    xc = x - x.mean(axis=0)
+    evals, evecs = np.linalg.eigh(xc.T @ xc / (x.shape[0] - 1))
+    order = np.argsort(evals)[::-1]
+    pc_oracle = evecs[:, order[:2]]
+    for arrays in (eager, part):
+        assert arrays["pc"].shape == (3, 2)
+        np.testing.assert_allclose(
+            np.abs(arrays["pc"]), np.abs(pc_oracle), atol=1e-8
+        )
+    np.testing.assert_allclose(eager["pc"], part["pc"], atol=1e-12)
+    assert km["centers"].shape == (2, 3)
+    assert int(km["n_iter"][0]) == 2
+    assert np.isfinite(km["cost"][0])
+
+
+def test_version_mismatch_rejected_with_message(daemon):
+    sock = socket.create_connection(daemon.address, timeout=30)
+    try:
+        protocol.send_json(sock, {"v": 99, "op": "status", "job": "x"})
+        resp = protocol.recv_json(sock)
+        assert resp is not None and resp["ok"] is False
+        assert f"v{protocol.PROTOCOL_VERSION}" in resp["error"]
+        assert "protocol version mismatch" in resp["error"]
+    finally:
+        sock.close()
+
+
+def test_versionless_request_rejected(daemon):
+    sock = socket.create_connection(daemon.address, timeout=30)
+    try:
+        protocol.send_json(sock, {"op": "status", "job": "x"})
+        resp = protocol.recv_json(sock)
+        assert resp is not None and resp["ok"] is False
+        assert "protocol version mismatch" in resp["error"]
+    finally:
+        sock.close()
+
+
+def test_version_mismatch_with_payload_keeps_framing(daemon):
+    """A rejected feed must drain its payload frame so the connection
+    stays usable for the next (valid) request."""
+    from tests.make_protocol_golden import _ipc_bytes
+
+    sock = socket.create_connection(daemon.address, timeout=30)
+    try:
+        protocol.send_json(
+            sock, {"v": 99, "op": "feed", "job": "x", "algo": "pca"}
+        )
+        protocol.send_frame(sock, _ipc_bytes(golden_matrix()))
+        resp = protocol.recv_json(sock)
+        assert resp is not None and resp["ok"] is False
+        # connection still aligned: a valid ping succeeds on the same socket
+        protocol.send_json(sock, {"v": protocol.PROTOCOL_VERSION, "op": "ping"})
+        resp2 = protocol.recv_json(sock)
+        assert resp2 is not None and resp2["ok"] is True
+    finally:
+        sock.close()
+
+
+def test_ping_is_version_exempt_and_echoes_version(daemon):
+    sock = socket.create_connection(daemon.address, timeout=30)
+    try:
+        protocol.send_json(sock, {"op": "ping"})  # no v at all
+        resp = protocol.recv_json(sock)
+        assert resp == {"ok": True, "v": protocol.PROTOCOL_VERSION}
+    finally:
+        sock.close()
+
+
+def test_live_client_speaks_the_frozen_version(daemon):
+    """Today's DataPlaneClient must emit v1 requests the golden daemon
+    accepts — ties the library to the document."""
+    with DataPlaneClient(*daemon.address) as c:
+        assert c.ping()
+        c.feed("live", golden_matrix(), algo="pca")
+        arrays = c.finalize_pca("live", k=2)
+        assert arrays["pc"].shape == (3, 2)
