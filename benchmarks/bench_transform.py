@@ -74,12 +74,57 @@ def main() -> None:
     run(2 * CALLS)
     lat = [slope_dt(run, CALLS, 2 * CALLS, warm=False) * 1e3 for _ in range(9)]
     p50 = float(np.percentile(lat, 50))
+    daemon_extras = _daemon_serving_p50(rng)
     emit(
         f"pca_transform_p50_ms_batch{BATCH}_d{D}_k{K}_bf16",
         p50,
         "ms",
         BASELINE_P50_MS / p50,
+        **daemon_extras,
     )
+
+
+def _daemon_serving_p50(rng) -> dict:
+    """End-to-end daemon ``transform`` round-trip p50 (Arrow IPC over
+    loopback TCP + host→device + GEMM + device→host) — the path Spark
+    executors actually take (VERDICT r2 #1 asked for this number next to
+    the device-only p50).
+
+    Measured at a smaller batch than the device-only metric: on the dev
+    harness, host→device crosses the axon tunnel at single-digit MB/s, so
+    a 512 MB batch would measure the tunnel, not the serving stack. The
+    ``daemon_tunneled`` flag marks runs where that applies (same
+    heuristic as bench_ingest).
+    """
+    import time
+
+    from spark_rapids_ml_tpu.models.pca import PCAModel
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+    d_rows = int(os.environ.get("SRML_BENCH_DAEMON_ROWS", 4096))
+    model = PCAModel(
+        pc=rng.normal(size=(D, K)), mean=np.zeros(D),
+        explained_variance=np.ones(K) / K,
+    )
+    xb = rng.normal(size=(d_rows, D)).astype(np.float32)
+    with DataPlaneDaemon() as daemon:
+        with DataPlaneClient(*daemon.address) as c:
+            c.ensure_model("bench-pca", "pca", model._model_data())
+            c.transform("bench-pca", xb)  # warm: compile + device residency
+            lats = []
+            for _ in range(9):
+                t0 = time.perf_counter()
+                c.transform("bench-pca", xb)
+                lats.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(lats, 50))
+    # crude tunnel detection: a local host→device path moves this batch in
+    # well under a PCIe-class millisecond budget; the tunnel takes 100s of ms
+    bps = xb.nbytes / (p50 / 1e3)
+    return {
+        "daemon_p50_ms": round(p50, 3),
+        "daemon_batch_rows": d_rows,
+        "daemon_tunneled": bool(bps < 1e9),
+    }
 
 
 if __name__ == "__main__":

@@ -709,14 +709,19 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
         return self._predict_cache[key]
 
     def predict(self, x: np.ndarray) -> np.ndarray:
-        x = np.asarray(x)
-        from spark_rapids_ml_tpu.parallel.sharding import pad_rows
+        from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
 
-        n = x.shape[0]
-        bucket = max(256, 1 << (n - 1).bit_length()) if n else 256
-        xp, _ = pad_rows(x, bucket)
-        out = np.asarray(jax.device_get(self._predictor()(xp)))[:n]
-        return out
+        return run_bucketed(self._predictor(), x)
+
+    # Daemon serving contract (serve/daemon.py).
+    _serve_algo = "kmeans"
+    _serve_outputs = (("prediction", "predictionCol", "int"),)
+
+    def transform_matrix(self, x: np.ndarray) -> dict:
+        """Role-keyed device transform (daemon ``transform`` op surface)."""
+        if self.centers is None:
+            raise RuntimeError("KMeansModel has no centers (unfitted?)")
+        return {"prediction": self.predict(x)}
 
     def _transform(self, dataset):
         if self.centers is None:

@@ -417,8 +417,57 @@ class LinearRegressionModel(Model, _LinearRegressionParams, MLWritable, MLReadab
         x = np.asarray(x)
         return x @ self.coefficients + self.intercept
 
+    # Daemon serving contract (serve/daemon.py).
+    _serve_algo = "linreg"
+    _serve_outputs = (("prediction", "predictionCol", "double"),)
+
+    def _predictor(self):
+        """Jitted y = x @ w + b with coefficients device-resident (the
+        per-batch-upload fix of SURVEY.md §7(d), same pattern as
+        PCAModel._projector)."""
+        cache = getattr(self, "_predict_cache", None)
+        if cache is None:
+            cache = self._predict_cache = {}
+        from spark_rapids_ml_tpu import config
+
+        key = (config.get("compute_dtype"), config.get("accum_dtype"))
+        if key not in cache:
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+            w_dev = jnp.asarray(self.coefficients, dtype=jnp.dtype(key[0]))
+            accum = jnp.dtype(key[1])
+            b = float(self.intercept)
+
+            @jax.jit
+            def predict(x):
+                with mm_precision(w_dev.dtype):
+                    z = jax.lax.dot_general(
+                        x.astype(w_dev.dtype),
+                        w_dev.reshape(-1, 1),
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=accum,
+                    )
+                return z[:, 0] + b
+
+            cache[key] = predict
+        return cache[key]
+
+    def transform_matrix(self, x: np.ndarray) -> dict:
+        """Role-keyed device transform (daemon ``transform`` op surface)."""
+        if self.coefficients is None:
+            raise RuntimeError("model has no coefficients (unfitted?)")
+        from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
+
+        y = run_bucketed(self._predictor(), x)
+        return {"prediction": y.astype(np.float64)}
+
     def _transform(self, dataset):
         if self.coefficients is None:
             raise RuntimeError("model has no coefficients (unfitted?)")
         x = as_matrix(dataset, self.getFeaturesCol())
-        return with_column(dataset, self.getPredictionCol(), self.predict(x))
+        return with_column(
+            dataset, self.getPredictionCol(), self.transform_matrix(x)["prediction"]
+        )

@@ -852,6 +852,69 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.argmax(self.predict_proba(x), axis=1)
 
+    # Daemon serving contract (serve/daemon.py).
+    _serve_algo = "logreg"
+    _serve_outputs = (
+        ("rawPrediction", "rawPredictionCol", "vec"),
+        ("probability", "probabilityCol", "vec"),
+        ("prediction", "predictionCol", "double"),
+    )
+
+    def _raw_scorer(self):
+        """Jitted per-class margins with W, b device-resident — the device
+        scoring path the daemon ``transform`` op serves (the reference ran
+        transform on the accelerator, RapidsPCA.scala:128-161; scoring on
+        executor CPUs would abandon it)."""
+        cache = getattr(self, "_raw_cache", None)
+        if cache is None:
+            cache = self._raw_cache = {}
+        from spark_rapids_ml_tpu import config
+
+        key = (config.get("compute_dtype"), config.get("accum_dtype"))
+        if key not in cache:
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+            cd, accum = jnp.dtype(key[0]), jnp.dtype(key[1])
+            binary = self.coefficients.ndim == 1
+            W = np.atleast_2d(self.coefficients)  # (C|1, d)
+            w_dev = jnp.asarray(W, dtype=cd)
+            b_dev = jnp.asarray(np.atleast_1d(self.intercept), accum)
+
+            @jax.jit
+            def raw(x):
+                with mm_precision(cd):
+                    z = jax.lax.dot_general(
+                        x.astype(cd), w_dev,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=accum,
+                    ) + b_dev[None, :]
+                if binary:
+                    # Spark's binary raw output is [-z, z] (the margin).
+                    return jnp.concatenate([-z, z], axis=1)
+                return z
+
+            cache[key] = raw
+        return cache[key]
+
+    def transform_matrix(self, x: np.ndarray) -> dict:
+        """Role-keyed transform of a bare matrix: margins on device, the
+        elementwise raw→probability map on host (negligible next to the
+        (n, d)×(d, C) GEMM)."""
+        if self.coefficients is None:
+            raise RuntimeError("model has no coefficients (unfitted?)")
+        from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
+
+        raw = run_bucketed(self._raw_scorer(), x).astype(np.float64)
+        proba = self._raw_to_proba(raw)
+        return {
+            "rawPrediction": raw,
+            "probability": proba,
+            "prediction": np.argmax(proba, axis=1).astype(np.float64),
+        }
+
     def _transform(self, dataset):
         if self.coefficients is None:
             raise RuntimeError("model has no coefficients (unfitted?)")

@@ -563,17 +563,25 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
             self._project_cache[key] = project
         return self._project_cache[key]
 
-    def _transform(self, dataset):
+    # Daemon serving contract (serve/daemon.py): wire algo name + role →
+    # (param naming the output column, canonical column kind).
+    _serve_algo = "pca"
+    _serve_outputs = (("output", "outputCol", "vec"),)
+
+    def transform_matrix(self, x: np.ndarray) -> dict:
+        """Role-keyed transform of a bare (n, d) matrix on device — the
+        serving surface the data-plane daemon's ``transform`` op calls
+        (the accelerator-resident columnar UDF of the reference,
+        RapidsPCA.scala:128-161 → rapidsml_jni.cu:75-107)."""
         if self.pc is None:
             raise RuntimeError("PCAModel has no principal components (unfitted?)")
+        from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
+
+        return {"output": run_bucketed(self._projector(), x)}
+
+    def _transform(self, dataset):
         x = as_matrix(dataset, self.getInputCol())
-        # Pad rows to a bucket so repeated batches hit the jit cache instead
-        # of recompiling per shape.
-        n = x.shape[0]
-        bucket = max(256, 1 << (n - 1).bit_length()) if n else 256
-        xp, _ = pad_rows(np.asarray(x), bucket)
-        y = self._projector()(xp)
-        y = np.asarray(jax.device_get(y))[:n]
+        y = self.transform_matrix(x)["output"]
         return with_column(dataset, self.getOutputCol(), y)
 
     def setOutputCol(self, value: str) -> "PCAModel":

@@ -146,12 +146,27 @@ class StandardScalerModel(Model, _ScalerParams, MLWritable, MLReadable):
         self.mean = source.mean
         self.std = source.std
 
-    def _transform(self, dataset):
-        x = as_matrix(dataset, self.getInputCol()).astype(np.float64)
+    # Daemon serving contract (serve/daemon.py). withMean/withStd ride the
+    # registration params so the served copy scales identically — they are
+    # the only params that change the served output (_serve_params).
+    _serve_algo = "scaler"
+    _serve_outputs = (("output", "outputCol", "vec"),)
+    _serve_params = ("withMean", "withStd")
+
+    def transform_matrix(self, x: np.ndarray) -> dict:
+        """Role-keyed transform of a bare matrix (host elementwise — the
+        op is bandwidth-trivial relative to any model GEMM)."""
+        x = np.asarray(x).astype(np.float64)
         if self.getWithMean():
             x = x - self.mean[None, :]
         if self.getWithStd():
             # MLlib convention: zero-variance features multiply by 0.
             inv = np.where(self.std > 0, 1.0 / np.where(self.std > 0, self.std, 1.0), 0.0)
             x = x * inv[None, :]
-        return with_column(dataset, self.getOutputCol(), x.astype(np.float32))
+        return {"output": x.astype(np.float32)}
+
+    def _transform(self, dataset):
+        x = as_matrix(dataset, self.getInputCol())
+        return with_column(
+            dataset, self.getOutputCol(), self.transform_matrix(x)["output"]
+        )

@@ -35,6 +35,23 @@ def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
     return x, mask
 
 
+def run_bucketed(fn, x: np.ndarray, min_bucket: int = 256) -> np.ndarray:
+    """Apply a jitted row-wise device fn to ``x`` padded to a power-of-two
+    row bucket, returning the first n rows of the (host-fetched) result.
+
+    The shared bucketing policy of every model's batch predict/transform
+    path: repeated batches of varying size hit a bounded set of compiled
+    shapes instead of recompiling per shape."""
+    import jax
+
+    x = np.asarray(x)
+    n = x.shape[0]
+    bucket = max(min_bucket, 1 << (n - 1).bit_length()) if n else min_bucket
+    xp, _ = pad_rows(x, bucket)
+    out = jax.device_get(fn(xp))
+    return np.asarray(out)[:n]
+
+
 def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Rows over the data axis, everything else replicated."""
     spec = P(DATA_AXIS, *([None] * (ndim - 1)))

@@ -210,6 +210,69 @@ class DataPlaneClient:
         )
         return protocol.recv_arrays(sock, resp), int(resp["rows"])
 
+    # -- model serving (daemon-side transform) -----------------------------
+
+    def ensure_model(
+        self,
+        name: str,
+        algo: str,
+        arrays: Dict[str, np.ndarray],
+        params: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Register a fitted model for serving (idempotent; first caller
+        wins). ``arrays`` is the model's ``_model_data()`` payload; raw
+        array frames follow the JSON header, mirroring the finalize
+        response framing. Returns True when this call created it."""
+        sock = self._conn()
+        req = {
+            "v": protocol.PROTOCOL_VERSION,
+            "op": "ensure_model",
+            "model": name,
+            "algo": algo,
+            "params": params or {},
+        }
+        if self._token is not None:
+            req["token"] = self._token
+        protocol.send_arrays(
+            sock, {k: np.asarray(v) for k, v in arrays.items()}, req
+        )
+        resp = protocol.recv_json(sock)
+        if resp is None:
+            raise ConnectionError("daemon closed the connection")
+        if not resp.get("ok", False):
+            raise RuntimeError(f"daemon error: {resp.get('error')}")
+        return bool(resp["created"])
+
+    def model_exists(self, name: str) -> bool:
+        resp, _ = self._roundtrip({"op": "model_status", "model": name})
+        return bool(resp["exists"])
+
+    def transform(
+        self,
+        name: str,
+        data,
+        input_col: str = "features",
+        n_cols: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Run a registered model over one batch on the daemon's devices.
+        ``data``: Arrow Table/RecordBatch or (n, d) ndarray. Returns the
+        role-keyed output arrays (the model's ``_serve_outputs`` roles,
+        e.g. {"output": ...} for PCA, {"prediction": ...} for KMeans)."""
+        resp, sock = self._roundtrip(
+            {
+                "op": "transform",
+                "model": name,
+                "input_col": input_col,
+                "n_cols": n_cols,
+            },
+            payload=self._to_ipc(data, input_col, "label"),
+        )
+        return protocol.recv_arrays(sock, resp)
+
+    def drop_model(self, name: str) -> bool:
+        resp, _ = self._roundtrip({"op": "drop_model", "model": name})
+        return bool(resp["dropped"])
+
     # -- conveniences ------------------------------------------------------
 
     def finalize_pca(

@@ -62,8 +62,9 @@ logger = get_logger("serve.daemon")
 
 #: Ops whose request JSON is followed by one Arrow-IPC payload frame
 #: (docs/protocol.md). Rejection paths must drain that frame to keep the
-#: connection framing aligned.
-_PAYLOAD_OPS = ("feed", "seed")
+#: connection framing aligned. (``ensure_model`` instead carries raw
+#: array frames per its request's ``arrays`` spec — see _drain_payload.)
+_PAYLOAD_OPS = ("feed", "seed", "transform")
 
 
 def _opt(req: Dict[str, Any], key: str, default):
@@ -467,6 +468,63 @@ class _Job:
         }
 
 
+def _model_class(algo: str):
+    """Wire algo → core model class for daemon-side reconstruction from
+    ``_model_data()`` arrays (the same payload model persistence stores)."""
+    if algo == "pca":
+        from spark_rapids_ml_tpu.models.pca import PCAModel
+
+        return PCAModel
+    if algo == "kmeans":
+        from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+
+        return KMeansModel
+    if algo == "linreg":
+        from spark_rapids_ml_tpu.models.linear_regression import LinearRegressionModel
+
+        return LinearRegressionModel
+    if algo == "logreg":
+        from spark_rapids_ml_tpu.models.logistic_regression import (
+            LogisticRegressionModel,
+        )
+
+        return LogisticRegressionModel
+    if algo == "scaler":
+        from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
+
+        return StandardScalerModel
+    raise ValueError(
+        f"unknown model algo {algo!r} (pca|kmeans|linreg|logreg|scaler)"
+    )
+
+
+class _ServedModel:
+    """A registered model serving ``transform``: fitted arrays live on
+    device inside the core model's jit caches, resident across batches —
+    the accelerator-resident columnar UDF of the reference
+    (RapidsPCA.scala:128-161 → rapidsml_jni.cu:75-107), minus its
+    per-batch PC re-upload (rapidsml_jni.cu:85)."""
+
+    def __init__(self, algo: str, arrays: Dict[str, np.ndarray], params: Dict[str, Any]):
+        cls = _model_class(algo)
+        self.algo = algo
+        self.model = cls._from_model_data("served", arrays)
+        # Params configure serving behavior (e.g. scaler withMean/withStd);
+        # unknown names are ignored so client and daemon can skew.
+        known = {k: v for k, v in (params or {}).items() if self.model.hasParam(k)}
+        if known:
+            self.model._set(**known)
+        self.lock = threading.Lock()
+        self.touched = time.monotonic()
+
+    def transform(self, x: np.ndarray) -> Dict[str, np.ndarray]:
+        # Serialize per-model: the jit caches aren't thread-safe to build
+        # concurrently; steady-state calls just take the lock briefly.
+        with self.lock:
+            self.touched = time.monotonic()
+            return self.model.transform_matrix(x)
+
+
 class DataPlaneDaemon:
     """Arrow-over-TCP accumulation server on the TPU host.
 
@@ -489,6 +547,8 @@ class DataPlaneDaemon:
         self._token = token
         self._jobs: Dict[str, _Job] = {}
         self._jobs_lock = threading.Lock()
+        self._models: Dict[str, _ServedModel] = {}
+        self._models_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reaper_thread: Optional[threading.Thread] = None
@@ -572,6 +632,18 @@ class DataPlaneDaemon:
                     "evicted idle job %r (%.1fs > ttl %.1fs, %d rows fed)",
                     name, now - job.touched, self._ttl, job.rows,
                 )
+            # Served models are stateless registrations: evicting one is
+            # always safe (a later transform re-registers on miss), so no
+            # revalidation dance is needed.
+            with self._models_lock:
+                stale_models = [
+                    n for n, m in self._models.items()
+                    if now - m.touched > self._ttl
+                ]
+                for n in stale_models:
+                    del self._models[n]
+            for n in stale_models:
+                logger.info("evicted idle served model %r", n)
 
     def __enter__(self):
         return self.start()
@@ -616,10 +688,13 @@ class DataPlaneDaemon:
 
         def _drain_payload():
             # Keep the connection framing aligned for the error response:
-            # payload-carrying ops already have their payload frame in
+            # payload-carrying ops already have their payload frame(s) in
             # flight when the JSON header is rejected.
             if op in _PAYLOAD_OPS:
                 protocol.recv_frame(conn)
+            elif op == "ensure_model":
+                for _ in req.get("arrays") or []:
+                    protocol.recv_frame(conn)
 
         # Auth first: an unauthenticated peer learns nothing (not even the
         # protocol version) beyond "unauthorized". Constant-time compare.
@@ -668,6 +743,22 @@ class DataPlaneDaemon:
                 with job.lock:
                     job.dropped = True
             protocol.send_json(conn, {"ok": True, "dropped": job is not None})
+        elif op == "ensure_model":
+            self._op_ensure_model(conn, req)
+        elif op == "transform":
+            self._op_transform(conn, req)
+        elif op == "model_status":
+            with self._models_lock:
+                m = self._models.get(str(req.get("model")))
+            protocol.send_json(
+                conn,
+                {"ok": True, "exists": m is not None,
+                 "algo": None if m is None else m.algo},
+            )
+        elif op == "drop_model":
+            with self._models_lock:
+                m = self._models.pop(str(req.get("model")), None)
+            protocol.send_json(conn, {"ok": True, "dropped": m is not None})
         elif op == "ping":
             protocol.send_json(conn, {"ok": True, "v": protocol.PROTOCOL_VERSION})
         else:
@@ -768,6 +859,55 @@ class DataPlaneDaemon:
                 self._jobs[name] = job
         job.seed_centers(x)
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
+
+    def _op_ensure_model(self, conn, req: Dict[str, Any]) -> None:
+        """Register a fitted model for serving (idempotent). The request
+        JSON carries the ``arrays`` spec; raw array frames follow — the
+        same framing finalize uses in the response direction. First caller
+        wins; concurrent registrations under one name are deduplicated."""
+        arrays = protocol.recv_arrays(conn, req)
+        name = str(req["model"])
+        algo = str(req["algo"])
+        params = _opt(req, "params", {})
+        with self._models_lock:
+            existing = self._models.get(name)
+            if existing is None:
+                self._models[name] = _ServedModel(algo, arrays, params)
+                created = True
+            else:
+                if existing.algo != algo:
+                    raise ValueError(
+                        f"model {name!r} is algo {existing.algo!r}; "
+                        f"ensure_model requested {algo!r}"
+                    )
+                existing.touched = time.monotonic()
+                created = False
+        protocol.send_json(conn, {"ok": True, "created": created})
+
+    def _op_transform(self, conn, req: Dict[str, Any]) -> None:
+        """Run a registered model over one Arrow batch; output arrays
+        (role-keyed, see the model's ``_serve_outputs``) stream back as
+        raw frames. The model's fitted arrays stay device-resident across
+        batches and connections."""
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
+
+        payload = protocol.recv_frame(conn)
+        if payload is None:
+            raise protocol.ProtocolError("connection closed before transform payload")
+        with pa.ipc.open_stream(payload) as reader:
+            table = reader.read_all()
+        name = str(req["model"])
+        with self._models_lock:
+            served = self._models.get(name)
+        if served is None:
+            raise KeyError(f"no such model {name!r}; ensure_model first")
+        x = table_column_to_matrix(
+            table, _opt(req, "input_col", "features"), req.get("n_cols")
+        )
+        outs = served.transform(x)
+        protocol.send_arrays(conn, outs, {"ok": True, "rows": int(x.shape[0])})
 
     def _op_finalize(self, conn, req: Dict[str, Any]) -> None:
         job = self._get_job(req)

@@ -383,21 +383,170 @@ class _SparkAdapter:
         return model
 
 
-class _TransformTask:
-    """Executor-side batch transform (pickle-able: the model's fitted
-    arrays ride the closure to each task, resident for the task's
-    lifetime — no per-batch re-upload, fixing rapidsml_jni.cu:85)."""
+def _serve_spec(core_model):
+    """(wire algo, [(role, output column name, kind)]) for models that
+    declare the daemon serving contract (``_serve_algo``/``_serve_outputs``
+    on the model class); None for models without one (KNN — no transform)."""
+    algo = getattr(core_model, "_serve_algo", None)
+    outs = getattr(core_model, "_serve_outputs", None)
+    if not algo or not outs:
+        return None
+    return algo, [
+        (role, core_model.getOrDefault(param), kind) for role, param, kind in outs
+    ]
 
-    def __init__(self, core_model):
+
+def _scalar_params(core_model):
+    """Serving-behavior params of the model (``_serve_params`` on the
+    model class, e.g. scaler withMean/withStd) — what a served daemon
+    copy needs to transform identically. Cosmetic params (column names,
+    k, ...) don't change the served output and are excluded so they don't
+    fragment the daemon registry."""
+    names = getattr(core_model, "_serve_params", ())
+    return {n: core_model.getOrDefault(n) for n in names}
+
+
+def _model_fingerprint(core_model) -> str:
+    """Content hash of the fitted arrays + serving params: the daemon
+    registry key. Two models with identical fits share a served copy;
+    a refit under the same uid gets a fresh one."""
+    import hashlib
+
+    h = hashlib.md5()
+    for k, v in sorted(core_model._model_data().items()):
+        h.update(k.encode())
+        if v is not None:
+            h.update(np.ascontiguousarray(v).tobytes())
+    for k, v in sorted(_scalar_params(core_model).items()):
+        h.update(f"{k}={v!r}".encode())
+    return h.hexdigest()[:12]
+
+
+def _output_column(vals, kind, n_rows):
+    """Build one canonical output column: the declared mapInArrow schema
+    (vec → list<float64>, int → int32, double → float64) must hold
+    regardless of the compute dtype the transform ran in."""
+    import pyarrow as pa
+
+    if n_rows == 0:
+        empty = {"vec": pa.list_(pa.float64()), "int": pa.int32(),
+                 "double": pa.float64()}[kind]
+        return pa.array([], empty)
+    if vals is None:
+        raise RuntimeError(
+            "daemon transform returned no array for a declared output role "
+            "(client/daemon version skew?) — upgrade the daemon or set "
+            "SRML_TRANSFORM_LOCAL=1 to score executor-side"
+        )
+    vals = np.asarray(vals)
+    if kind == "vec":
+        from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
+
+        col = matrix_to_list_column(vals.astype(np.float64))
+        return col.cast(pa.list_(pa.float64()))
+    if kind == "int":
+        return pa.array(vals.astype(np.int32))
+    return pa.array(vals.astype(np.float64))
+
+
+def _append_outputs(table, role_arrays, outputs):
+    """Append/replace the model's output columns on one batch table."""
+    for role, colname, kind in outputs:
+        if colname in table.column_names:
+            table = table.drop_columns([colname])
+        table = table.append_column(
+            colname, _output_column(role_arrays.get(role), kind, table.num_rows)
+        )
+    return table
+
+
+class _TransformTask:
+    """Executor-side (CPU) batch transform — the EXPLICIT fallback when no
+    daemon should be used (SRML_TRANSFORM_LOCAL=1). Pickle-able: the
+    model's fitted arrays ride the closure to each task, resident for the
+    task's lifetime — no per-batch re-upload (fixes rapidsml_jni.cu:85),
+    but the compute runs on the executor's host backend, not the TPU."""
+
+    def __init__(self, core_model, input_col, outputs):
         self._core = core_model
+        self._input_col = input_col
+        self._outputs = outputs
 
     def __call__(self, batches):
         import pyarrow as pa
 
+        from spark_rapids_ml_tpu.core.dataset import as_matrix
+
         for batch in batches:
             table = pa.Table.from_batches([batch])
-            out = self._core.transform(table)
-            yield from out.to_batches()
+            if table.num_rows == 0:
+                yield from _append_outputs(table, {}, self._outputs).to_batches()
+                continue
+            x = as_matrix(table, self._input_col)
+            outs = self._core.transform_matrix(x)
+            yield from _append_outputs(table, outs, self._outputs).to_batches()
+
+
+class _DaemonTransformTask:
+    """Executor-side feeder for TPU-served transform: batches stream to
+    the data-plane daemon's ``transform`` op and the projected columns
+    come back — the reference's accelerator-resident columnar UDF
+    (RapidsPCA.scala:128-161 → rapidsml_jni.cu:75-107), with the model
+    registered once (ensure_model) and device-resident across batches.
+    Only the features column crosses the wire; passthrough columns never
+    leave the executor."""
+
+    def __init__(self, core_model, host, port, token, input_col, algo, outputs):
+        self._core = core_model  # fitted arrays ride the closure (jit caches strip)
+        self.host, self.port, self.token = host, port, token
+        self._input_col = input_col
+        self._algo = algo
+        self._outputs = outputs
+        self._name = f"{core_model.uid}-{_model_fingerprint(core_model)}"
+        self._params = _scalar_params(core_model)
+
+    def __call__(self, batches):
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+        from spark_rapids_ml_tpu.spark import daemon_session as ds
+
+        h, p = ds.executor_daemon_address(self.host, self.port)
+        with DataPlaneClient(h, p, token=self.token) as c:
+            registered = c.model_exists(self._name)
+            for batch in batches:
+                table = pa.Table.from_batches([batch])
+                if table.num_rows == 0:
+                    yield from _append_outputs(table, {}, self._outputs).to_batches()
+                    continue
+                if not registered:
+                    c.ensure_model(
+                        self._name, self._algo, self._core._model_data(),
+                        params=self._params,
+                    )
+                    registered = True
+                try:
+                    outs = c.transform(
+                        self._name,
+                        table.select([self._input_col]),
+                        input_col=self._input_col,
+                    )
+                except RuntimeError as e:
+                    if "no such model" not in str(e):
+                        raise
+                    # Registrations are stateless and TTL-evictable; the
+                    # documented recovery (docs/protocol.md) is to
+                    # re-register and retry — the task has everything.
+                    c.ensure_model(
+                        self._name, self._algo, self._core._model_data(),
+                        params=self._params,
+                    )
+                    outs = c.transform(
+                        self._name,
+                        table.select([self._input_col]),
+                        input_col=self._input_col,
+                    )
+                yield from _append_outputs(table, outs, self._outputs).to_batches()
 
 
 class _SparkModelAdapter:
@@ -409,34 +558,66 @@ class _SparkModelAdapter:
     def __getattr__(self, name):
         return getattr(self._core, name)
 
+    def _transform_input_col(self):
+        core = self._core
+        return core.getOrDefault(
+            "inputCol" if core.hasParam("inputCol") else "featuresCol"
+        )
+
+    def _derive_output_schema(self, dataset, outputs):
+        """Output schema = input schema + declared output fields, computed
+        WITHOUT running a Spark job (the round-2 review flagged the old
+        limit(1) probe as one job per transform call). Duck-typed test
+        harnesses have no StructType schema — they ignore the argument."""
+        try:
+            from pyspark.sql import types as T
+
+            base = dataset.schema
+        except (ImportError, AttributeError):
+            return None
+        out_names = {name for _, name, _ in outputs}
+        fields = [f for f in base.fields if f.name not in out_names]
+        for _, name, kind in outputs:
+            typ = (
+                T.ArrayType(T.DoubleType())
+                if kind == "vec"
+                else (T.IntegerType() if kind == "int" else T.DoubleType())
+            )
+            fields.append(T.StructField(name, typ, True))
+        return T.StructType(fields)
+
     def transform(self, dataset):
         if not _is_spark_df(dataset):
             _check_not_orphan_spark_df(dataset)
             return self._core.transform(dataset)
-        import pyarrow as pa
+        import os
 
         core = self._core
-        out_field = None
-        for name in ("outputCol", "predictionCol"):
-            if core.hasParam(name) and core.isDefined(core.getParam(name)):
-                out_field = core.getOrDefault(name)
-                break
+        spec = _serve_spec(core)
 
-        if hasattr(dataset, "mapInArrow"):
+        if hasattr(dataset, "mapInArrow") and spec is not None:
             # Distributed, lazy: one Arrow batch per executor partition —
-            # the columnar-UDF analogue (RapidsPCA.scala:128-161).
-            transform_batches = _TransformTask(core)
-            sample = _df_to_arrow(dataset.limit(1), dataset.columns)
-            out_sample = core.transform(sample)
-            try:
-                from pyspark.sql.pandas.types import from_arrow_schema
+            # served from the TPU via the daemon unless the explicit
+            # executor-CPU fallback is requested.
+            algo, outputs = spec
+            input_col = self._transform_input_col()
+            local = os.environ.get("SRML_TRANSFORM_LOCAL", "").lower() in (
+                "1", "true",
+            )
+            if local:
+                fn = _TransformTask(core, input_col, outputs)
+            else:
+                spark = getattr(dataset, "sparkSession", None)
+                host, port, token = daemon_session.resolve(spark)
+                fn = _DaemonTransformTask(
+                    core, host, port, token, input_col, algo, outputs
+                )
+            return dataset.mapInArrow(
+                fn, self._derive_output_schema(dataset, outputs)
+            )
 
-                schema = from_arrow_schema(out_sample.schema)
-            except ImportError:  # duck-typed DF harness: arrow schema is fine
-                schema = out_sample.schema
-            return dataset.mapInArrow(transform_batches, schema)
-
-        # Fallback: collect → transform → recreate (local mode only).
+        # Fallback: collect → transform → recreate (models without a
+        # serving contract, or DataFrames without mapInArrow).
         table = _df_to_arrow(dataset, dataset.columns)
         out = core.transform(table)
         spark = dataset.sparkSession

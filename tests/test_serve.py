@@ -284,3 +284,58 @@ def test_logreg_nonbinary_labels_rejected(daemon, rng):
     with _client(daemon) as c:
         with pytest.raises(RuntimeError, match="binary"):
             c.feed("job-lr2", (x, y), algo="logreg")
+
+
+def test_model_serving_roundtrip(daemon, data, mesh8):
+    """ensure_model/transform/drop_model: the daemon's served copy must
+    reproduce the core model's transform exactly, stay registered across
+    calls, and reject transforms after drop."""
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    model = PCA(mesh=mesh8).setK(3).fit({"features": data})
+    with _client(daemon) as c:
+        assert c.ensure_model("srv", "pca", model._model_data()) is True
+        # idempotent re-register: first copy wins
+        assert c.ensure_model("srv", "pca", model._model_data()) is False
+        assert c.model_exists("srv")
+        outs = c.transform("srv", data[:100])
+        np.testing.assert_allclose(
+            outs["output"], model.transform_matrix(data[:100])["output"],
+            atol=1e-12,
+        )
+        # batches of a different size reuse the registration
+        outs2 = c.transform("srv", data[100:350])
+        assert outs2["output"].shape == (250, 3)
+        assert c.drop_model("srv") is True
+        assert not c.model_exists("srv")
+        with pytest.raises(RuntimeError, match="no such model"):
+            c.transform("srv", data[:10])
+
+
+def test_model_serving_algo_conflict_rejected(daemon, data, mesh8):
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    model = PCA(mesh=mesh8).setK(2).fit({"features": data})
+    with _client(daemon) as c:
+        c.ensure_model("conflicted", "pca", model._model_data())
+        with pytest.raises(RuntimeError, match="algo"):
+            c.ensure_model("conflicted", "kmeans", model._model_data())
+
+
+def test_model_serving_params_configure_the_served_copy(daemon, data, mesh8):
+    """Scaler withMean rides the registration params — the served copy
+    must scale exactly like the configured local model."""
+    from spark_rapids_ml_tpu.models.scaler import StandardScaler
+
+    model = (
+        StandardScaler(mesh=mesh8).setWithMean(True).fit({"features": data})
+    )
+    with _client(daemon) as c:
+        c.ensure_model(
+            "scl", "scaler", model._model_data(),
+            params={"withMean": True, "withStd": True},
+        )
+        outs = c.transform("scl", data[:64])
+        np.testing.assert_allclose(
+            outs["output"], model.transform_matrix(data[:64])["output"], atol=0
+        )
