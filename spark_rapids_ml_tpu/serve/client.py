@@ -273,6 +273,52 @@ class DataPlaneClient:
         resp, _ = self._roundtrip({"op": "drop_model", "model": name})
         return bool(resp["dropped"])
 
+    def finalize_knn(
+        self,
+        job: str,
+        register_as: str,
+        mode: str = "exact",
+        nlist: Optional[int] = None,
+        nprobe: Optional[int] = None,
+        seed: int = 0,
+    ) -> Dict[str, np.ndarray]:
+        """Build the index from a knn job's accumulated rows ON the daemon
+        and register it as ``register_as`` for :meth:`kneighbors` serving.
+        Returns only O(1) stats ({"n_rows", "n_cols"[, "nlist",
+        "maxlen"]}) — the index itself never crosses the wire."""
+        params: Dict[str, Any] = {
+            "mode": mode, "register_as": register_as, "seed": seed,
+        }
+        if nlist is not None:
+            params["nlist"] = nlist
+        if nprobe is not None:
+            params["nprobe"] = nprobe
+        arrays, _ = self.finalize(job, params)
+        return arrays
+
+    def kneighbors(
+        self,
+        model: str,
+        queries,
+        k: Optional[int] = None,
+        input_col: str = "features",
+        n_cols: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Query a daemon-registered index: returns (distances (q, k),
+        indices (q, k)) with global partition-major row ids."""
+        resp, sock = self._roundtrip(
+            {
+                "op": "kneighbors",
+                "model": model,
+                "k": k,
+                "input_col": input_col,
+                "n_cols": n_cols,
+            },
+            payload=self._to_ipc(queries, input_col, "label"),
+        )
+        arrays = protocol.recv_arrays(sock, resp)
+        return arrays["distances"], arrays["indices"]
+
     # -- conveniences ------------------------------------------------------
 
     def finalize_pca(

@@ -64,7 +64,7 @@ logger = get_logger("serve.daemon")
 #: (docs/protocol.md). Rejection paths must drain that frame to keep the
 #: connection framing aligned. (``ensure_model`` instead carries raw
 #: array frames per its request's ``arrays`` spec — see _drain_payload.)
-_PAYLOAD_OPS = ("feed", "seed", "transform")
+_PAYLOAD_OPS = ("feed", "seed", "transform", "kneighbors")
 
 
 def _opt(req: Dict[str, Any], key: str, default):
@@ -141,8 +141,17 @@ class _Job:
             self.b = jnp.zeros((), self._accum)
             self.update = _stream_grad_hess_fn(mesh, config.get("accum_dtype"))
             self.state = self._logreg_zero_state()
+        elif algo == "knn":
+            # KNN's "sufficient statistic" IS the dataset (the model is the
+            # database, SURVEY §2.3) — rows accumulate host-side per
+            # partition; finalize builds the device index and REGISTERS it
+            # for serving instead of shipping ~dataset-sized arrays to the
+            # driver (the round-2 full-collect gap, VERDICT missing #2).
+            self.state = []  # eager-fed row blocks, arrival order
+            self.part_rows: Dict[int, list] = {}  # partition → row blocks
+            self.update = None
         else:
-            raise ValueError(f"unknown algo {algo!r} (pca|linreg|kmeans|logreg)")
+            raise ValueError(f"unknown algo {algo!r} (pca|linreg|kmeans|logreg|knn)")
 
     def _kmeans_zero_state(self):
         from spark_rapids_ml_tpu.models.kmeans import stream_zero_state
@@ -155,6 +164,8 @@ class _Job:
         return stream_zero_state(self.n_cols, self._accum)
 
     def _zero_state(self):
+        if self.algo == "knn":
+            return []
         if self.algo == "pca":
             return gram_ops.init_stats(self.n_cols)
         if self.algo == "linreg":
@@ -236,6 +247,24 @@ class _Job:
         if self.algo in ("linreg", "logreg") and y is None:
             raise ValueError(f"{self.algo} feed needs a label column")
         n = x.shape[0]
+        if self.algo == "knn":
+            # Host-side row accumulation (no device fold): the exactly-once
+            # staging applies unchanged — a block only counts at commit.
+            block = np.ascontiguousarray(x, dtype=np.float32)
+            with self.lock:
+                if self.dropped:
+                    raise KeyError("job was finalized/dropped; rows not accepted")
+                self.touched = time.monotonic()
+                if partition is not None and partition in self.committed:
+                    return
+                if partition is None:
+                    self.state.append(block)
+                    self.rows += n
+                    self.pass_rows += n
+                else:
+                    blocks, extra = self.staged.get((partition, attempt), ([], 0))
+                    self.staged[(partition, attempt)] = (blocks + [block], extra + n)
+            return
         target = self._bucket(n)
         xb = np.zeros((target,) + x.shape[1:], dtype=x.dtype)
         xb[:n] = x
@@ -323,7 +352,14 @@ class _Job:
                     "with no staged feed"
                 )
             state, n = staged
-            self.state = self._merge(self.state, state)
+            if self.algo == "knn":
+                # Keyed by partition (not arrival order) so the finalize
+                # concatenation — and therefore the global row ids the
+                # index returns — is deterministic partition-major, however
+                # the concurrent commits interleaved.
+                self.part_rows[partition] = state
+            else:
+                self.state = self._merge(self.state, state)
             self.committed[partition] = n
             self.rows += n
             self.pass_rows += n
@@ -393,6 +429,54 @@ class _Job:
             }
             self.pass_rows = 0
             return info
+
+    def build_knn_model(self, params: Dict[str, Any]):
+        """Build the KNN/ANN model from the accumulated rows and consume
+        the job. Returns (core model, info arrays); the daemon registers
+        the model for `kneighbors` serving — the ~dataset-sized index
+        never crosses to the driver (BASELINE config #5: 10M×768 would
+        OOM it, the round-2 full-collect gap)."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            self.touched = time.monotonic()
+            blocks = list(self.state)
+            for pid in sorted(self.part_rows):
+                blocks.extend(self.part_rows[pid])
+            if not blocks:
+                raise ValueError("finalize before any feed: no rows")
+            rows = np.concatenate(blocks)
+            mode = str(params.get("mode", "exact"))
+            info = {
+                "n_rows": np.asarray([rows.shape[0]], np.int64),
+                "n_cols": np.asarray([rows.shape[1]], np.int64),
+            }
+            if mode == "ivf":
+                import jax.numpy as jnp
+
+                from spark_rapids_ml_tpu.models.knn import (
+                    ApproximateNearestNeighborsModel,
+                    build_ivf_flat_device,
+                )
+
+                nlist = int(params["nlist"])
+                index = build_ivf_flat_device(
+                    jnp.asarray(rows), nlist=nlist,
+                    seed=int(params.get("seed") or 0),
+                )
+                model = ApproximateNearestNeighborsModel(index=index)
+                if params.get("nprobe"):
+                    model._set(nprobe=int(params["nprobe"]))
+                info["nlist"] = np.asarray([nlist], np.int64)
+                info["maxlen"] = np.asarray([index.lists.shape[1]], np.int64)
+            elif mode == "exact":
+                from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
+
+                model = NearestNeighborsModel(database=rows, mesh=self.mesh)
+            else:
+                raise ValueError(f"unknown knn mode {mode!r} (exact|ivf)")
+            self.dropped = True  # rows are consumed by the built index
+            return model, info
 
     def finalize(self, params: Dict[str, Any], drop: bool = False) -> Dict[str, np.ndarray]:
         with self.lock:
@@ -516,6 +600,24 @@ class _ServedModel:
             self.model._set(**known)
         self.lock = threading.Lock()
         self.touched = time.monotonic()
+        # Re-creatable registration (client holds the arrays): plain TTL.
+        self.ttl_scale = 1.0
+
+    @classmethod
+    def from_model(cls, algo: str, model) -> "_ServedModel":
+        """Wrap an already-built core model (daemon-built KNN index) —
+        bypasses the arrays/params reconstruction path. NOT re-creatable
+        by clients (the source rows were consumed by the build), so the
+        reaper holds it 8× longer than ordinary registrations before
+        reclaiming the dataset-sized memory; owners should drop_model
+        explicitly when done."""
+        obj = cls.__new__(cls)
+        obj.algo = algo
+        obj.model = model
+        obj.lock = threading.Lock()
+        obj.touched = time.monotonic()
+        obj.ttl_scale = 8.0
+        return obj
 
     def transform(self, x: np.ndarray) -> Dict[str, np.ndarray]:
         # Serialize per-model: the jit caches aren't thread-safe to build
@@ -523,6 +625,15 @@ class _ServedModel:
         with self.lock:
             self.touched = time.monotonic()
             return self.model.transform_matrix(x)
+
+    def kneighbors(self, queries: np.ndarray, k):
+        with self.lock:
+            self.touched = time.monotonic()
+            if not hasattr(self.model, "kneighbors"):
+                raise ValueError(
+                    f"model algo {self.algo!r} does not serve kneighbors"
+                )
+            return self.model.kneighbors(queries, k)
 
 
 class DataPlaneDaemon:
@@ -632,18 +743,20 @@ class DataPlaneDaemon:
                     "evicted idle job %r (%.1fs > ttl %.1fs, %d rows fed)",
                     name, now - job.touched, self._ttl, job.rows,
                 )
-            # Served models are stateless registrations: evicting one is
-            # always safe (a later transform re-registers on miss), so no
-            # revalidation dance is needed.
+            # ensure_model registrations are stateless (clients re-register
+            # on miss) and reap at the plain TTL; daemon-built KNN indexes
+            # are NOT re-creatable — ttl_scale holds them 8× longer before
+            # their dataset-sized memory is reclaimed (queries after that
+            # get a clear evicted-refit error, not silent wrong answers).
             with self._models_lock:
                 stale_models = [
                     n for n, m in self._models.items()
-                    if now - m.touched > self._ttl
+                    if now - m.touched > self._ttl * m.ttl_scale
                 ]
                 for n in stale_models:
                     del self._models[n]
             for n in stale_models:
-                logger.info("evicted idle served model %r", n)
+                logger.warning("evicted idle served model %r", n)
 
     def __enter__(self):
         return self.start()
@@ -747,6 +860,8 @@ class DataPlaneDaemon:
             self._op_ensure_model(conn, req)
         elif op == "transform":
             self._op_transform(conn, req)
+        elif op == "kneighbors":
+            self._op_kneighbors(conn, req)
         elif op == "model_status":
             with self._models_lock:
                 m = self._models.get(str(req.get("model")))
@@ -909,10 +1024,73 @@ class DataPlaneDaemon:
         outs = served.transform(x)
         protocol.send_arrays(conn, outs, {"ok": True, "rows": int(x.shape[0])})
 
+    def _op_kneighbors(self, conn, req: Dict[str, Any]) -> None:
+        """Query a daemon-registered KNN/ANN index: query batch in, the
+        (q, k) neighbor distances/indices back — the database-sized index
+        never leaves the daemon."""
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
+
+        payload = protocol.recv_frame(conn)
+        if payload is None:
+            raise protocol.ProtocolError("connection closed before kneighbors payload")
+        with pa.ipc.open_stream(payload) as reader:
+            table = reader.read_all()
+        name = str(req["model"])
+        with self._models_lock:
+            served = self._models.get(name)
+        if served is None:
+            raise KeyError(
+                f"no such model {name!r} — a daemon-built index this old "
+                "was TTL-evicted (it is not client-re-creatable); refit "
+                "the estimator"
+            )
+        q = table_column_to_matrix(
+            table, _opt(req, "input_col", "features"), req.get("n_cols")
+        )
+        k = req.get("k")
+        dists, idx = served.kneighbors(q, None if k is None else int(k))
+        protocol.send_arrays(
+            conn,
+            {"distances": np.asarray(dists, np.float64),
+             "indices": np.asarray(idx, np.int64)},
+            {"ok": True, "rows": int(q.shape[0])},
+        )
+
     def _op_finalize(self, conn, req: Dict[str, Any]) -> None:
         job = self._get_job(req)
+        params = _opt(req, "params", {})
+        if job.algo == "knn":
+            # Build-and-serve: the index is registered daemon-side under
+            # ``register_as``; only O(1) stats go back to the caller.
+            name = str(params.get("register_as") or f"knn-{req.get('job')}")
+            with self._models_lock:
+                if name in self._models:
+                    # First-wins like ensure_model: silently replacing a
+                    # live registration would answer existing handles'
+                    # queries from a different dataset's row-id space.
+                    raise ValueError(
+                        f"model name {name!r} is already registered; "
+                        "pick a fresh register_as"
+                    )
+            model, info = job.build_knn_model(params)
+            algo = "ann" if params.get("mode") == "ivf" else "knn"
+            with self._models_lock:
+                if name in self._models:  # raced registration: first wins
+                    raise ValueError(
+                        f"model name {name!r} is already registered; "
+                        "pick a fresh register_as"
+                    )
+                self._models[name] = _ServedModel.from_model(algo, model)
+            with self._jobs_lock:
+                self._jobs.pop(str(req.get("job")), None)
+            protocol.send_arrays(
+                conn, info, {"ok": True, "rows": job.rows, "model": name}
+            )
+            return
         drop = bool(_opt(req, "drop", True))
-        arrays = job.finalize(_opt(req, "params", {}), drop=drop)
+        arrays = job.finalize(params, drop=drop)
         # Unregister BEFORE sending: if the client disconnects mid-response
         # the name must not stay poisoned (dropped=True) in _jobs forever.
         if drop:

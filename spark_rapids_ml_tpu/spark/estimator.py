@@ -175,30 +175,65 @@ class _SparkAdapter:
 
     def fit(self, dataset):
         if _is_spark_df(dataset):
-            if self._daemon_algo is not None:
-                core_model = self._fit_distributed(dataset)
-            else:
-                cols = self._input_columns()
-                table = _df_to_arrow(dataset, cols)
-                core_model = self._core.fit(table)
+            if self._daemon_algo == "knn":
+                return self._fit_knn(dataset)
+            if self._daemon_algo is None:
+                # Never collect a DataFrame to the driver to fit — every
+                # shipped estimator speaks a daemon protocol; a custom
+                # wrapper without one must opt into the core API.
+                raise NotImplementedError(
+                    f"{type(self).__name__} has no daemon fit protocol; "
+                    "use the core estimator with in-memory data"
+                )
+            core_model = self._fit_distributed(dataset)
         else:
             _check_not_orphan_spark_df(dataset)
             core_model = self._core.fit(dataset)
         return _SparkModelAdapter(core_model)
 
-    def _input_columns(self):
-        cols = []
-        for name in ("inputCol", "featuresCol"):
-            if self._core.hasParam(name) and self._core.isDefined(
-                self._core.getParam(name)
-            ):
-                cols.append(self._core.getOrDefault(name))
-        for name in ("labelCol",):
-            if self._core.hasParam(name) and self._core.isDefined(
-                self._core.getParam(name)
-            ):
-                cols.append(self._core.getOrDefault(name))
-        return cols
+    def _fit_knn(self, df):
+        """Daemon-fed KNN/ANN fit: executors stream partitions to a knn
+        accumulation job; finalize BUILDS the index on the daemon's
+        devices and registers it for kneighbors serving. The dataset (and
+        the index, which is the same size) never reaches the driver —
+        BASELINE config #5 (10M×768 ≈ 31 GB) would OOM it."""
+        core = self._core
+        spark = getattr(df, "sparkSession", None)
+        host, port, token = daemon_session.resolve(spark)
+        job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
+        input_col = core.getOrDefault("featuresCol")
+        sel = df.select(input_col)
+        ivf = core.hasParam("nlist")
+
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+        fn = _FeedTask(
+            host, port, token, job, "knn", input_col, "label", {}, None
+        )
+        acks = sel.mapInArrow(fn, "partition int, rows long").collect()
+        if sum(r["rows"] for r in acks) == 0:
+            raise ValueError("cannot fit on an empty DataFrame")
+        name = f"knnidx-{job}"
+        with DataPlaneClient(host, port, token=token) as client:
+            try:
+                if ivf:
+                    info = client.finalize_knn(
+                        job, register_as=name, mode="ivf",
+                        nlist=core.getNlist(), nprobe=core.getNprobe(),
+                        seed=core.getSeed(),
+                    )
+                else:
+                    info = client.finalize_knn(job, register_as=name, mode="exact")
+            except Exception:
+                try:
+                    client.drop(job)
+                except Exception:
+                    pass
+                raise
+        return _DaemonKNNModel(
+            core, host, port, token, name,
+            n_rows=int(info["n_rows"][0]), input_col=input_col,
+        )
 
     # -- distributed fit ---------------------------------------------------
 
@@ -422,16 +457,26 @@ def _model_fingerprint(core_model) -> str:
     return h.hexdigest()[:12]
 
 
+def _arrow_kind_type(kind):
+    import pyarrow as pa
+
+    return {
+        "vec": pa.list_(pa.float64()),
+        "ivec": pa.list_(pa.int64()),
+        "int": pa.int32(),
+        "double": pa.float64(),
+    }[kind]
+
+
 def _output_column(vals, kind, n_rows):
     """Build one canonical output column: the declared mapInArrow schema
-    (vec → list<float64>, int → int32, double → float64) must hold
-    regardless of the compute dtype the transform ran in."""
+    (vec → list<float64>, ivec → list<int64>, int → int32, double →
+    float64) must hold regardless of the compute dtype the transform ran
+    in."""
     import pyarrow as pa
 
     if n_rows == 0:
-        empty = {"vec": pa.list_(pa.float64()), "int": pa.int32(),
-                 "double": pa.float64()}[kind]
-        return pa.array([], empty)
+        return pa.array([], _arrow_kind_type(kind))
     if vals is None:
         raise RuntimeError(
             "daemon transform returned no array for a declared output role "
@@ -439,14 +484,39 @@ def _output_column(vals, kind, n_rows):
             "SRML_TRANSFORM_LOCAL=1 to score executor-side"
         )
     vals = np.asarray(vals)
-    if kind == "vec":
+    if kind in ("vec", "ivec"):
         from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
 
-        col = matrix_to_list_column(vals.astype(np.float64))
-        return col.cast(pa.list_(pa.float64()))
+        dt = np.float64 if kind == "vec" else np.int64
+        col = matrix_to_list_column(vals.astype(dt))
+        return col.cast(_arrow_kind_type(kind))
     if kind == "int":
         return pa.array(vals.astype(np.int32))
     return pa.array(vals.astype(np.float64))
+
+
+def _derive_output_schema(dataset, outputs):
+    """Output schema = input schema + declared output fields, computed
+    WITHOUT running a Spark job (the round-2 review flagged the old
+    limit(1) probe as one job per transform call). Duck-typed test
+    harnesses have no StructType schema — they ignore the argument."""
+    try:
+        from pyspark.sql import types as T
+
+        base = dataset.schema
+    except (ImportError, AttributeError):
+        return None
+    out_names = {name for _, name, _ in outputs}
+    fields = [f for f in base.fields if f.name not in out_names]
+    spark_types = {
+        "vec": lambda: T.ArrayType(T.DoubleType()),
+        "ivec": lambda: T.ArrayType(T.LongType()),
+        "int": T.IntegerType,
+        "double": T.DoubleType,
+    }
+    for _, name, kind in outputs:
+        fields.append(T.StructField(name, spark_types[kind](), True))
+    return T.StructType(fields)
 
 
 def _append_outputs(table, role_arrays, outputs):
@@ -549,6 +619,136 @@ class _DaemonTransformTask:
                 yield from _append_outputs(table, outs, self._outputs).to_batches()
 
 
+_KNN_OUTPUTS = (
+    ("distances", "knn_distances", "vec"),
+    ("indices", "knn_indices", "ivec"),
+)
+
+
+class _DaemonKNNTask:
+    """Executor-side query feeder: each batch's query rows go to the
+    daemon's ``kneighbors`` op; neighbor distance/index columns come
+    back. The database-sized index stays daemon-resident."""
+
+    def __init__(self, host, port, token, name, input_col, k):
+        self.host, self.port, self.token = host, port, token
+        self._name = name
+        self._input_col = input_col
+        self._k = k
+
+    def __call__(self, batches):
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+        from spark_rapids_ml_tpu.spark import daemon_session as ds
+
+        h, p = ds.executor_daemon_address(self.host, self.port)
+        with DataPlaneClient(h, p, token=self.token) as c:
+            for batch in batches:
+                table = pa.Table.from_batches([batch])
+                if table.num_rows == 0:
+                    yield from _append_outputs(table, {}, _KNN_OUTPUTS).to_batches()
+                    continue
+                dists, idx = c.kneighbors(
+                    self._name,
+                    table.select([self._input_col]),
+                    k=self._k,
+                    input_col=self._input_col,
+                )
+                out = {"distances": dists, "indices": idx}
+                yield from _append_outputs(table, out, _KNN_OUTPUTS).to_batches()
+
+
+class _DaemonKNNModel:
+    """Fitted KNN/ANN handle whose index lives ON the TPU-host daemon.
+
+    The reference never materializes the dataset on the driver
+    (RapidsRowMatrix.scala:118-139); for KNN the fitted model IS the
+    dataset, so driver-side persistence is structurally impossible at
+    config-#5 scale (10M×768 ≈ 31 GB) — queries are served remotely
+    instead. Use the core (non-Spark) API for an in-memory, persistable
+    index."""
+
+    def __init__(self, core, host, port, token, name, n_rows, input_col):
+        self._core = core  # the estimator: param surface (k, featuresCol…)
+        self._host, self._port, self._token = host, port, token
+        self._name = name
+        self._n_rows = n_rows
+        self._input_col = input_col
+
+    def __getattr__(self, name):
+        return getattr(self._core, name)
+
+    @property
+    def daemon_model_name(self) -> str:
+        return self._name
+
+    @property
+    def numRows(self) -> int:
+        return self._n_rows
+
+    def kneighbors(self, queries, k=None):
+        """Driver-side convenience for ndarray queries: (distances (q, k),
+        indices (q, k)); indices are global partition-major row positions
+        of the fitted DataFrame."""
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+        if _is_spark_df(queries):
+            raise TypeError(
+                "pass a DataFrame to transform() for distributed queries; "
+                "kneighbors takes an (q, d) ndarray"
+            )
+        with DataPlaneClient(self._host, self._port, token=self._token) as c:
+            return c.kneighbors(
+                self._name, np.asarray(queries),
+                k=self._core.getOrDefault("k") if k is None else k,
+                input_col=self._input_col,
+            )
+
+    def transform(self, dataset):
+        """Distributed query: appends knn_distances (list<double>) and
+        knn_indices (list<long>) columns via mapInArrow tasks that hit
+        the daemon — no index download, no driver collect."""
+        if not _is_spark_df(dataset):
+            dists, idx = self.kneighbors(
+                __import__(
+                    "spark_rapids_ml_tpu.core.dataset", fromlist=["as_matrix"]
+                ).as_matrix(dataset, self._input_col)
+            )
+            from spark_rapids_ml_tpu.core.dataset import with_column
+
+            out = with_column(dataset, "knn_distances", dists)
+            return with_column(out, "knn_indices", idx)
+        fn = _DaemonKNNTask(
+            self._host, self._port, self._token, self._name,
+            self._input_col, self._core.getOrDefault("k"),
+        )
+        return dataset.mapInArrow(
+            fn, _derive_output_schema(dataset, _KNN_OUTPUTS)
+        )
+
+    def release(self) -> bool:
+        """Free the daemon-resident index now (it is dataset-sized and
+        otherwise held until the daemon's extended KNN TTL). The handle
+        is unusable afterwards."""
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+        try:
+            with DataPlaneClient(self._host, self._port, token=self._token) as c:
+                return c.drop_model(self._name)
+        except OSError:
+            return False  # daemon already gone — nothing to free
+
+    def write(self):
+        raise NotImplementedError(
+            "a daemon-resident KNN index is dataset-sized and cannot be "
+            "persisted from the driver; fit the core "
+            "(spark_rapids_ml_tpu.NearestNeighbors / "
+            "ApproximateNearestNeighbors) estimator on in-memory data for "
+            "a persistable model"
+        )
+
+
 class _SparkModelAdapter:
     """Wraps a fitted core Model with Spark DataFrame transform."""
 
@@ -565,26 +765,7 @@ class _SparkModelAdapter:
         )
 
     def _derive_output_schema(self, dataset, outputs):
-        """Output schema = input schema + declared output fields, computed
-        WITHOUT running a Spark job (the round-2 review flagged the old
-        limit(1) probe as one job per transform call). Duck-typed test
-        harnesses have no StructType schema — they ignore the argument."""
-        try:
-            from pyspark.sql import types as T
-
-            base = dataset.schema
-        except (ImportError, AttributeError):
-            return None
-        out_names = {name for _, name, _ in outputs}
-        fields = [f for f in base.fields if f.name not in out_names]
-        for _, name, kind in outputs:
-            typ = (
-                T.ArrayType(T.DoubleType())
-                if kind == "vec"
-                else (T.IntegerType() if kind == "int" else T.DoubleType())
-            )
-            fields.append(T.StructField(name, typ, True))
-        return T.StructType(fields)
+        return _derive_output_schema(dataset, outputs)
 
     def transform(self, dataset):
         if not _is_spark_df(dataset):
@@ -616,12 +797,16 @@ class _SparkModelAdapter:
                 fn, self._derive_output_schema(dataset, outputs)
             )
 
-        # Fallback: collect → transform → recreate (models without a
-        # serving contract, or DataFrames without mapInArrow).
-        table = _df_to_arrow(dataset, dataset.columns)
-        out = core.transform(table)
-        spark = dataset.sparkSession
-        return spark.createDataFrame(out.to_pandas())
+        # No collect-based fallback: every Spark code path must keep the
+        # dataset off the driver (the reference's defining property,
+        # RapidsRowMatrix.scala:118-139). mapInArrow exists since
+        # pyspark 3.3; models without a serving contract have no Spark
+        # transform at all.
+        raise NotImplementedError(
+            "distributed transform needs DataFrame.mapInArrow (pyspark "
+            ">= 3.3) and a model with a serving contract; for in-memory "
+            "data use the core estimators (spark_rapids_ml_tpu.*) directly"
+        )
 
 
 def _make_wrapper(name, core_cls, doc, daemon_algo=None):
@@ -664,12 +849,17 @@ SparkLogisticRegression = _make_wrapper(
     "LogisticRegression over PySpark DataFrames.", daemon_algo="logreg",
 )
 SparkNearestNeighbors = _make_wrapper(
-    "SparkNearestNeighbors", _NearestNeighbors, "Exact KNN over PySpark DataFrames."
+    "SparkNearestNeighbors", _NearestNeighbors,
+    "Exact KNN over PySpark DataFrames — daemon-fed fit, daemon-served "
+    "queries (the dataset never reaches the driver).",
+    daemon_algo="knn",
 )
 SparkApproximateNearestNeighbors = _make_wrapper(
     "SparkApproximateNearestNeighbors",
     _ApproximateNearestNeighbors,
-    "IVF-Flat approximate KNN over PySpark DataFrames.",
+    "IVF-Flat approximate KNN over PySpark DataFrames — daemon-fed fit "
+    "(device-side quantizer + bucketize), daemon-served queries.",
+    daemon_algo="knn",
 )
 SparkStandardScaler = _make_wrapper(
     "SparkStandardScaler", _StandardScaler,
