@@ -70,11 +70,55 @@ def main() -> None:
         return w
 
     dt_per_iter = slope_dt(run, ITERS, 2 * ITERS)
+
+    # -- multinomial MM-Newton pass (streamed-protocol kernel) -------------
+    # Per pass: gradient GEMM + C per-class weighted Grams ≈ 2·C·n·d²
+    # flops; the same A100 sustained-GEMM convention gives the baseline.
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        _stream_multinomial_step_fn,
+        _stream_softmax_stats_fn,
+        stream_softmax_zero_state,
+    )
+
+    C = int(os.environ.get("SRML_BENCH_CLASSES", 8))
+    rows_mm = int(os.environ.get("SRML_BENCH_MM_ROWS", ROWS // 4))
+    x_mm = jax.random.normal(jax.random.key(2), (rows_mm, D), dtype=jnp.float32)
+    y_mm = jax.random.randint(jax.random.key(3), (rows_mm,), 0, C).astype(
+        jnp.float32
+    )
+    mask_mm = jnp.ones((rows_mm,), jnp.float32)
+    if n_chips > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x_mm = jax.device_put(x_mm, NamedSharding(mesh, P("data", None)))
+        y_mm = jax.device_put(y_mm, NamedSharding(mesh, P("data")))
+        mask_mm = jax.device_put(mask_mm, NamedSharding(mesh, P("data")))
+    update = _stream_softmax_stats_fn(mesh, C, "float32")
+    mm_step = _stream_multinomial_step_fn(1e-4, True, "float32")
+
+    def run_mm(n):
+        W = jnp.zeros((D, C), jnp.float32)
+        b = jnp.zeros((C,), jnp.float32)
+        for _ in range(n):
+            state = stream_softmax_zero_state(D, C, jnp.float32)
+            gw, gb, hw, hwb, hbb, _, nn = update(state, W, b, x_mm, y_mm, mask_mm)
+            W, b, _ = mm_step(gw, gb, hw, hwb, hbb, nn, W, b)
+        sync(W)
+        return W
+
+    mm_iters = max(2, ITERS // 2)
+    dt_mm = slope_dt(run_mm, mm_iters, 2 * mm_iters)
+    a100_mm = 110e12 / (2 * C * D * D)
     emit(
         f"logreg_newton_row_iters_per_sec_per_chip_d{D}",
         ROWS / dt_per_iter / n_chips,
         "row_iters/s/chip",
         (ROWS / dt_per_iter / n_chips) / A100_ROW_ITERS_PER_SEC,
+        multinomial_classes=C,
+        multinomial_row_iters_per_sec_per_chip=round(
+            rows_mm / dt_mm / n_chips, 1
+        ),
+        multinomial_vs_baseline=round((rows_mm / dt_mm / n_chips) / a100_mm, 4),
     )
 
 

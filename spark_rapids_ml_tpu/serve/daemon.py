@@ -133,13 +133,28 @@ class _Job:
             )
             self.state = self._kmeans_zero_state()
         elif algo == "logreg":
-            from spark_rapids_ml_tpu.models.logistic_regression import (
-                _stream_grad_hess_fn,
-            )
+            # n_classes > 2 switches the job to the multinomial MM-Newton
+            # protocol (same feed/step/finalize op sequence; the state is
+            # per-class, see models.logistic_regression).
+            self.n_classes = int(params.get("n_classes") or 2)
+            if self.n_classes > 2:
+                from spark_rapids_ml_tpu.models.logistic_regression import (
+                    _stream_softmax_stats_fn,
+                )
 
-            self.w = jnp.zeros((n_cols,), self._accum)
-            self.b = jnp.zeros((), self._accum)
-            self.update = _stream_grad_hess_fn(mesh, config.get("accum_dtype"))
+                self.w = jnp.zeros((n_cols, self.n_classes), self._accum)
+                self.b = jnp.zeros((self.n_classes,), self._accum)
+                self.update = _stream_softmax_stats_fn(
+                    mesh, self.n_classes, config.get("accum_dtype")
+                )
+            else:
+                from spark_rapids_ml_tpu.models.logistic_regression import (
+                    _stream_grad_hess_fn,
+                )
+
+                self.w = jnp.zeros((n_cols,), self._accum)
+                self.b = jnp.zeros((), self._accum)
+                self.update = _stream_grad_hess_fn(mesh, config.get("accum_dtype"))
             self.state = self._logreg_zero_state()
         elif algo == "knn":
             # KNN's "sufficient statistic" IS the dataset (the model is the
@@ -159,6 +174,14 @@ class _Job:
         return stream_zero_state(self.k, self.n_cols, self._accum)
 
     def _logreg_zero_state(self):
+        if getattr(self, "n_classes", 2) > 2:
+            from spark_rapids_ml_tpu.models.logistic_regression import (
+                stream_softmax_zero_state,
+            )
+
+            return stream_softmax_zero_state(
+                self.n_cols, self.n_classes, self._accum
+            )
         from spark_rapids_ml_tpu.models.logistic_regression import stream_zero_state
 
         return stream_zero_state(self.n_cols, self._accum)
@@ -407,16 +430,35 @@ class _Job:
                 }
                 self.pass_rows = 0
                 return info
+            reg = float(params.get("reg", 0.0))
+            fit_intercept = bool(params.get("fit_intercept", True))
+            if getattr(self, "n_classes", 2) > 2:
+                from spark_rapids_ml_tpu.models.logistic_regression import (
+                    _stream_multinomial_step_fn,
+                    stream_softmax_objective,
+                )
+
+                gw, gb, hw, hwb, hbb, lsum, n = self.state
+                mm = _stream_multinomial_step_fn(reg, fit_intercept, self._accum.name)
+                loss = stream_softmax_objective(lsum, n, reg, self.w)
+                self.w, self.b, delta = mm(gw, gb, hw, hwb, hbb, n, self.w, self.b)
+                self.state = self._logreg_zero_state()
+                self.iteration += 1
+                info = {
+                    "iteration": self.iteration,
+                    "delta": float(delta),
+                    "loss": loss,
+                    "pass_rows": self.pass_rows,
+                }
+                self.pass_rows = 0
+                return info
             from spark_rapids_ml_tpu.models.logistic_regression import (
                 _stream_newton_step_fn,
                 stream_objective,
             )
 
-            reg = float(params.get("reg", 0.0))
             gw, gb, hww, hwb, hbb, lsum, n = self.state
-            newton = _stream_newton_step_fn(
-                reg, bool(params.get("fit_intercept", True)), self._accum.name
-            )
+            newton = _stream_newton_step_fn(reg, fit_intercept, self._accum.name)
             loss = stream_objective(lsum, n, reg, self.w)
             self.w, self.b, delta = newton(gw, gb, hww, hwb, hbb, n, self.w, self.b)
             self.state = self._logreg_zero_state()
@@ -499,9 +541,16 @@ class _Job:
                 "n_iter": np.asarray([self.iteration]),
             }
         if self.algo == "logreg":
+            w = np.asarray(jax.device_get(self.w))
+            b = np.asarray(jax.device_get(self.b))
+            if getattr(self, "n_classes", 2) > 2:
+                # Spark layout: (C, d) coefficientMatrix + (C,) intercepts.
+                w, b = w.T, b.reshape(-1)
+            else:
+                b = b.reshape(1)
             return {
-                "coefficients": np.asarray(jax.device_get(self.w)),
-                "intercept": np.asarray(jax.device_get(self.b)).reshape(1),
+                "coefficients": w,
+                "intercept": b,
                 "n_iter": np.asarray([self.iteration]),
             }
         if self.algo == "pca" and params.get("raw_moments"):
@@ -900,6 +949,9 @@ class DataPlaneDaemon:
         input_col = _opt(req, "input_col", "features")
         x = table_column_to_matrix(table, input_col, req.get("n_cols"))
         req_algo = str(_opt(req, "algo", "pca"))
+        # Single parse shared by label validation and the job-mismatch
+        # guard below, so the two can't disagree on the coercion rule.
+        req_classes = int((req.get("params") or {}).get("n_classes") or 2)
         # Validate the batch BEFORE registering a job, so a rejected first
         # feed doesn't leave an orphan empty job (with its d×d device
         # buffers) parked under the name forever.
@@ -910,11 +962,18 @@ class DataPlaneDaemon:
                 raise KeyError(f"label column {label_col!r} not in batch")
             y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
             if req_algo == "logreg":
-                from spark_rapids_ml_tpu.models.logistic_regression import (
-                    validate_binary_labels,
-                )
+                if req_classes > 2:
+                    from spark_rapids_ml_tpu.models.logistic_regression import (
+                        validate_multiclass_labels,
+                    )
 
-                validate_binary_labels(y)
+                    validate_multiclass_labels(y, req_classes)
+                else:
+                    from spark_rapids_ml_tpu.models.logistic_regression import (
+                        validate_binary_labels,
+                    )
+
+                    validate_binary_labels(y)
         if req_algo == "kmeans":
             # Validate the seeding constraint BEFORE registering: a first
             # batch smaller than k must not leave an orphan centerless job
@@ -937,6 +996,12 @@ class DataPlaneDaemon:
             raise ValueError(
                 f"job {name!r} is algo {job.algo!r}; feed requested {req_algo!r}"
             )
+        if req_algo == "logreg":
+            if req_classes != getattr(job, "n_classes", 2):
+                raise ValueError(
+                    f"job {name!r} has n_classes={job.n_classes}; "
+                    f"feed carried n_classes={req_classes}"
+                )
         part = req.get("partition")
         job.fold(
             x,

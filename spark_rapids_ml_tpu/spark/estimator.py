@@ -146,6 +146,40 @@ class _FeedTask:
         )
 
 
+class _LabelMaxTask:
+    """O(1)-result label scan: each task reports its partitions' max
+    label. One tiny Spark job, like the reference's numCols probe
+    (RapidsPCA.scala:73-74) — how the driver learns n_classes without
+    collecting labels."""
+
+    def __init__(self, label_col):
+        self._label = label_col
+
+    def __call__(self, batches):
+        import numpy as np
+        import pyarrow as pa
+
+        mx = -1.0
+        for batch in batches:
+            if batch.num_rows:
+                arr = np.asarray(
+                    pa.Table.from_batches([batch])
+                    .column(self._label)
+                    .to_numpy(zero_copy_only=False)
+                )
+                if arr.size:
+                    mx = max(mx, float(np.max(arr)))
+        yield pa.RecordBatch.from_pydict({"maxlabel": pa.array([mx], pa.float64())})
+
+
+def _probe_num_classes(df, label_col) -> int:
+    acks = df.select(label_col).mapInArrow(
+        _LabelMaxTask(label_col), "maxlabel double"
+    ).collect()
+    mx = max((float(r["maxlabel"]) for r in acks), default=-1.0)
+    return max(int(mx) + 1, 2)
+
+
 class _SparkAdapter:
     """Wraps a core estimator class with Spark DataFrame in/out.
 
@@ -267,6 +301,12 @@ class _SparkAdapter:
         feed_params = {}
         client = DataPlaneClient(host, port, token=token)
         try:
+            if algo == "logreg":
+                # Spark ML infers numClasses from the labels; here one
+                # O(1)-result probe job (per-partition max) picks the
+                # binary-Newton vs multinomial-MM daemon protocol.
+                n_classes = _probe_num_classes(sel, label_col)
+                feed_params = {"n_classes": n_classes}
             if algo == "kmeans":
                 k = core.getK()
                 feed_params = {
@@ -398,9 +438,15 @@ class _SparkAdapter:
                     LogisticTrainingSummary,
                 )
 
+                coef = arrays["coefficients"]
                 model = LogisticRegressionModel(
-                    coefficients=arrays["coefficients"],
-                    intercept=float(arrays["intercept"][0]),
+                    coefficients=coef,
+                    # Binary: scalar; multinomial ((C, d) coef): (C,) vector.
+                    intercept=(
+                        float(arrays["intercept"][0])
+                        if coef.ndim == 1
+                        else np.asarray(arrays["intercept"])
+                    ),
                 )
                 model._summary = LogisticTrainingSummary(
                     loss=info["loss"], numIter=info["iteration"], n_rows=rows

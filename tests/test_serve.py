@@ -339,3 +339,48 @@ def test_model_serving_params_configure_the_served_copy(daemon, data, mesh8):
         np.testing.assert_allclose(
             outs["output"], model.transform_matrix(data[:64])["output"], atol=0
         )
+
+
+def test_multinomial_iterative_job_matches_stream_fit(daemon, rng, mesh8):
+    """logreg job with n_classes>2 runs the multinomial MM-Newton
+    protocol; the daemon-driven loop must match fit_multinomial_stream."""
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        fit_multinomial_stream,
+    )
+
+    n, d, C = 480, 5, 3
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=(d, C)) * 2
+    y = np.argmax(x @ w, axis=1).astype(np.float64)
+    reg, iters = 0.02, 6
+
+    def src():
+        return iter([(x[i : i + 120], y[i : i + 120]) for i in range(0, n, 120)])
+
+    ref = fit_multinomial_stream(
+        src, d, C, reg=reg, max_iter=iters, tol=0.0, mesh=mesh8
+    )
+    params = {"n_classes": C}
+    with _client(daemon) as c:
+        for it in range(iters):
+            for i in range(0, n, 120):
+                c.feed(
+                    "mm-job", (x[i : i + 120], y[i : i + 120]), algo="logreg",
+                    params=params, pass_id=it,
+                )
+            info = c.step("mm-job", params={"reg": reg, "fit_intercept": True})
+        assert info["iteration"] == iters
+        arrays = c.finalize_logreg("mm-job")
+    assert arrays["coefficients"].shape == (C, d)
+    np.testing.assert_allclose(arrays["coefficients"], ref.coefficients, atol=1e-9)
+    np.testing.assert_allclose(arrays["intercept"], ref.intercept, atol=1e-9)
+    assert int(arrays["n_iter"][0]) == iters
+
+
+def test_logreg_n_classes_mismatch_rejected(daemon, rng):
+    x = rng.normal(size=(60, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    with _client(daemon) as c:
+        c.feed("cls-job", (x, y), algo="logreg", params={"n_classes": 3})
+        with pytest.raises(RuntimeError, match="n_classes"):
+            c.feed("cls-job", (x, y), algo="logreg", params={"n_classes": 4})

@@ -361,3 +361,38 @@ def test_spark_knn_fit_survives_task_retry(rng, mesh8):
     d2_, i2 = m2.kneighbors(q)
     np.testing.assert_array_equal(i1, i2)
     np.testing.assert_allclose(d1, d2_, atol=0)
+
+
+def test_spark_logreg_multiclass_fit_and_transform(rng, mesh8):
+    """3-class labels route the distributed fit through the multinomial
+    MM-Newton daemon protocol (n_classes probed with an O(1) Spark job)
+    and the served transform returns C-wide probability vectors."""
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        fit_multinomial_stream,
+    )
+
+    n, d, C = 600, 6, 3
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    w = rng.normal(size=(d, C)) * 2
+    y = np.argmax(x @ w, axis=1).astype(np.float64)
+    df = simdf_from_numpy(x, n_partitions=3, label=y)
+    model = (
+        SparkLogisticRegression().setRegParam(1e-2).setMaxIter(15).fit(df)
+    )
+    assert df.sparkSession.driver_rows_materialized == 0
+    assert model.coefficients.shape == (C, d)
+    assert model.numClasses == C
+
+    def src():
+        return iter([(x[i : i + 200], y[i : i + 200]) for i in range(0, n, 200)])
+
+    ref = fit_multinomial_stream(
+        src, d, C, reg=1e-2, max_iter=15, tol=1e-6, mesh=mesh8
+    )
+    np.testing.assert_allclose(model.coefficients, ref.coefficients, atol=1e-6)
+    rows = model.transform(df).collect()
+    proba = np.asarray([r["probability"] for r in rows])
+    pred = np.asarray([r["prediction"] for r in rows])
+    assert proba.shape == (n, C)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-9)
+    assert (pred == y).mean() > 0.95
