@@ -290,20 +290,7 @@ def fit_pca_stream(
     skip_batches = 0
     if checkpoint_path:
         restored = ckpt.load_state(checkpoint_path)
-        if multiproc:
-            # Every process must resume identically or the lockstep scans
-            # desync — a missing file on one host is a config error
-            # (non-shared checkpoint path), not a silent fresh start.
-            from jax.experimental import multihost_utils as mhu
-
-            flags = np.asarray(
-                mhu.process_allgather(np.asarray([int(restored is not None)]))
-            )
-            if flags.any() != flags.all():
-                raise RuntimeError(
-                    "checkpoint visible on some hosts but not others; "
-                    "checkpoint_path must be on a shared filesystem"
-                )
+        ckpt.require_consistent_visibility(restored)
         if restored is not None:
             arrays, meta = restored
             if meta.get("n_cols") != n_cols:

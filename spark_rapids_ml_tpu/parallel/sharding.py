@@ -165,26 +165,40 @@ def lockstep_labeled_batches(batches, n_cols: int, check=None):
     while True:
         pair = next(it, None)
         code, ok = -1, 1
+        cast_err = None
         if pair is not None:
             x, y = np.asarray(pair[0]), np.asarray(pair[1]).reshape(-1)
-            code = codes.get(x.dtype, -2)
-            if check is not None and check(x, y):
-                ok = 0
+            if x.dtype not in codes:
+                # Cast non-float sources (e.g. int features) to f32, the
+                # same coercion shard_rows applies — so a pipeline that
+                # works single-process behaves identically on a pod
+                # (r2 advisor: the old -2 code rejected here only). An
+                # uncastable dtype is carried THROUGH the allgather like
+                # check failures, so every host raises together instead
+                # of the rest hanging in the collective.
+                try:
+                    x = x.astype(np.float32)
+                except (ValueError, TypeError) as e:
+                    cast_err = (
+                        f"lockstep: batch dtype {np.asarray(pair[0]).dtype} "
+                        f"is not castable to float32: {e}"
+                    )
+                    ok = 0
+            if cast_err is None:
+                code = codes[x.dtype]
+                if check is not None and check(x, y):
+                    ok = 0
         flags = np.asarray(mhu.process_allgather(np.asarray([
             0 if pair is None else 1, code, ok,
         ]))).reshape(-1, 3)
         if (flags[:, 2] == 0).any():
             bad = int(np.argmax(flags[:, 2] == 0))
             # Re-derive the local message when this host is the bad one.
-            msg = (check(x, y) if pair is not None and ok == 0 else None)
+            msg = None
+            if pair is not None and ok == 0:
+                msg = cast_err or check(x, y)
             raise ValueError(
                 msg or f"batch validation failed on process {bad}"
-            )
-        if (flags[:, 1] == -2).any():
-            bad = int(np.argmax(flags[:, 1] == -2))
-            raise TypeError(
-                f"lockstep: process {bad} supplied an unsupported batch "
-                "dtype (expected float16/32/64)"
             )
         live = flags[flags[:, 0] == 1, 1]
         if live.size and live.min() != live.max():

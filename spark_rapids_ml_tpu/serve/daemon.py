@@ -354,6 +354,11 @@ class _Job:
                 self.pass_rows += n
             else:
                 self.staged[(partition, attempt)] = (state, extra_rows + n)
+            # Refresh again on exit: the device update above can dominate
+            # the op (first-compile can take tens of seconds), and a
+            # touched stamp from the op's START would make a busy job look
+            # idle the instant it finishes.
+            self.touched = time.monotonic()
 
     def commit(
         self, partition: int, attempt: int = 0, pass_id: Optional[int] = None
@@ -758,36 +763,27 @@ class DataPlaneDaemon:
         interval = max(min(self._ttl / 4.0, 30.0), 0.05)
         while not self._stop.wait(interval):
             now = time.monotonic()
+            evicted = []
+            # Atomic check-and-remove under BOTH locks (round-2 advisor:
+            # the old pop-then-revalidate left a window where a concurrent
+            # feed saw "no such job" or recreated the name and lost rows).
+            # Lock order is registry → job everywhere; the non-blocking
+            # acquire skips jobs mid-op (their touched is being refreshed
+            # anyway) instead of stalling the registry.
             with self._jobs_lock:
-                stale = [
-                    name
-                    for name, job in self._jobs.items()
-                    if now - job.touched > self._ttl
-                ]
-                evicted = [(name, self._jobs.pop(name)) for name in stale]
-            for name, job in evicted:
-                with job.lock:
-                    # Revalidate under job.lock: an op ack'd between the
-                    # stale scan and here refreshed `touched` — its rows
-                    # were accepted, so the job must survive (reinsert).
+                for name, job in list(self._jobs.items()):
                     if now - job.touched <= self._ttl:
-                        with self._jobs_lock:
-                            cur = self._jobs.setdefault(name, job)
-                        if cur is not job:
-                            # A feed recreated the name in the window; the
-                            # old job's state cannot be merged into the
-                            # new one — poison it LOUDLY so late feeds /
-                            # finalize on it error instead of silently
-                            # diverging from the fresh job.
-                            job.dropped = True
-                            logger.error(
-                                "job %r was recreated while the reaper held "
-                                "its evicted predecessor; %d previously-fed "
-                                "rows are lost — finalize will see only the "
-                                "new job's rows", name, job.rows,
-                            )
                         continue
-                    job.dropped = True
+                    if not job.lock.acquire(blocking=False):
+                        continue  # op in flight — it refreshes touched
+                    try:
+                        if now - job.touched > self._ttl:
+                            job.dropped = True
+                            del self._jobs[name]
+                            evicted.append((name, job))
+                    finally:
+                        job.lock.release()
+            for name, job in evicted:
                 logger.warning(
                     "evicted idle job %r (%.1fs > ttl %.1fs, %d rows fed)",
                     name, now - job.touched, self._ttl, job.rows,

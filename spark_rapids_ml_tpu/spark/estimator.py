@@ -403,20 +403,27 @@ class _SparkAdapter:
                     info = client.step(job)
                     if info["moved2"] <= tol2:
                         break
+                # One final cost-only scan at the UPDATED centers (r2
+                # advisor: step() evaluates cost against the pre-update
+                # centers, so the last step's cost is one Lloyd iteration
+                # stale). finalize reads the unstepped pass's inertia —
+                # the exact fit_kmeans_stream trainingCost semantics.
+                n_rows = run_pass(info["iteration"])
                 arrays = client.finalize_kmeans(job)
+                cost = float(arrays["cost"][0])
                 from spark_rapids_ml_tpu.models.kmeans import (
                     KMeansModel,
                     KMeansSummary,
                 )
 
                 model = KMeansModel(centers=arrays["centers"])
-                model._training_cost = info["cost"]
+                model._training_cost = cost
                 model._n_iter = info["iteration"]
                 model._summary = KMeansSummary(
-                    trainingCost=info["cost"],
+                    trainingCost=cost,
                     numIter=info["iteration"],
                     k=core.getK(),
-                    n_rows=info.get("pass_rows", 0),
+                    n_rows=n_rows,
                 )
             else:  # logreg
                 info = {"loss": float("nan"), "iteration": 0}
