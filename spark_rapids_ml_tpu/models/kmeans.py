@@ -129,7 +129,7 @@ def _lloyd_block_n(m_local: int, d: int, k_pad: int, itemsize: int) -> int:
     resident sums accumulator and centers block."""
     from spark_rapids_ml_tpu.ops.pallas_kernels import LLOYD_STEP_BLOCK_N
 
-    for b in (LLOYD_STEP_BLOCK_N, 2048, 1024, 512, 256, 128):
+    for b in (16384, 8192, LLOYD_STEP_BLOCK_N, 2048, 1024, 512, 256, 128):
         if m_local % b:
             continue
         vmem = (

@@ -204,7 +204,9 @@ def gram_colsum_pallas(
 # ---------------------------------------------------------------------------
 
 
-def _lloyd_step_kernel(nvalid_ref, x_ref, c_ref, c2_ref, sums_ref, counts_ref, *, block_n):
+def _lloyd_step_kernel(
+    nvalid_ref, x_ref, c_ref, c2h_ref, sums_ref, counts_ref, *, block_n, dead_lane
+):
     @pl.when(pl.program_id(0) == 0)
     def _init():
         sums_ref[:] = jnp.zeros_like(sums_ref)
@@ -217,24 +219,36 @@ def _lloyd_step_kernel(nvalid_ref, x_ref, c_ref, c2_ref, sums_ref, counts_ref, *
     def _accumulate():
         xb = x_ref[:]  # (bn, d) compute dtype
         c = c_ref[:]  # (k_pad, d) compute dtype; padded rows are zeros
+        # TRANSPOSED distance layout (k_pad, bn): the argmin then reduces
+        # over the SUBLANE axis instead of the 128-lane axis — sublane
+        # reductions are the cheap direction on the VPU, and the profile
+        # at d=256/k=100 was assignment(VPU)-bound, not MXU-bound.
         xc = jax.lax.dot_general(
-            xb, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            c, xb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
             precision=_dot_prec(xb.dtype),
-        )  # (bn, k_pad)
-        # ||x-c||² up to the row-constant ||x||²: argmin-invariant. Padded
-        # centers carry c2 = LLOYD_PAD_D2 so they never win.
-        d2 = c2_ref[:] - 2.0 * xc
-        assign = jnp.argmin(d2, axis=1).astype(jnp.int32)  # (bn,)
-        ks = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
-        rows = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0) + row0
-        onehot = jnp.where(
-            (ks == assign[:, None]) & (rows < nv), 1.0, 0.0
-        ).astype(xb.dtype)  # (bn, k_pad)
+        )  # (k_pad, bn)
+        # ½‖x−c‖² up to the row-constant ½‖x‖²: argmin-invariant; the ½c²
+        # is precomputed host-side (one VPU subtract per element here).
+        # Padded centers carry c2h = LLOYD_PAD_D2 so they never win.
+        d2 = c2h_ref[:] - xc  # (k_pad, bn); c2h is (k_pad, 1)
+        assign = jnp.argmin(d2, axis=0).astype(jnp.int32)[None, :]  # (1, bn)
+        cols = jax.lax.broadcasted_iota(jnp.int32, assign.shape, 1) + row0
+        ks = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0)
+        if dead_lane is not None:
+            # Padded rows (x = 0) would argmin to the min-norm REAL center
+            # and pollute counts; with k < k_pad a spare center row exists
+            # — route them there ((1, bn) compare) and skip the
+            # (k_pad, bn) row-mask pass entirely (sums[k:] are discarded
+            # by the caller).
+            assign = jnp.where(cols < nv, assign, dead_lane)
+            onehot = (ks == assign).astype(xb.dtype)  # (k_pad, bn)
+        else:
+            onehot = ((ks == assign) & (cols < nv)).astype(xb.dtype)
         sums_ref[:] += jax.lax.dot_general(
-            onehot, xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            onehot, xb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
             precision=_dot_prec(xb.dtype),
         )
-        counts_ref[:] += jnp.sum(onehot.astype(jnp.float32), axis=0, keepdims=True)
+        counts_ref[:] += jnp.sum(onehot.astype(jnp.float32), axis=1)[None, :]
 
 
 LLOYD_PAD_D2 = 1e30  # finite sentinel: padded centers never win the argmin
@@ -272,19 +286,24 @@ def lloyd_step_pallas(
         raise ValueError(f"n={n} not divisible by block_n={bn}")
     if k_pad % 128:
         raise ValueError(f"k_pad={k_pad} must be a multiple of 128 lanes")
-    c2 = jnp.sum(jnp.square(centers.astype(jnp.float32)), axis=1, keepdims=True).T
-    ks = jax.lax.broadcasted_iota(jnp.int32, c2.shape, 1)
-    c2 = jnp.where(ks < k, c2, LLOYD_PAD_D2)
+    c2h = 0.5 * jnp.sum(
+        jnp.square(centers.astype(jnp.float32)), axis=1, keepdims=True
+    )  # (k_pad, 1) — column vector for the transposed (k_pad, bn) layout
+    ks = jax.lax.broadcasted_iota(jnp.int32, c2h.shape, 0)
+    c2h = jnp.where(ks < k, c2h, LLOYD_PAD_D2)
     nv = jnp.asarray(n_valid, jnp.int32).reshape((1,))
     sums, counts = pl.pallas_call(
-        functools.partial(_lloyd_step_kernel, block_n=bn),
+        functools.partial(
+            _lloyd_step_kernel, block_n=bn,
+            dead_lane=k if k < k_pad else None,
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n // bn,),
             in_specs=[
                 pl.BlockSpec((bn, d), lambda i, nv: (i, 0)),
                 pl.BlockSpec((k_pad, d), lambda i, nv: (0, 0)),
-                pl.BlockSpec((1, k_pad), lambda i, nv: (0, 0)),
+                pl.BlockSpec((k_pad, 1), lambda i, nv: (0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((k_pad, d), lambda i, nv: (0, 0)),
@@ -301,7 +320,7 @@ def lloyd_step_pallas(
         if not interpret
         else None,
         interpret=interpret,
-    )(nv, x, centers, c2)
+    )(nv, x, centers, c2h)
     return sums, counts[0]
 
 

@@ -255,7 +255,7 @@ class _Job:
                 return  # idempotent: a retried seed keeps the first init
             c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
             self.centers = jnp.asarray(c0, self._accum)
-            self.touched = time.monotonic()
+            self.touched = time.monotonic()  # exit stamp (init can be slow)
 
     def fold(
         self,
@@ -394,6 +394,7 @@ class _Job:
             # losing attempts' stages for this partition free their buffers
             for key in [k for k in self.staged if k[0] == partition]:
                 del self.staged[key]
+            self.touched = time.monotonic()  # exit stamp (see fold)
             return self.rows
 
     def step(self, params: Dict[str, Any]) -> Dict[str, Any]:
@@ -434,6 +435,7 @@ class _Job:
                     "pass_rows": self.pass_rows,
                 }
                 self.pass_rows = 0
+                self.touched = time.monotonic()  # exit stamp (see fold)
                 return info
             reg = float(params.get("reg", 0.0))
             fit_intercept = bool(params.get("fit_intercept", True))
@@ -456,6 +458,7 @@ class _Job:
                     "pass_rows": self.pass_rows,
                 }
                 self.pass_rows = 0
+                self.touched = time.monotonic()  # exit stamp (see fold)
                 return info
             from spark_rapids_ml_tpu.models.logistic_regression import (
                 _stream_newton_step_fn,
@@ -475,6 +478,7 @@ class _Job:
                 "pass_rows": self.pass_rows,
             }
             self.pass_rows = 0
+            self.touched = time.monotonic()  # exit stamp (see fold)
             return info
 
     def build_knn_model(self, params: Dict[str, Any]):

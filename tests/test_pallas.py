@@ -111,8 +111,14 @@ def test_lloyd_step_parity(rng):
         np.add.at(ref_counts, lab[:n_valid], 1)
         np.testing.assert_allclose(np.asarray(counts)[:k], ref_counts)
         np.testing.assert_allclose(np.asarray(sums)[:k], ref_sums, rtol=1e-4, atol=1e-2)
-        # padded centers never win the argmin
-        assert float(np.asarray(counts)[k:].sum()) == 0.0
+        # Dead-lane contract: invalid rows of processed blocks are routed
+        # to lane k (cheaper than a (bn, k_pad) row mask); that lane's
+        # sums/counts carry their garbage and are DISCARDED by callers
+        # (models/kmeans slices [:k]). Other padded lanes never win.
+        processed = -(-min(n_valid, m) // 256) * 256
+        assert float(np.asarray(counts)[k]) == float(processed - n_valid)
+        assert float(np.asarray(counts)[k + 1:].sum()) == 0.0
+        np.testing.assert_allclose(np.asarray(sums)[k + 1:], 0.0, atol=1e-6)
 
 
 def test_lloyd_step_block_validation(rng):
