@@ -98,16 +98,72 @@ def main() -> None:
     assert pc.shape == (D, K) and np.all(np.isfinite(pc))
 
     rows_per_sec_per_chip = N_BATCHES * BATCH_ROWS / dt / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": f"pca_fit_streaming_rows_per_sec_per_chip_d{D}_k{K}",
-                "value": round(rows_per_sec_per_chip, 1),
-                "unit": "rows/s/chip",
-                "vs_baseline": round(rows_per_sec_per_chip / A100_CUML_ROWS_PER_SEC, 4),
-            }
-        )
+    line = {
+        "metric": f"pca_fit_streaming_rows_per_sec_per_chip_d{D}_k{K}",
+        "value": round(rows_per_sec_per_chip, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(rows_per_sec_per_chip / A100_CUML_ROWS_PER_SEC, 4),
+    }
+    if os.environ.get("SRML_BENCH_INGEST", "") in ("1", "true"):
+        line.update(_ingest_inclusive(mesh, update))
+    print(json.dumps(line))
+
+
+def _ingest_inclusive(mesh, update):
+    """Optional ingest-inclusive measurement (SRML_BENCH_INGEST=1): real
+    host Arrow batches through bridge/arrow + device_put, double-buffered
+    against the device fold — the end-to-end feed the compute-only
+    headline deliberately excludes (r2 review weak #5). On the dev
+    harness device_put crosses the axon tunnel at single-digit MB/s; the
+    ``ingest_tunneled`` flag marks such runs (same heuristic as
+    bench_ingest.py) so the number is read as the tunnel's, not the
+    architecture's.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    from spark_rapids_ml_tpu.bridge.arrow import (
+        matrix_to_list_column,
+        table_column_to_matrix,
     )
+    from spark_rapids_ml_tpu.ops import gram as gram_ops
+
+    rows = int(os.environ.get("SRML_BENCH_INGEST_ROWS", 1 << 16))
+    n_b = int(os.environ.get("SRML_BENCH_INGEST_BATCHES", 8))
+    rng = np.random.default_rng(0)
+    host = rng.standard_normal((rows, D), dtype=np.float32)
+    tables = [
+        pa.table({"features": matrix_to_list_column(host)}) for _ in range(2)
+    ]
+
+    import ml_dtypes
+
+    def put(i):
+        mat = table_column_to_matrix(tables[i % 2], "features")
+        # Quantize-on-ingest: cast to bfloat16 ON THE HOST so the wire
+        # carries 2 bytes/element (the design the headline documents);
+        # a device-side cast would transfer f32 and double the bytes.
+        return jax.device_put(mat.astype(ml_dtypes.bfloat16))
+
+    state = gram_ops.init_stats(D, accum_dtype="float32")
+    nxt = put(0)
+    t0 = time.perf_counter()
+    for i in range(n_b):
+        cur = nxt
+        if i + 1 < n_b:
+            nxt = put(i + 1)  # overlap next transfer with this fold
+        state = update(state, cur, rows)
+    jax.device_get(state[0])  # sync (block_until_ready unreliable here)
+    dt = time.perf_counter() - t0
+    bps = n_b * rows * D * 2 / dt
+    return {
+        "ingest_rows_per_sec": round(n_b * rows / dt, 1),
+        "ingest_batch_rows": rows,
+        "ingest_tunneled": bool(bps < 1e9),
+    }
 
 
 if __name__ == "__main__":

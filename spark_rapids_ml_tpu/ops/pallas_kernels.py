@@ -268,8 +268,13 @@ def lloyd_step_pallas(
 
     x: (n, d) compute dtype; centers: (k_pad, d) compute dtype whose rows
     beyond the true ``k`` are padding — they are excluded from the argmin
-    via a LLOYD_PAD_D2 distance sentinel. Rows ≥ n_valid are skipped (whole
-    blocks past the boundary skip their GEMMs entirely).
+    via a LLOYD_PAD_D2 distance sentinel. Whole blocks past n_valid skip
+    their GEMMs entirely; invalid rows of the boundary block are routed
+    to the DEAD LANE ``k`` when k < k_pad (cheaper than a (bn, k_pad)
+    row mask), so **sums[k]/counts[k] carry their garbage and callers
+    MUST slice [:k]** (counts.sum() is NOT the valid-row count; lanes
+    k+1.. stay zero). When k == k_pad the row-mask path runs instead and
+    all lanes are exact.
 
     Per block: pairwise-distance GEMM → argmin → one-hot → centroid-sum
     GEMM, with the (k_pad, d) sums and (1, k_pad) counts accumulators

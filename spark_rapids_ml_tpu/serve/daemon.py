@@ -79,12 +79,16 @@ def _opt(req: Dict[str, Any], key: str, default):
 class _Job:
     """One accumulation job: device state + its fold function + a lock."""
 
-    def __init__(self, algo: str, n_cols: int, mesh, params: Optional[Dict[str, Any]] = None):
+    def __init__(
+        self, algo: str, n_cols: int, mesh,
+        params: Optional[Dict[str, Any]] = None, clock=time.monotonic,
+    ):
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu import config
 
         params = params or {}
+        self._clock = clock
         self.algo = algo
         self.n_cols = n_cols
         self.mesh = mesh
@@ -96,7 +100,7 @@ class _Job:
         self.v_sharding = row_sharding(mesh, ndim=1)
         self.iteration = 0
         self.pass_rows = 0
-        self.touched = time.monotonic()
+        self.touched = self._clock()
         # Partition staging (exactly-once under task retry): keyed by
         # (partition, attempt) so CONCURRENT attempts of one partition
         # (Spark speculation runs a duplicate alongside the original)
@@ -255,7 +259,7 @@ class _Job:
                 return  # idempotent: a retried seed keeps the first init
             c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
             self.centers = jnp.asarray(c0, self._accum)
-            self.touched = time.monotonic()  # exit stamp (init can be slow)
+            self.touched = self._clock()  # exit stamp (init can be slow)
 
     def fold(
         self,
@@ -277,7 +281,7 @@ class _Job:
             with self.lock:
                 if self.dropped:
                     raise KeyError("job was finalized/dropped; rows not accepted")
-                self.touched = time.monotonic()
+                self.touched = self._clock()
                 if partition is not None and partition in self.committed:
                     return
                 if partition is None:
@@ -297,7 +301,7 @@ class _Job:
             if self.dropped:
                 raise KeyError("job was finalized/dropped; rows not accepted")
             self._check_pass(pass_id)
-            self.touched = time.monotonic()
+            self.touched = self._clock()
             if partition is not None and partition in self.committed:
                 return  # duplicate of a committed task (retry/speculation)
             if self.algo == "kmeans" and self.centers is None:
@@ -358,7 +362,7 @@ class _Job:
             # the op (first-compile can take tens of seconds), and a
             # touched stamp from the op's START would make a busy job look
             # idle the instant it finishes.
-            self.touched = time.monotonic()
+            self.touched = self._clock()
 
     def commit(
         self, partition: int, attempt: int = 0, pass_id: Optional[int] = None
@@ -370,7 +374,7 @@ class _Job:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
             self._check_pass(pass_id)
-            self.touched = time.monotonic()
+            self.touched = self._clock()
             if partition in self.committed:
                 return self.rows
             staged = self.staged.pop((partition, attempt), None)
@@ -394,7 +398,7 @@ class _Job:
             # losing attempts' stages for this partition free their buffers
             for key in [k for k in self.staged if k[0] == partition]:
                 del self.staged[key]
-            self.touched = time.monotonic()  # exit stamp (see fold)
+            self.touched = self._clock()  # exit stamp (see fold)
             return self.rows
 
     def step(self, params: Dict[str, Any]) -> Dict[str, Any]:
@@ -404,7 +408,7 @@ class _Job:
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
-            self.touched = time.monotonic()
+            self.touched = self._clock()
             if self.algo not in ("kmeans", "logreg"):
                 raise ValueError(
                     f"algo {self.algo!r} is single-pass; step not applicable"
@@ -435,7 +439,7 @@ class _Job:
                     "pass_rows": self.pass_rows,
                 }
                 self.pass_rows = 0
-                self.touched = time.monotonic()  # exit stamp (see fold)
+                self.touched = self._clock()  # exit stamp (see fold)
                 return info
             reg = float(params.get("reg", 0.0))
             fit_intercept = bool(params.get("fit_intercept", True))
@@ -458,7 +462,7 @@ class _Job:
                     "pass_rows": self.pass_rows,
                 }
                 self.pass_rows = 0
-                self.touched = time.monotonic()  # exit stamp (see fold)
+                self.touched = self._clock()  # exit stamp (see fold)
                 return info
             from spark_rapids_ml_tpu.models.logistic_regression import (
                 _stream_newton_step_fn,
@@ -478,7 +482,7 @@ class _Job:
                 "pass_rows": self.pass_rows,
             }
             self.pass_rows = 0
-            self.touched = time.monotonic()  # exit stamp (see fold)
+            self.touched = self._clock()  # exit stamp (see fold)
             return info
 
     def build_knn_model(self, params: Dict[str, Any]):
@@ -490,7 +494,7 @@ class _Job:
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
-            self.touched = time.monotonic()
+            self.touched = self._clock()
             blocks = list(self.state)
             for pid in sorted(self.part_rows):
                 blocks.extend(self.part_rows[pid])
@@ -647,7 +651,11 @@ class _ServedModel:
     (RapidsPCA.scala:128-161 → rapidsml_jni.cu:75-107), minus its
     per-batch PC re-upload (rapidsml_jni.cu:85)."""
 
-    def __init__(self, algo: str, arrays: Dict[str, np.ndarray], params: Dict[str, Any]):
+    def __init__(
+        self, algo: str, arrays: Dict[str, np.ndarray], params: Dict[str, Any],
+        clock=time.monotonic,
+    ):
+        self._clock = clock
         cls = _model_class(algo)
         self.algo = algo
         self.model = cls._from_model_data("served", arrays)
@@ -657,12 +665,12 @@ class _ServedModel:
         if known:
             self.model._set(**known)
         self.lock = threading.Lock()
-        self.touched = time.monotonic()
+        self.touched = self._clock()
         # Re-creatable registration (client holds the arrays): plain TTL.
         self.ttl_scale = 1.0
 
     @classmethod
-    def from_model(cls, algo: str, model) -> "_ServedModel":
+    def from_model(cls, algo: str, model, clock=time.monotonic) -> "_ServedModel":
         """Wrap an already-built core model (daemon-built KNN index) —
         bypasses the arrays/params reconstruction path. NOT re-creatable
         by clients (the source rows were consumed by the build), so the
@@ -670,10 +678,11 @@ class _ServedModel:
         reclaiming the dataset-sized memory; owners should drop_model
         explicitly when done."""
         obj = cls.__new__(cls)
+        obj._clock = clock
         obj.algo = algo
         obj.model = model
         obj.lock = threading.Lock()
-        obj.touched = time.monotonic()
+        obj.touched = clock()
         obj.ttl_scale = 8.0
         return obj
 
@@ -681,12 +690,12 @@ class _ServedModel:
         # Serialize per-model: the jit caches aren't thread-safe to build
         # concurrently; steady-state calls just take the lock briefly.
         with self.lock:
-            self.touched = time.monotonic()
+            self.touched = self._clock()
             return self.model.transform_matrix(x)
 
     def kneighbors(self, queries: np.ndarray, k):
         with self.lock:
-            self.touched = time.monotonic()
+            self.touched = self._clock()
             if not hasattr(self.model, "kneighbors"):
                 raise ValueError(
                     f"model algo {self.algo!r} does not serve kneighbors"
@@ -709,11 +718,17 @@ class DataPlaneDaemon:
         mesh=None,
         ttl: Optional[float] = None,
         token: Optional[str] = None,
+        clock=time.monotonic,
+        reap_interval: Optional[float] = None,
     ):
         self._host, self._port = host, port
         self._mesh = mesh
         self._ttl = ttl
         self._token = token
+        # Injectable clock: TTL tests advance a fake clock instead of
+        # wall-sleeping (r2 review weak #7); production uses monotonic.
+        self._clock = clock
+        self._reap_interval = reap_interval
         self._jobs: Dict[str, _Job] = {}
         self._jobs_lock = threading.Lock()
         self._models: Dict[str, _ServedModel] = {}
@@ -764,9 +779,13 @@ class DataPlaneDaemon:
     def _reap_loop(self) -> None:
         """Evict jobs idle > ttl: a driver that crashed between feed and
         finalize must not leak d×d device buffers forever."""
-        interval = max(min(self._ttl / 4.0, 30.0), 0.05)
+        interval = (
+            self._reap_interval
+            if self._reap_interval is not None
+            else max(min(self._ttl / 4.0, 30.0), 0.05)
+        )
         while not self._stop.wait(interval):
-            now = time.monotonic()
+            now = self._clock()
             evicted = []
             # Atomic check-and-remove under BOTH locks (round-2 advisor:
             # the old pop-then-revalidate left a window where a concurrent
@@ -990,7 +1009,8 @@ class DataPlaneDaemon:
         with self._jobs_lock:
             job = self._jobs.get(name)
             if job is None:
-                job = _Job(req_algo, x.shape[1], self._mesh, req.get("params"))
+                job = _Job(req_algo, x.shape[1], self._mesh, req.get("params"),
+                           clock=self._clock)
                 self._jobs[name] = job
         if job.algo != req_algo:
             raise ValueError(
@@ -1035,7 +1055,8 @@ class DataPlaneDaemon:
         with self._jobs_lock:
             job = self._jobs.get(name)
             if job is None:
-                job = _Job("kmeans", x.shape[1], self._mesh, params)
+                job = _Job("kmeans", x.shape[1], self._mesh, params,
+                           clock=self._clock)
                 self._jobs[name] = job
         job.seed_centers(x)
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
@@ -1052,7 +1073,8 @@ class DataPlaneDaemon:
         with self._models_lock:
             existing = self._models.get(name)
             if existing is None:
-                self._models[name] = _ServedModel(algo, arrays, params)
+                self._models[name] = _ServedModel(algo, arrays, params,
+                                                  clock=self._clock)
                 created = True
             else:
                 if existing.algo != algo:
@@ -1060,7 +1082,7 @@ class DataPlaneDaemon:
                         f"model {name!r} is algo {existing.algo!r}; "
                         f"ensure_model requested {algo!r}"
                     )
-                existing.touched = time.monotonic()
+                existing.touched = existing._clock()
                 created = False
         protocol.send_json(conn, {"ok": True, "created": created})
 
@@ -1147,7 +1169,8 @@ class DataPlaneDaemon:
                         f"model name {name!r} is already registered; "
                         "pick a fresh register_as"
                     )
-                self._models[name] = _ServedModel.from_model(algo, model)
+                self._models[name] = _ServedModel.from_model(algo, model,
+                                                             clock=self._clock)
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
             protocol.send_arrays(

@@ -228,35 +228,54 @@ def test_partitioned_kmeans_requires_seed(daemon, rng):
 
 
 def test_ttl_evicts_abandoned_job(mesh8, data):
-    with DataPlaneDaemon(mesh=mesh8, ttl=0.3) as d:
+    # Injected clock: no wall sleeps — advance fake time past the TTL and
+    # wait only for one (50 ms) reaper tick.
+    clk = {"t": 0.0}
+    with DataPlaneDaemon(
+        mesh=mesh8, ttl=60.0, clock=lambda: clk["t"], reap_interval=0.02
+    ) as d:
         with DataPlaneClient(*d.address) as c:
-            c.feed("leak", data, algo="pca")
-            assert c.status("leak")["rows"] == data.shape[0]
-        # driver "crashes" here; reaper collects the orphan
-        deadline = time.monotonic() + 5.0
-        while time.monotonic() < deadline:
-            try:
-                with DataPlaneClient(*d.address) as c:
-                    c.status("leak")
-                time.sleep(0.1)
-            except RuntimeError:
-                break
-        else:
-            pytest.fail("abandoned job was never evicted")
+            c.feed("abandoned", data, algo="pca")
+            assert c.status("abandoned")["rows"] == data.shape[0]
+            clk["t"] = 61.0  # job now idle past the TTL
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                try:
+                    c.status("abandoned")
+                except RuntimeError:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("idle job was never evicted")
+            with pytest.raises(RuntimeError, match="no such job"):
+                c.finalize_pca("abandoned", k=2)
 
 
 def test_active_job_survives_ttl(mesh8, data):
-    with DataPlaneDaemon(mesh=mesh8, ttl=1.0) as d:
+    clk = {"t": 0.0}
+    with DataPlaneDaemon(
+        mesh=mesh8, ttl=60.0, clock=lambda: clk["t"], reap_interval=0.02
+    ) as d:
         with DataPlaneClient(*d.address) as c:
-            parts = np.array_split(data, 4)
-            for pid, part in enumerate(parts):
-                c.feed("live", part, algo="pca", partition=pid)
-                c.commit("live", partition=pid)
-                time.sleep(0.4)  # slower than ttl/4, faster than ttl
-            assert c.status("live")["rows"] == data.shape[0]
-
-
-# --------------------------------- auth -------------------------------------
+            c.feed("active", data[:200], algo="pca")
+            # keep touching just inside the TTL across several reaper
+            # ticks — alternating feed and partitioned feed+commit so
+            # BOTH touch paths (fold's and commit's exit stamps) are what
+            # keeps the job alive
+            for i in range(4):
+                clk["t"] += 50.0
+                if i % 2 == 0:
+                    c.feed("active", data[:50], algo="pca")
+                else:
+                    c.feed(
+                        "active", data[200 + i * 50 : 250 + i * 50],
+                        algo="pca", partition=i,
+                    )
+                    clk["t"] += 50.0
+                    c.commit("active", partition=i)
+                time.sleep(0.05)  # several reaper ticks at the fake time
+            arrays = c.finalize_pca("active", k=2)
+            assert arrays["pc"].shape == (data.shape[1], 2)
 
 
 def test_token_required_when_configured(mesh8, data):

@@ -69,3 +69,61 @@ def test_real_spark_linreg_fit(spark, rng, mesh8):
     df = spark.createDataFrame(rows, ["features", "label"]).repartition(4)
     model = SparkLinearRegression().setRegParam(1e-6).fit(df)
     np.testing.assert_allclose(model.coefficients, w, atol=1e-4)
+
+
+def test_real_spark_transform_schema_is_derived(pca_df):
+    """Round-3: the output schema comes from the input StructType + the
+    model's declared output fields — no limit(1) probe job, and the
+    declared ArrayType(Double) must match what the tasks actually emit
+    (this exercises _derive_output_schema's pyspark branch, which no
+    sim harness can)."""
+    from pyspark.sql import types as T
+
+    df, x = pca_df
+    model = SparkPCA().setInputCol("features").setK(3).fit(df)
+    out = model.transform(df)
+    field = out.schema["pca_features"]
+    assert isinstance(field.dataType, T.ArrayType)
+    assert isinstance(field.dataType.elementType, T.DoubleType)
+    assert out.count() == x.shape[0]
+
+
+def test_real_spark_logreg_multiclass(spark, rng, mesh8):
+    from spark_rapids_ml_tpu.spark.estimator import SparkLogisticRegression
+
+    n, d, C = 1200, 6, 3
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=(d, C)) * 2
+    y = np.argmax(x @ w, axis=1).astype(float)
+    rows = [(xi.tolist(), float(yi)) for xi, yi in zip(x, y)]
+    df = spark.createDataFrame(rows, ["features", "label"]).repartition(3)
+    model = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(12).fit(df)
+    assert model.coefficients.shape == (C, d)
+    out = model.transform(df).toPandas()
+    proba = np.asarray(out["probability"].tolist())
+    assert proba.shape == (n, C)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-9)
+    assert (np.asarray(out["prediction"]) == np.asarray(out["label"])).mean() > 0.9
+
+
+def test_real_spark_knn_daemon_fed(spark, rng):
+    from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
+
+    n, d, k = 500, 8, 4
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    df = spark.createDataFrame([(r.tolist(),) for r in x], ["features"]).repartition(3)
+    model = SparkNearestNeighbors().setK(k).fit(df)
+    dists, idx = model.kneighbors(x[:16])
+    assert idx.shape == (16, k)
+    # self-distance ~0 (ids are partition-major; repartition reorders rows,
+    # so only the distance property is order-stable)
+    np.testing.assert_allclose(dists[:, 0], 0.0, atol=1e-3)
+
+
+def test_real_spark_transform_local_fallback(pca_df, monkeypatch):
+    df, x = pca_df
+    model = SparkPCA().setInputCol("features").setK(3).fit(df)
+    monkeypatch.setenv("SRML_TRANSFORM_LOCAL", "1")
+    out = model.transform(df)
+    got = np.asarray(out.select("pca_features").toPandas()["pca_features"].tolist())
+    assert got.shape == (x.shape[0], 3)
