@@ -12,9 +12,10 @@ Objective (Spark ML LogisticRegression, ``standardization=False``):
 
     min_w 1/n Σ log(1 + exp(−ŷᵢ·(xᵢw + b))) + λ/2·‖w‖₂²   (binary, L2)
 
-Binary labels are {0, 1}. Multinomial (softmax) uses the same loop with
-full-batch gradient descent + Nesterov momentum (the k·d×k·d Hessian is not
-materialized). Intercept is unpenalized, as in Spark.
+Binary labels are {0, 1}. Multinomial (softmax) runs MM-Newton: the exact
+gradient with per-class upper-bound curvature blocks (the (C·d)² Hessian is
+never materialized — see _stream_softmax_stats_fn). Intercept is
+unpenalized, as in Spark.
 """
 
 from __future__ import annotations
@@ -308,94 +309,6 @@ def _newton_fn_cached(
     return jax.jit(f)
 
 
-@functools.lru_cache(maxsize=32)
-def _softmax_gd_fn(
-    mesh: Mesh, n_classes: int, reg: float, fit_intercept: bool, max_iter: int, tol: float, ad: str
-):
-    """Multinomial softmax via Nesterov full-batch GD, one compiled loop."""
-    accum = jnp.dtype(ad)
-    c = n_classes
-
-    def shard(x, y_onehot, mask):
-        from spark_rapids_ml_tpu.ops.gram import mm_precision
-
-        with mm_precision(accum):  # true-f32 dots (TPU default is bf16)
-            return _shard(x, y_onehot, mask)
-
-    def _shard(x, y_onehot, mask):
-        xc = x.astype(accum)
-        yc = y_onehot.astype(accum)
-        maskc = mask.astype(accum)
-        # Integer sum: an f32 sum of ones saturates at 2^24 rows/shard.
-        n = jax.lax.psum(jnp.sum(maskc.astype(jnp.int32)).astype(accum), DATA_AXIS)
-        d = x.shape[1]
-
-        def grads(w, b):
-            # w: (d, c), b: (c,)
-            logits = xc @ w + b
-            p = jax.nn.softmax(logits, axis=1)
-            r = (p - yc) * maskc[:, None]
-            gw = jax.lax.psum(
-                jax.lax.dot_general(xc, r, (((0,), (0,)), ((), ())),
-                                    preferred_element_type=accum),
-                DATA_AXIS,
-            ) / n + reg * w
-            gb = jax.lax.psum(jnp.sum(r, axis=0), DATA_AXIS) / n
-            if not fit_intercept:
-                gb = jnp.zeros_like(gb)
-            return gw, gb
-
-        # Lipschitz bound for softmax CE: L <= 0.5·λ_max(XᵀX)/n + reg.
-        # Estimate λ_max by power iteration on the psum'd Gram.
-        gram = jax.lax.psum(
-            jax.lax.dot_general(xc * maskc[:, None], xc * maskc[:, None],
-                                (((0,), (0,)), ((), ())),
-                                preferred_element_type=accum),
-            DATA_AXIS,
-        )
-
-        def power(v, _):
-            v = gram @ v
-            return v / jnp.maximum(jnp.linalg.norm(v), 1e-30), None
-
-        v, _ = jax.lax.scan(power, jnp.ones((d,), accum) / jnp.sqrt(d), None, length=30)
-        lmax = jnp.maximum(v @ (gram @ v), 1e-12)
-        step = 1.0 / (0.5 * lmax / n + reg + 1e-12)
-
-        def body(carry):
-            w, b, zw, zb, t, _, it = carry
-            gw, gb = grads(zw, zb)
-            w_next = zw - step * gw
-            b_next = zb - step * gb
-            t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-            mom = (t - 1.0) / t_next
-            zw_next = w_next + mom * (w_next - w)
-            zb_next = b_next + mom * (b_next - b)
-            delta = jnp.sqrt(jnp.sum((w_next - w) ** 2) + jnp.sum((b_next - b) ** 2))
-            return w_next, b_next, zw_next, zb_next, t_next, delta, it + 1
-
-        def cond(carry):
-            delta, it = carry[5], carry[6]
-            return jnp.logical_and(it < max_iter, delta > tol)
-
-        w0 = jnp.zeros((d, c), accum)
-        b0 = jnp.zeros((c,), accum)
-        w, b, _, _, _, _, n_iter = jax.lax.while_loop(
-            cond,
-            body,
-            (w0, b0, w0, b0, jnp.array(1.0, accum), jnp.array(jnp.inf, accum), 0),
-        )
-        return w, b, n_iter
-
-    f = jax.shard_map(
-        shard,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
-        out_specs=(P(), P(), P()),
-    )
-    return jax.jit(f)
-
-
 def fit_logistic_regression(
     x: np.ndarray,
     y: np.ndarray,
@@ -435,16 +348,42 @@ def fit_logistic_regression(
                 n_rows=n_true,
                 loss=float(loss),
             )
-        onehot = np.eye(n_classes, dtype=np.float64)[y.astype(np.int64)]
-        os_, _, _ = shard_rows(onehot, mesh)
-        fn = _softmax_gd_fn(
-            mesh, n_classes, float(reg), bool(fit_intercept), int(max_iter), float(tol), ad
-        )
-        w, b, n_iter = jax.device_get(fn(xs, os_, mask))
+        # Multinomial MM-Newton: the SAME machinery as the streaming path
+        # (exact softmax gradient + per-class upper-bound curvature,
+        # _stream_softmax_stats_fn) driven over the in-memory shards —
+        # one device round-trip per iteration, converging in tens of
+        # iterations where the round-2 Nesterov-GD sidecar needed
+        # hundreds, and single source of truth for the update rule.
+        accum = jnp.dtype(ad)
+        state_bytes = n_classes * x.shape[1] ** 2 * accum.itemsize
+        if state_bytes > 2**31:
+            # The replicated (C, d, d) curvature state is the price of
+            # second-order steps; past ~2 GB it would crowd out the data.
+            raise ValueError(
+                f"multinomial MM-Newton state is C·d² = {state_bytes / 2**30:.1f}"
+                f" GiB (C={n_classes}, d={x.shape[1]}, {accum.name}) — too "
+                "large for a replicated accumulator. Reduce d (feature "
+                "hashing/PCA) or C, or use a float32 accum_dtype."
+            )
+        ys, _, _ = shard_rows(y.astype(np.float32), mesh)
+        update = _stream_softmax_stats_fn(mesh, n_classes, ad)
+        mm_step = _stream_multinomial_step_fn(float(reg), bool(fit_intercept), ad)
+        W = jnp.zeros((x.shape[1], n_classes), accum)
+        b = jnp.zeros((n_classes,), accum)
+        n_iter = 0
+        for it in range(max_iter):
+            state = stream_softmax_zero_state(x.shape[1], n_classes, accum)
+            gw, gb, hw, hwb, hbb, _, n = update(state, W, b, xs, ys, mask)
+            W, b, delta = mm_step(gw, gb, hw, hwb, hbb, n, W, b)
+            n_iter = it + 1
+            if float(delta) <= tol:
+                break
         return LogisticSolution(
-            coefficients=np.asarray(w.T, dtype=np.float64),  # (c, d) Spark layout
-            intercept=np.asarray(b, dtype=np.float64),
-            n_iter=int(n_iter),
+            coefficients=np.asarray(
+                jax.device_get(W), dtype=np.float64
+            ).T,  # (c, d) Spark layout
+            intercept=np.asarray(jax.device_get(b), dtype=np.float64),
+            n_iter=n_iter,
             n_rows=n_true,
         )
 

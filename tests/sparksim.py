@@ -95,8 +95,15 @@ def _dying_iter(batches, fail_after):
     raise RuntimeError("injected executor death at partition end")
 
 
-def _run_task(fn, batches, pid, attempt, fail_after, out_q):
-    """Worker-process entry: impersonate one Spark task."""
+def _run_task(fn, batches, pid, attempt, fail_after, out_q, env=None):
+    """Worker-process entry: impersonate one Spark task.
+
+    ``env``: driver-side SRML_*/JAX_* snapshot taken at task LAUNCH.
+    Forkserver children freeze os.environ at forkserver start (unlike
+    spawn), so without this pass-through a test's monkeypatched executor
+    env var (e.g. SRML_DAEMON_ADDRESS) would silently not reach tasks."""
+    for k, v in (env or {}).items():
+        os.environ[k] = v
     os.environ["SRML_PARTITION_ID"] = str(pid)
     os.environ["SRML_ATTEMPT"] = str(attempt)
     # The dev image's sitecustomize pins jax to the tunneled TPU platform,
@@ -231,9 +238,13 @@ class SimDataFrame:
 
     def _one_attempt(self, ctx, pid, attempt, batches, fail_after):
         q = ctx.Queue()
+        env = {
+            k: v for k, v in os.environ.items()
+            if k.startswith(("SRML_", "JAX_"))
+        }
         proc = ctx.Process(
             target=_run_task,
-            args=(self._mapped, list(batches), pid, attempt, fail_after, q),
+            args=(self._mapped, list(batches), pid, attempt, fail_after, q, env),
         )
         proc.start()
         try:
