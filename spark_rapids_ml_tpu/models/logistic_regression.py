@@ -802,9 +802,9 @@ def fit_logistic_stream(
     ``batch_source`` is a CALLABLE returning a fresh iterator of
     ``(x (rows, d), y (rows,))`` pairs; each Newton iteration consumes one
     full scan, accumulating gradient + Hessian sharded on device into a
-    donated O(d²) state. Labels must be {0, 1} (binary only — the
-    multinomial GD path needs hundreds of scans and belongs on the
-    in-memory path). The returned ``loss`` is the objective at the LAST
+    donated O(d²) state. Labels must be {0, 1} (binary only — multiclass
+    streams through :func:`fit_multinomial_stream`). The returned
+    ``loss`` is the objective at the LAST
     iterate evaluated during its final scan (one iteration stale, standard
     for streaming monitors; a converged fit has delta ≤ tol so the
     difference is below the stopping precision).

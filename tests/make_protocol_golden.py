@@ -159,7 +159,114 @@ def main() -> None:
     with open(FIXTURE, "wb") as f:
         f.write(data)
     print(f"wrote {FIXTURE}: {len(data)} bytes, {len(expect)} requests")
+    data_s, expect_s = serving_transcript()
+    with open(FIXTURE_SERVING, "wb") as f:
+        f.write(data_s)
+    print(f"wrote {FIXTURE_SERVING}: {len(data_s)} bytes, {len(expect_s)} requests")
 
+
+
+# ---------------------------------------------------------------------------
+# v1 serving-ops transcript (additive ops: ensure_model / transform /
+# model_status / kneighbors / drop_model + the knn job algo)
+# ---------------------------------------------------------------------------
+
+FIXTURE_SERVING = os.path.join(
+    os.path.dirname(__file__), "fixtures", "protocol_v1_serving.bin"
+)
+
+
+def golden_pc() -> np.ndarray:
+    """Deterministic (3, 2) projection matrix for the served-PCA leg —
+    conformance needs a fixed registered model, not a real fit."""
+    return np.asarray([[0.8, -0.6], [0.6, 0.8], [0.0, 0.0]], np.float64)
+
+
+def serving_transcript_frames() -> tuple[list, list]:
+    """Request frames + response expectations for the serving ops.
+
+    Kinds: ("json", bytes) / ("arrow", bytes) / ("raw", bytes) — raw
+    frames are the request-direction array buffers of ensure_model.
+    """
+    x = golden_matrix()
+    pc = golden_pc()
+    frames: list = []
+    expect = []
+
+    def _req(obj: dict, payloads=()) -> None:
+        frames.append(("json", json.dumps(obj).encode()))
+        frames.extend(payloads)
+
+    # 1. register a PCA model: JSON carries the arrays spec, raw buffer
+    # frames follow (request-direction mirror of finalize's response)
+    arrays = {"pc": pc, "mean": np.zeros((3,), np.float64)}
+    spec = [
+        {"name": k, "dtype": str(v.dtype), "shape": list(v.shape)}
+        for k, v in arrays.items()
+    ]
+    _req(
+        {"v": V, "op": "ensure_model", "model": "g-served", "algo": "pca",
+         "params": {}, "arrays": spec},
+        [("raw", np.ascontiguousarray(v).tobytes()) for v in arrays.values()],
+    )
+    expect.append(("json", {"ok": True, "created": True}))
+
+    # 2. idempotent re-register: first copy wins
+    _req(
+        {"v": V, "op": "ensure_model", "model": "g-served", "algo": "pca",
+         "params": {}, "arrays": spec},
+        [("raw", np.ascontiguousarray(v).tobytes()) for v in arrays.values()],
+    )
+    expect.append(("json", {"ok": True, "created": False}))
+
+    # 3. model_status
+    _req({"v": V, "op": "model_status", "model": "g-served"})
+    expect.append(("json", {"ok": True, "exists": True, "algo": "pca"}))
+
+    # 4. transform one batch: response carries the role-keyed arrays
+    _req(
+        {"v": V, "op": "transform", "model": "g-served",
+         "input_col": "features", "n_cols": None},
+        [("arrow", _ipc_bytes(x))],
+    )
+    expect.append(("arrays", {"ok": True, "rows": 8}))
+
+    # 5-8. knn job: partitioned rows feed -> commit -> build-and-serve
+    for pid, part in ((0, x[:4]), (1, x[4:])):
+        _req(
+            {"v": V, "op": "feed", "job": "g-knn", "algo": "knn",
+             "input_col": "features", "label_col": "label", "n_cols": None,
+             "params": {}, "partition": pid, "attempt": 0, "pass_id": None},
+            [("arrow", _ipc_bytes(part))],
+        )
+        expect.append(("json", {"ok": True}))
+        _req({"v": V, "op": "commit", "job": "g-knn",
+              "partition": pid, "attempt": 0, "pass_id": None})
+        expect.append(("json", {"ok": True}))
+    _req({"v": V, "op": "finalize", "job": "g-knn",
+          "params": {"mode": "exact", "register_as": "g-knn-idx"},
+          "drop": True})
+    expect.append(("arrays", {"ok": True, "rows": 8, "model": "g-knn-idx"}))
+
+    # 9. kneighbors against the daemon-built index
+    _req(
+        {"v": V, "op": "kneighbors", "model": "g-knn-idx", "k": 2,
+         "input_col": "features", "n_cols": None},
+        [("arrow", _ipc_bytes(x[:3]))],
+    )
+    expect.append(("arrays", {"ok": True, "rows": 3}))
+
+    # 10-11. drop both registrations
+    for name in ("g-served", "g-knn-idx"):
+        _req({"v": V, "op": "drop_model", "model": name})
+        expect.append(("json", {"ok": True, "dropped": True}))
+
+    return frames, expect
+
+
+def serving_transcript() -> tuple[bytes, list]:
+    frames, expect = serving_transcript_frames()
+    return b"".join(frame_bytes(p) for _, p in frames), expect
 
 if __name__ == "__main__":
     main()

@@ -101,8 +101,14 @@ def _run_task(fn, batches, pid, attempt, fail_after, out_q, env=None):
     ``env``: driver-side SRML_*/JAX_* snapshot taken at task LAUNCH.
     Forkserver children freeze os.environ at forkserver start (unlike
     spawn), so without this pass-through a test's monkeypatched executor
-    env var (e.g. SRML_DAEMON_ADDRESS) would silently not reach tasks."""
-    for k, v in (env or {}).items():
+    env var (e.g. SRML_DAEMON_ADDRESS) would silently not reach tasks —
+    and a var UNSET driver-side must be unset here too, or a frozen
+    template value leaks into later tests (order-dependent greens)."""
+    env = env or {}
+    for k in list(os.environ):
+        if k.startswith(("SRML_", "JAX_")) and k not in env:
+            del os.environ[k]
+    for k, v in env.items():
         os.environ[k] = v
     os.environ["SRML_PARTITION_ID"] = str(pid)
     os.environ["SRML_ATTEMPT"] = str(attempt)

@@ -178,3 +178,86 @@ def test_live_client_speaks_the_frozen_version(daemon):
         c.feed("live", golden_matrix(), algo="pca")
         arrays = c.finalize_pca("live", k=2)
         assert arrays["pc"].shape == (3, 2)
+
+
+def test_replay_serving_transcript(daemon):
+    """Replay the frozen serving-ops byte transcript (ensure_model /
+    transform / model_status / knn build-and-serve / kneighbors /
+    drop_model) and assert every response, including numeric conformance
+    of the served transform and the daemon-built index."""
+    from tests.make_protocol_golden import (
+        FIXTURE_SERVING,
+        golden_pc,
+        serving_transcript,
+    )
+
+    assert os.path.exists(FIXTURE_SERVING), (
+        "tests/fixtures/protocol_v1_serving.bin must be committed"
+    )
+    with open(FIXTURE_SERVING, "rb") as f:
+        stream = f.read()
+    _, expect = serving_transcript()
+
+    sock = socket.create_connection(daemon.address, timeout=120)
+    try:
+        sock.sendall(stream)
+        results = []
+        for kind, checks in expect:
+            resp = protocol.recv_json(sock)
+            assert resp is not None, "daemon closed mid-transcript"
+            for key, want in checks.items():
+                assert resp.get(key) == want, (
+                    f"response {resp} missing/mismatched {key}={want!r}"
+                )
+            if kind == "arrays":
+                results.append(protocol.recv_arrays(sock, resp))
+    finally:
+        sock.close()
+
+    transform_out, knn_build, knn_query = results
+    x = golden_matrix()
+    # served PCA transform: y = x @ pc, exactly
+    np.testing.assert_allclose(
+        transform_out["output"], x @ golden_pc(), atol=1e-10
+    )
+    assert int(knn_build["n_rows"][0]) == 8
+    assert int(knn_build["n_cols"][0]) == 3
+    # daemon-built exact index: self is nearest, partition-major ids
+    np.testing.assert_array_equal(knn_query["indices"][:, 0], [0, 1, 2])
+    np.testing.assert_allclose(knn_query["distances"][:, 0], 0.0, atol=1e-3)
+
+
+def test_serving_generator_matches_committed_fixture():
+    """Frame-by-frame drift check for the serving transcript (JSON frames
+    semantic, arrow/raw payloads byte-compared — raw buffers are plain
+    C-order arrays with no encoder variance)."""
+    import io
+    import json as _json
+    import struct
+
+    import pyarrow as pa
+
+    from tests.make_protocol_golden import (
+        FIXTURE_SERVING,
+        serving_transcript_frames,
+    )
+
+    frames, _ = serving_transcript_frames()
+    with open(FIXTURE_SERVING, "rb") as f:
+        committed = f.read()
+    stream = io.BytesIO(committed)
+    for kind, generated in frames:
+        header = stream.read(4)
+        (n,) = struct.unpack(">I", header)
+        recorded = stream.read(n)
+        if kind == "json":
+            assert _json.loads(generated) == _json.loads(recorded)
+        elif kind == "arrow":
+            with pa.ipc.open_stream(generated) as r:
+                gen_t = r.read_all()
+            with pa.ipc.open_stream(recorded) as r:
+                rec_t = r.read_all()
+            assert gen_t.equals(rec_t)
+        else:  # raw array buffer
+            assert generated == recorded
+    assert stream.read() == b""
