@@ -611,7 +611,7 @@ def _bucketed_core(
     queries, probe, probe_d2, lists, list_ids, list_mask, resid_norms,
     n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
     list_block: int = 16, shortlist_mult: int = 2, rerank: bool = True,
-    *, lists_lo, centroids,
+    *, lists_lo, centroids, _debug_stage=None,
 ):
     """The capacity-bucketed scorer over ONE device's lists.
 
@@ -701,6 +701,17 @@ def _bucketed_core(
     )
     pair_slot = slot_unsorted.reshape(q, nprobe)
     pair_list = jnp.where(probe >= 0, probe, 0)  # dropped pairs masked via pair_slot
+    if _debug_stage == "bucket":
+        # Profiling cut (benchmarks/profile_ivf_stages.py): everything up
+        # to and including the bucketing sort/scatters stays live; the
+        # scan and selection are dropped.
+        live = (
+            bucket_q.sum() + slot_unsorted.sum() + counts.sum()
+        ).astype(accum_dtype)
+        return (
+            probe_d2[:, :k].astype(accum_dtype) + live,
+            jnp.broadcast_to(pair_list[:, :1], (q, k)).astype(jnp.int64),
+        )
 
     nblk = -(-nlist // list_block)
     pad = nblk * list_block - nlist
@@ -729,7 +740,10 @@ def _bucketed_core(
             f"{nprobe * maxlen}; raise nprobe or use mode='dense'"
         )
 
-    def body(_, b):
+    def _block_d2(b):
+        """One list-block's (L, C, maxlen) within-list scores — shared by
+        the real scan body and the scan_nosel profiling cut so the two
+        measure the identical scoring pipeline."""
         qidx = jax.lax.dynamic_slice(bq_p, (b * list_block, 0), (list_block, C))
         # Query residuals q − c_list, formed in f32 BEFORE the compute-
         # dtype cast: bf16-rounding q and c separately leaves absolute-
@@ -754,7 +768,10 @@ def _bucketed_core(
         # Within-list ranking score ‖δ‖² − 2(q−c)·δ: the per-(query, list)
         # ‖q−c‖² constant joins at gather-back (it cannot change a
         # within-list argmin) and the rerank restores true distances.
-        d2 = r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
+        return r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
+
+    def body(_, b):
+        d2 = _block_d2(b)
         # 0.95 within-list recall: recall_target=1.0 degenerates to a full
         # per-row sort (4x the einsum+selection cost); misses concentrate
         # at the k-th boundary and the 2k shortlist + rerank absorbs them.
@@ -768,9 +785,35 @@ def _bucketed_core(
             bpos.reshape(list_block, C, blk_k).astype(jnp.int32),
         )
 
-    _, (res_d, res_p) = jax.lax.scan(body, None, jnp.arange(nblk))
+    def body_nosel(_, b):
+        # Profiling cut (_debug_stage="scan_nosel"): the einsum + d2 stay
+        # live (same _block_d2 as the real body), the approx_min_k
+        # selection is replaced by a slice.
+        d2 = _block_d2(b)
+        return _, (
+            d2[:, :, :blk_k],
+            jnp.broadcast_to(
+                jax.lax.broadcasted_iota(jnp.int32, (1, 1, blk_k), 2),
+                (list_block, C, blk_k),
+            ),
+        )
+
+    _, (res_d, res_p) = jax.lax.scan(
+        body_nosel if _debug_stage == "scan_nosel" else body,
+        None, jnp.arange(nblk),
+    )
     res_d = res_d.reshape(nblk * list_block, C, blk_k)
     res_p = res_p.reshape(nblk * list_block, C, blk_k)
+    if _debug_stage in ("scan", "scan_nosel"):
+        # Profiling cut: bucketing + the blocked residual-GEMM scan stay
+        # live; candidate gather-back and final selection are dropped.
+        live = (res_d.sum() + res_p.sum().astype(accum_dtype)).astype(accum_dtype)
+        return (
+            probe_d2[:, :k].astype(accum_dtype)
+            + live
+            + (bucket_q.sum() + slot_unsorted.sum()).astype(accum_dtype),
+            jnp.broadcast_to(pair_list[:, :1], (q, k)).astype(jnp.int64),
+        )
 
     # Gather each query's candidates back from its (list, slot) buckets,
     # completing the residual identity with the probe stage's ‖q−c‖² term
@@ -860,7 +903,7 @@ def _residual_index_data(lists, centroids, compute_dtype, chunk: int = 64):
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
                   slack: float = 1.5, shortlist_mult: int = 2,
-                  rerank: bool = True):
+                  rerank: bool = True, _debug_stage=None):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -979,19 +1022,49 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         q = queries.shape[0]
         nlist = lists.shape[0]
         C = _bucketed_capacity(q, nprobe, nlist, slack)
+        if _debug_stage == "dispatch":
+            # Near-noop cut: measures the per-call dispatch floor of the
+            # two-jit probe+core pipeline (on the dev tunnel this is
+            # several ms per call; ~100 µs on a production host).
+            return (
+                queries[:, :k].astype(jnp.dtype(ad)),
+                probe[:, :k].astype(jnp.int64),
+            )
+        if _debug_stage == "probe":
+            return (
+                probe_d2[:, :k].astype(jnp.dtype(ad)),
+                probe[:, :k].astype(jnp.int64),
+            )
         return _bucketed_core(
             queries, probe, probe_d2, lists, list_ids, list_mask,
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             list_block=16, shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=centroids,
+            _debug_stage=_debug_stage,
         )
+
+    @jax.jit
+    def _probe_trivial(centroids, queries):
+        # Profiling stand-in for probe_bucketed (_debug_stage="dispatch"):
+        # data-dependent but ~zero compute, so the two-jit pipeline's
+        # dispatch overhead is measured WITHOUT the probe GEMM/selection
+        # (the earlier cut returned real probe output and folded the
+        # probe's device time into the "floor").
+        probe = jnp.broadcast_to(
+            jax.lax.broadcasted_iota(jnp.int32, (1, nprobe), 1),
+            (queries.shape[0], nprobe),
+        ) + (queries[:, :1] * 0).astype(jnp.int32)
+        return probe, queries[:, :nprobe].astype(jnp.float32) * 0.0
 
     def query_bucketed(centroids, lists, list_ids, list_mask, queries, n_valid,
                        resid_norms, lists_lo):
         # Two dispatches, not one fused jit: XLA schedules the monolithic
         # probe+scan+rerank graph measurably worse (+20% wall) than the
         # same stages compiled separately and pipelined by async dispatch.
-        probe, probe_d2 = probe_bucketed(centroids, queries)
+        probe_fn = (
+            _probe_trivial if _debug_stage == "dispatch" else probe_bucketed
+        )
+        probe, probe_d2 = probe_fn(centroids, queries)
         return core_bucketed(
             queries, probe, probe_d2, centroids, lists, list_ids, list_mask,
             n_valid, resid_norms, lists_lo,
