@@ -775,6 +775,11 @@ def _bucketed_core(
         # 0.95 within-list recall: recall_target=1.0 degenerates to a full
         # per-row sort (4x the einsum+selection cost); misses concentrate
         # at the k-th boundary and the 2k shortlist + rerank absorbs them.
+        # (Round-3 negative result: an exact min+argmin pre-reduction over
+        # size-8 groups measured 3x SLOWER — the 8-wide group axis lands
+        # on the 128-lane dimension and wastes 15/16 of every vreg — and
+        # cost ~2% recall from within-list winner collisions. See
+        # benchmarks/README.md.)
         bd, bpos = jax.lax.approx_min_k(
             d2.reshape(list_block * C, maxlen), blk_k, recall_target=0.95
         )
