@@ -110,7 +110,10 @@ def main() -> None:
         """(q/s, recall@10) at one operating point — BOTH points are
         emitted every run (r2 review: the default config ships
         rerank=on, the headline ran rerank=off; report both always)."""
-        query = _ivf_query_fn(K, NPROBE, "bfloat16", "float32", rerank=rerank)
+        query = _ivf_query_fn(
+            K, NPROBE, "bfloat16", "float32", rerank=rerank,
+            fused=str(config.get("ann_fused_scan")),
+        )
         ids0 = np.asarray(
             query(*dev, queries, resid_norms=norms, lists_lo=lists_lo)[1]
         )
@@ -143,6 +146,20 @@ def main() -> None:
         lats = [slope_dt(run, reps, 3 * reps, warm=False) for _ in range(5)]
         dt = float(np.median(lats))
         return N_QUERY / dt / n_chips, recall
+
+    if os.environ.get("SRML_BENCH_AB_FUSED"):
+        # Same-run interleaved A/B of the fused Pallas scan+selection vs
+        # the XLA einsum+approx_min_k scan (within-session chip drift
+        # forbids cross-run comparison — benchmarks/README.md): one extra
+        # JSON line per arm, then the normal headline (auto = fused).
+        for arm in ("off", "on"):
+            config.set("ann_fused_scan", arm)
+            qps, rec = measure(rerank=False)
+            emit(
+                f"ivfflat_ab_fused_{arm}_norerank", qps, "queries/s/chip",
+                qps / A100_QUERIES_PER_SEC, recall_at_10=round(rec, 4),
+            )
+        config.set("ann_fused_scan", "auto")
 
     qps_off, recall_off = measure(rerank=False)
     qps_on, recall_on = measure(rerank=True)

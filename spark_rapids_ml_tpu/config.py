@@ -81,6 +81,14 @@ _DEFAULTS: Dict[str, Any] = {
     # (q, R, d) raw-row gather is the single most expensive post-scan op).
     # Keep "on" when bf16 score noise matters more than throughput.
     "ann_rerank": _env("ANN_RERANK", True, lambda v: str(v).lower() not in ("0", "false", "off")),
+    # Fused Pallas scan+selection kernel for the bucketed IVF query
+    # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
+    # GEMM and an EXACT per-slot top-k run in one kernel, scores
+    # VMEM-resident. "auto" = on when the backend is TPU and the per-list
+    # tile fits VMEM (the XLA einsum+approx_min_k scan is the portable
+    # fallback); "on" forces it (interpret mode off-TPU — used by tests);
+    # "off" forces the XLA scan.
+    "ann_fused_scan": _env("ANN_FUSED_SCAN", "auto", str),
 }
 
 _lock = threading.Lock()

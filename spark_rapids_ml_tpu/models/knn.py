@@ -47,6 +47,7 @@ from spark_rapids_ml_tpu.core.params import (
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops.distances import sq_euclidean
+from spark_rapids_ml_tpu.ops.pallas_kernels import ivf_scan_select_pallas
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding
 from spark_rapids_ml_tpu.utils.profiling import trace_span
@@ -607,11 +608,22 @@ def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
     return min(q, max(8, ((cap + 7) // 8) * 8))
 
 
+def _fused_scan_fits(C: int, maxlen: int, d: int, compute_dtype) -> bool:
+    """VMEM feasibility gate for ivf_scan_select_pallas's ``auto`` mode:
+    per grid step the kernel holds the (C_pad, d) query block, the
+    (maxlen_pad, d) row block (each double-buffered by the pipeline) and
+    the f32 (maxlen_pad, C_pad) score tile."""
+    c_pad = -(-C // 128) * 128
+    ml = -(-maxlen // 8) * 8
+    e = jnp.dtype(compute_dtype).itemsize
+    return 2 * (c_pad * d + ml * d) * e + ml * c_pad * 4 <= 10 * 2**20
+
+
 def _bucketed_core(
     queries, probe, probe_d2, lists, list_ids, list_mask, resid_norms,
     n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
     list_block: int = 16, shortlist_mult: int = 2, rerank: bool = True,
-    *, lists_lo, centroids, _debug_stage=None,
+    *, lists_lo, centroids, fused: str = "auto", _debug_stage=None,
 ):
     """The capacity-bucketed scorer over ONE device's lists.
 
@@ -654,59 +666,73 @@ def _bucketed_core(
     n_pairs = q * nprobe
 
     # --- bucket (query, list) pairs by list with capacity C ---
-    # Non-owned pairs take the sentinel list id ``nlist``: they sort last,
-    # scatter out of bounds (dropped), and never hold capacity.
-    flat_list = jnp.where(probe >= 0, probe, nlist).reshape(-1)
-    flat_query = jnp.repeat(jnp.arange(q, dtype=jnp.int32), nprobe)
     # Eviction order when a hot list overflows its capacity, least
-    # valuable dropped first: (1) padding queries (rows >= n_valid);
-    # (2) higher probe rank — a query's least promising list costs the
-    # least recall; (3) within a rank, a RANK-KEYED rotated query order so
-    # correlated query batches spread across their probed lists instead of
-    # the same C winners taking every list.
-    flat_rank = jnp.tile(jnp.arange(nprobe, dtype=jnp.int32), q)
-    rot = (flat_query + flat_rank * C) % q
-    flat_rank = jnp.where(flat_query >= n_valid, nprobe, flat_rank)
-    # Lexicographic (list, rank, rot) order. The combined int32 key is
-    # unique per pair (rot is a bijection of queries within each rank), so
-    # ONE unstable argsort replaces two stable ones (a stable sort ties
-    # every key to its index — effectively a wider sort — and this sort is
-    # a measurable slice of the query's critical path). Falls back to the
-    # two-pass form when the combined key range would overflow int32.
-    if (nlist + 1) * (nprobe + 2) + nprobe + 2 < (2**31 - 1) // max(q, 1):
-        combined = (flat_list * (nprobe + 2) + flat_rank) * q + rot
-        order = jnp.argsort(combined, stable=False)
-    else:
-        o1 = jnp.argsort(rot, stable=True)
-        key2 = (flat_list * (nprobe + 2) + flat_rank)[o1]
-        order = o1[jnp.argsort(key2, stable=True)]
-    sl = flat_list[order]
-    sq_ids = flat_query[order]
-    counts = jnp.zeros((nlist + 1,), jnp.int32).at[flat_list].add(1)
-    starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:-1]).astype(jnp.int32)]
-    )  # (nlist + 1,): entry nlist serves the sentinel (slot value unused)
-    slot = jnp.arange(n_pairs, dtype=jnp.int32) - starts[sl]
-    keep = (slot < C) & (sl < nlist)
+    # valuable dropped first: (1) padding queries (rows >= n_valid) never
+    # hold capacity at all; (2) higher probe rank — a query's least
+    # promising list costs the least recall; (3) within a rank, a
+    # RANK-KEYED rotated query order so correlated query batches spread
+    # across their probed lists instead of the same C winners taking
+    # every list.
+    #
+    # SORT-FREE slot assignment (replaced a 131k-element argsort that was
+    # the single most expensive bucketing op): the (rank-major,
+    # rot-within-rank) priority order is a FIXED, data-independent
+    # permutation of the pairs, so a pair's slot is simply the number of
+    # EARLIER same-list pairs along that static sequence — a chunked
+    # prefix-count: per-chunk list histograms (scatter-add) + exclusive
+    # cumsum across chunks + an in-chunk (S, S) equality/triangle count
+    # the VPU eats whole. Pure elementwise/reduce work instead of a sort.
+    # Non-owned pairs (probe < 0) and padding queries take the sentinel
+    # list id ``nlist``: they count only against the sentinel row and
+    # never hold capacity.
+    S = 512
+    n_seq = -(-n_pairs // S) * S
+    seq_i = jnp.arange(n_seq, dtype=jnp.int32)
+    r_seq = seq_i // q  # probe rank of sequence position (pad ranks >= nprobe)
+    q_seq = (seq_i % q - r_seq * C) % q  # rank-keyed rotation, inverted
+    valid_seq = r_seq < nprobe
+    l_seq = jnp.where(
+        valid_seq,
+        probe.reshape(-1)[
+            jnp.where(valid_seq, q_seq * nprobe + r_seq, 0)
+        ],
+        -1,
+    )
+    l_seq = jnp.where((l_seq >= 0) & (q_seq < n_valid), l_seq, nlist)
+    ch = n_seq // S
+    lc = l_seq.reshape(ch, S)
+    tri = (
+        jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        < jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    )  # strict lower triangle: earlier-in-chunk mask
+    within = jnp.sum(
+        (lc[:, :, None] == lc[:, None, :]) & tri[None],
+        axis=2,
+        dtype=jnp.int32,
+    ).reshape(-1)
+    hist = jnp.zeros((ch, nlist + 1), jnp.int32).at[seq_i // S, l_seq].add(1)
+    base = jnp.cumsum(hist, axis=0) - hist  # exclusive over earlier chunks
+    slot_seq = base[seq_i // S, l_seq] + within
+    keep = (slot_seq < C) & (l_seq < nlist)
     bucket_q = (
         jnp.full((nlist, C), -1, jnp.int32)
-        .at[jnp.where(keep, sl, nlist), jnp.where(keep, slot, 0)]
-        .set(sq_ids, mode="drop")
+        .at[jnp.where(keep, l_seq, nlist), jnp.where(keep, slot_seq, 0)]
+        .set(q_seq, mode="drop")
     )
-    # Per original (query, probe) pair: its slot in its list (-1 = dropped).
-    slot_unsorted = (
-        jnp.full((n_pairs,), -1, jnp.int32)
-        .at[order]
-        .set(jnp.where(keep, slot, -1))
-    )
-    pair_slot = slot_unsorted.reshape(q, nprobe)
+    # Per original (query, probe) pair: its slot in its list (-1 =
+    # dropped). Pair (qq, r) sits at the STATIC sequence position
+    # r·q + rot(qq, r) — a constant-index gather, no inverse scatter.
+    qq = jnp.arange(q, dtype=jnp.int32)[:, None]
+    rr = jnp.arange(nprobe, dtype=jnp.int32)[None, :]
+    i_pair = rr * q + (qq + rr * C) % q
+    pair_slot = jnp.where(keep, slot_seq, -1)[i_pair]
     pair_list = jnp.where(probe >= 0, probe, 0)  # dropped pairs masked via pair_slot
     if _debug_stage == "bucket":
         # Profiling cut (benchmarks/profile_ivf_stages.py): everything up
-        # to and including the bucketing sort/scatters stays live; the
+        # to and including the bucketing counts/scatters stays live; the
         # scan and selection are dropped.
         live = (
-            bucket_q.sum() + slot_unsorted.sum() + counts.sum()
+            bucket_q.sum() + pair_slot.sum() + hist.sum()
         ).astype(accum_dtype)
         return (
             probe_d2[:, :k].astype(accum_dtype) + live,
@@ -733,92 +759,142 @@ def _bucketed_core(
     # measured on clustered 128-d data, mult 2 → recall@10 0.92 at ~115k
     # q/s/chip, mult 4 → 0.98 at ~65k (f32 scans sit at the 0.99 probing
     # ceiling already at mult 2).
-    blk_k = min(shortlist_mult * k, maxlen)
+    fused = str(fused).lower()
+    if fused not in ("auto", "on", "off"):
+        raise ValueError(
+            f"ann_fused_scan={fused!r}: expected 'auto', 'on' or 'off'"
+        )
+    # The kernel computes and emits f32 scores: float64 accum configs
+    # (supported by the XLA path) must not silently lose precision.
+    f32_ok = jnp.dtype(accum_dtype) != jnp.float64
+    use_fused = _debug_stage is None and (
+        (fused == "on" and f32_ok)
+        or (
+            fused == "auto"
+            and f32_ok
+            and jax.default_backend() == "tpu"
+            and _fused_scan_fits(C, maxlen, d, compute_dtype)
+        )
+    )
+    # Exact selection needs no shortlist slack when its scores answer
+    # directly (the global top-k is contained in exact per-(list, slot)
+    # top-k): blk_k = k halves the fused kernel's extraction passes AND
+    # the gather-back pool. The rerank path keeps the mult·k width — its
+    # slack absorbs bf16 score-vs-f32-rank mismatch, which exactness of
+    # the *selection* cannot remove.
+    blk_k = min(k if (use_fused and not rerank) else shortlist_mult * k, maxlen)
     if nprobe * blk_k < k:
         raise ValueError(
             f"k={k} exceeds the bucketed candidate pool nprobe*maxlen="
             f"{nprobe * maxlen}; raise nprobe or use mode='dense'"
         )
 
-    def _block_d2(b):
-        """One list-block's (L, C, maxlen) within-list scores — shared by
-        the real scan body and the scan_nosel profiling cut so the two
-        measure the identical scoring pipeline."""
-        qidx = jax.lax.dynamic_slice(bq_p, (b * list_block, 0), (list_block, C))
-        # Query residuals q − c_list, formed in f32 BEFORE the compute-
-        # dtype cast: bf16-rounding q and c separately leaves absolute-
-        # magnitude noise that does not cancel in the subtraction.
-        cent = jax.lax.dynamic_slice(cent_p, (b * list_block, 0), (list_block, d))
-        qv = (
-            queries.astype(jnp.float32)[jnp.maximum(qidx, 0)]  # (L, C, d)
-            - cent[:, None, :]
-        ).astype(compute_dtype)
-        rows = jax.lax.dynamic_slice(
-            lists_lo_p, (b * list_block, 0, 0), (list_block, maxlen, d)
+    if use_fused:
+        # Fused Pallas scan+selection (ops/pallas_kernels.py): per-list
+        # residual GEMM + EXACT per-slot top-blk_k in one kernel, the
+        # (maxlen, C) score tile VMEM-resident. The per-(list, slot) query
+        # residuals are pre-gathered OUTSIDE the kernel — dynamic row
+        # gathers don't belong inside; XLA fuses gather + f32 subtract +
+        # compute-dtype cast into one loop writing the bf16 buffer the
+        # kernel then streams sequentially. (The same hoist measured
+        # no-effect for the XLA scan — benchmarks/README.md — because
+        # there the gather cost merely moves; the kernel REQUIRES it.)
+        # C stays at its 8-multiple: Mosaic masks the non-128 lane tail of
+        # the (maxlen, C) score tile, and NOT padding C to 128 saves 25%
+        # of the pre-gather + qv streaming HBM traffic at the bench shape.
+        qv_all = (
+            queries.astype(jnp.float32)[jnp.maximum(bq_p, 0)]
+            - cent_p[:, None, :]
+        ).astype(compute_dtype)  # (nlist_p, C, d)
+        fd, fp = ivf_scan_select_pallas(
+            qv_all, lists_lo_p, r2_all.astype(jnp.float32), blk_k,
+            interpret=jax.default_backend() != "tpu",
         )
-        r2 = jax.lax.dynamic_slice(r2_all, (b * list_block, 0), (list_block, maxlen))
-        # Batched MXU GEMM: each list scores only its assigned queries.
-        # Full precision for f32 compute (TPU's DEFAULT is bf16-mantissa).
-        from spark_rapids_ml_tpu.ops.gram import mm_precision
-
-        with mm_precision(compute_dtype):
-            qr = jnp.einsum(
-                "lcd,lmd->lcm", qv, rows, preferred_element_type=accum_dtype
+        # (nlist_p, C', blk_k) to match the gather-back epilogue; padded
+        # slot columns [C:c_pad] are never referenced by a valid pair.
+        res_d = jnp.swapaxes(fd, 1, 2).astype(accum_dtype)
+        res_p = jnp.swapaxes(fp, 1, 2)
+    else:
+        def _block_d2(b):
+            """One list-block's (L, C, maxlen) within-list scores — shared
+            by the real scan body and the scan_nosel profiling cut so the
+            two measure the identical scoring pipeline."""
+            qidx = jax.lax.dynamic_slice(bq_p, (b * list_block, 0), (list_block, C))
+            # Query residuals q − c_list, formed in f32 BEFORE the compute-
+            # dtype cast: bf16-rounding q and c separately leaves absolute-
+            # magnitude noise that does not cancel in the subtraction.
+            cent = jax.lax.dynamic_slice(cent_p, (b * list_block, 0), (list_block, d))
+            qv = (
+                queries.astype(jnp.float32)[jnp.maximum(qidx, 0)]  # (L, C, d)
+                - cent[:, None, :]
+            ).astype(compute_dtype)
+            rows = jax.lax.dynamic_slice(
+                lists_lo_p, (b * list_block, 0, 0), (list_block, maxlen, d)
             )
-        # Within-list ranking score ‖δ‖² − 2(q−c)·δ: the per-(query, list)
-        # ‖q−c‖² constant joins at gather-back (it cannot change a
-        # within-list argmin) and the rerank restores true distances.
-        return r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
+            r2 = jax.lax.dynamic_slice(r2_all, (b * list_block, 0), (list_block, maxlen))
+            # Batched MXU GEMM: each list scores only its assigned queries.
+            # Full precision for f32 compute (TPU's DEFAULT is bf16-mantissa).
+            from spark_rapids_ml_tpu.ops.gram import mm_precision
 
-    def body(_, b):
-        d2 = _block_d2(b)
-        # 0.95 within-list recall: recall_target=1.0 degenerates to a full
-        # per-row sort (4x the einsum+selection cost); misses concentrate
-        # at the k-th boundary and the 2k shortlist + rerank absorbs them.
-        # (Round-3 negative result: an exact min+argmin pre-reduction over
-        # size-8 groups measured 3x SLOWER — the 8-wide group axis lands
-        # on the 128-lane dimension and wastes 15/16 of every vreg — and
-        # cost ~2% recall from within-list winner collisions. See
-        # benchmarks/README.md.)
-        bd, bpos = jax.lax.approx_min_k(
-            d2.reshape(list_block * C, maxlen), blk_k, recall_target=0.95
-        )
-        # Positions, not ids: the in-scan per-row id gather measured ~2x
-        # the GEMM+selection cost; ids resolve once for the winners.
-        return _, (
-            bd.reshape(list_block, C, blk_k),
-            bpos.reshape(list_block, C, blk_k).astype(jnp.int32),
-        )
+            with mm_precision(compute_dtype):
+                qr = jnp.einsum(
+                    "lcd,lmd->lcm", qv, rows, preferred_element_type=accum_dtype
+                )
+            # Within-list ranking score ‖δ‖² − 2(q−c)·δ: the per-(query, list)
+            # ‖q−c‖² constant joins at gather-back (it cannot change a
+            # within-list argmin) and the rerank restores true distances.
+            return r2[:, None, :] - 2.0 * qr  # (L, C, maxlen)
 
-    def body_nosel(_, b):
-        # Profiling cut (_debug_stage="scan_nosel"): the einsum + d2 stay
-        # live (same _block_d2 as the real body), the approx_min_k
-        # selection is replaced by a slice.
-        d2 = _block_d2(b)
-        return _, (
-            d2[:, :, :blk_k],
-            jnp.broadcast_to(
-                jax.lax.broadcasted_iota(jnp.int32, (1, 1, blk_k), 2),
-                (list_block, C, blk_k),
-            ),
-        )
+        def body(_, b):
+            d2 = _block_d2(b)
+            # 0.95 within-list recall: recall_target=1.0 degenerates to a
+            # full per-row sort (4x the einsum+selection cost); misses
+            # concentrate at the k-th boundary and the 2k shortlist +
+            # rerank absorbs them.
+            # (Round-3 negative result: an exact min+argmin pre-reduction
+            # over size-8 groups measured 3x SLOWER — the 8-wide group
+            # axis lands on the 128-lane dimension and wastes 15/16 of
+            # every vreg — and cost ~2% recall from within-list winner
+            # collisions. See benchmarks/README.md.)
+            bd, bpos = jax.lax.approx_min_k(
+                d2.reshape(list_block * C, maxlen), blk_k, recall_target=0.95
+            )
+            # Positions, not ids: the in-scan per-row id gather measured
+            # ~2x the GEMM+selection cost; ids resolve once for winners.
+            return _, (
+                bd.reshape(list_block, C, blk_k),
+                bpos.reshape(list_block, C, blk_k).astype(jnp.int32),
+            )
 
-    _, (res_d, res_p) = jax.lax.scan(
-        body_nosel if _debug_stage == "scan_nosel" else body,
-        None, jnp.arange(nblk),
-    )
-    res_d = res_d.reshape(nblk * list_block, C, blk_k)
-    res_p = res_p.reshape(nblk * list_block, C, blk_k)
-    if _debug_stage in ("scan", "scan_nosel"):
-        # Profiling cut: bucketing + the blocked residual-GEMM scan stay
-        # live; candidate gather-back and final selection are dropped.
-        live = (res_d.sum() + res_p.sum().astype(accum_dtype)).astype(accum_dtype)
-        return (
-            probe_d2[:, :k].astype(accum_dtype)
-            + live
-            + (bucket_q.sum() + slot_unsorted.sum()).astype(accum_dtype),
-            jnp.broadcast_to(pair_list[:, :1], (q, k)).astype(jnp.int64),
+        def body_nosel(_, b):
+            # Profiling cut (_debug_stage="scan_nosel"): the einsum + d2
+            # stay live (same _block_d2 as the real body), the
+            # approx_min_k selection is replaced by a slice.
+            d2 = _block_d2(b)
+            return _, (
+                d2[:, :, :blk_k],
+                jnp.broadcast_to(
+                    jax.lax.broadcasted_iota(jnp.int32, (1, 1, blk_k), 2),
+                    (list_block, C, blk_k),
+                ),
+            )
+
+        _, (res_d, res_p) = jax.lax.scan(
+            body_nosel if _debug_stage == "scan_nosel" else body,
+            None, jnp.arange(nblk),
         )
+        res_d = res_d.reshape(nblk * list_block, C, blk_k)
+        res_p = res_p.reshape(nblk * list_block, C, blk_k)
+        if _debug_stage in ("scan", "scan_nosel"):
+            # Profiling cut: bucketing + the blocked residual-GEMM scan
+            # stay live; candidate gather-back and final selection dropped.
+            live = (res_d.sum() + res_p.sum().astype(accum_dtype)).astype(accum_dtype)
+            return (
+                probe_d2[:, :k].astype(accum_dtype)
+                + live
+                + (bucket_q.sum() + pair_slot.sum()).astype(accum_dtype),
+                jnp.broadcast_to(pair_list[:, :1], (q, k)).astype(jnp.int64),
+            )
 
     # Gather each query's candidates back from its (list, slot) buckets,
     # completing the residual identity with the probe stage's ‖q−c‖² term
@@ -908,7 +984,7 @@ def _residual_index_data(lists, centroids, compute_dtype, chunk: int = 64):
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
                   slack: float = 1.5, shortlist_mult: int = 2,
-                  rerank: bool = True, _debug_stage=None):
+                  rerank: bool = True, fused: str = "auto", _debug_stage=None):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -1044,7 +1120,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
             queries, probe, probe_d2, lists, list_ids, list_mask,
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             list_block=16, shortlist_mult=shortlist_mult, rerank=rerank,
-            lists_lo=lists_lo, centroids=centroids,
+            lists_lo=lists_lo, centroids=centroids, fused=fused,
             _debug_stage=_debug_stage,
         )
 
@@ -1113,7 +1189,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
 def _ivf_query_fn_sharded(
     k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5,
     shortlist_mult: int = 2,
-    rerank: bool = True,
+    rerank: bool = True, fused: str = "auto",
 ):
     """Sharded IVF query: inverted lists sharded over the ``data`` mesh
     axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
@@ -1163,7 +1239,7 @@ def _ivf_query_fn_sharded(
             queries, probe_local, probe_d2, lists, list_ids, list_mask,
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             shortlist_mult=shortlist_mult, rerank=rerank,
-            lists_lo=lists_lo, centroids=cent_local,
+            lists_lo=lists_lo, centroids=cent_local, fused=fused,
         )
         # Merge the per-device top-k: O(q·k·devices) over ICI.
         cat_d = jax.lax.all_gather(dists, DATA_AXIS, axis=1, tiled=True)
@@ -1400,6 +1476,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     config.get("accum_dtype"), self._shard_mesh,
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
                     rerank=bool(config.get("ann_rerank")),
+                    fused=str(config.get("ann_fused_scan")),
                 )
             else:
                 fn = _ivf_query_fn(
@@ -1407,6 +1484,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     config.get("accum_dtype"),
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
                     rerank=bool(config.get("ann_rerank")),
+                    fused=str(config.get("ann_fused_scan")),
                 )
             cent, lists, ids_dev, mask = self._ensure_dev_index()
             cd = jnp.dtype(config.get("compute_dtype"))

@@ -3,19 +3,21 @@
 The reference's hand-written device code is a Thrust sign-flip kernel and
 cuBLAS GEMM calls (rapidsml_jni.cu). On TPU, XLA already fuses the
 mask-multiply + GEMM + accumulate chain well, so Pallas here targets the
-two places hand-tiling pays:
+places hand-tiling pays:
 
-* ``gram_pallas`` — tiled XᵀX with the mask fused into the load (one HBM
-  pass; out-of-VMEM tiles stream through a (bn, bd) grid). Grid is
-  (d/bd, d/bd, n/bn) with the row dimension innermost ("arbitrary"
-  semantics) so each output tile accumulates in VMEM across row steps.
-* ``assign_min_dist_pallas`` — KMeans assignment: pairwise distance tile +
-  running argmin fused, never materializing the (m, k) distance matrix in
-  HBM (the XLA path writes it out then argmins it back in).
+* ``gram_pallas`` / ``gram_colsum_pallas`` — tiled XᵀX with the mask (or
+  n_valid boundary) fused into the load, accumulators VMEM-resident.
+* ``assign_min_dist_pallas`` / ``lloyd_step_pallas`` — KMeans assignment
+  (+ fused centroid-sum update): distance tile + argmin fused, never
+  materializing the (m, k) distance matrix in HBM.
+* ``newton_stats_pallas`` — one-HBM-pass binomial Newton statistics.
+* ``ivf_scan_select_pallas`` — IVF bucketed scan: per-list residual GEMM
+  + exact packed-key top-k selection, scores VMEM-resident (gated by
+  ``config.ann_fused_scan``, not ``use_pallas``).
 
-Both are gated behind ``config.use_pallas`` with the XLA path as the
-default; parity is tested in interpret mode on CPU (tests/test_pallas.py)
-so the kernels stay correct even when no TPU is attached.
+All are gated with the XLA path as the default/fallback; parity is tested
+in interpret mode on CPU (tests/test_pallas.py) so the kernels stay
+correct even when no TPU is attached.
 
 See /opt/skills/guides/pallas_guide.md for the tiling constraints used
 here (f32 min tile (8, 128); MXU 128×128).
@@ -543,3 +545,153 @@ def assign_min_dist_pallas(
         interpret=interpret,
     )(x, centers, c2)
     return best_i, best_d
+
+
+# ---------------------------------------------------------------------------
+# Fused IVF list scan + EXACT per-slot top-k selection
+# ---------------------------------------------------------------------------
+
+
+# Masked-winner key: int32 max — strictly above every packed finite-score
+# key (a finite f32 score maps below 0x7F800000, and the position bits
+# only fill the cleared low bits).
+IVF_MASKED_KEY = 0x7FFFFFFF
+# Emitted in the sublane-pad output rows callers slice away.
+IVF_MASKED_D2 = 3.0e38
+
+
+def _ivf_scan_select_kernel(
+    qv_ref, rows_ref, r2_ref, d_ref, p_ref, *, blk_k, pos_bits
+):
+    """One probed list per grid step: residual-score GEMM + exact top-blk_k
+    per query slot, the (maxlen, C) score tile never leaving VMEM.
+
+    Layout is the round-3 Lloyd lesson applied to selection (see
+    benchmarks/README.md): scores are computed as (maxlen, C) — candidate
+    ROWS on sublanes, query SLOTS on lanes — so each extraction pass
+    reduces over the SUBLANE axis, the cheap VPU direction.
+
+    Selection runs on PACKED sortable keys: the f32 score is mapped to a
+    total-order-preserving int32 (IEEE trick: flip the non-sign bits of
+    negatives), its low ``pos_bits`` cleared and the row position OR-ed
+    in. One int32 word then carries (value, position): each of the blk_k
+    extraction passes is a pure min-reduce + one equality mask (keys are
+    UNIQUE — position bits make ties impossible, so the mask removes
+    exactly one element and ties resolve to the lowest position, the
+    first-occurrence contract). This halves the per-pass vreg ops vs
+    carrying a separate value/index pair through the reduction tree.
+
+    The price is ``pos_bits`` of score mantissa: emitted distances (and
+    the selection boundary) are floored within a relative 2^(pos_bits-24)
+    (≈1.2e-4 at maxlen 2048) — an order below the bf16 scan GEMM noise
+    (~4e-3) these scores already carry in the shipped configuration.
+    """
+    rows = rows_ref[:]  # (maxlen_pad, d) compute dtype; padded rows zero
+    qv = qv_ref[:]  # (C, d) compute dtype — pre-gathered query residuals
+    qr = jax.lax.dot_general(
+        rows, qv, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=_dot_prec(rows.dtype),
+    )  # (maxlen_pad, C)
+    # Within-list residual score ‖δ‖² − 2(q−c)·δ; padded rows carry the
+    # caller's ≥1e30 r2 sentinel (their qr is 0: zero rows) so they sort
+    # last yet stay below IVF_MASKED_KEY once packed — a list with fewer
+    # than blk_k valid rows emits them, and the caller's id table maps
+    # them to -1. Finite scores assumed (no ±inf/NaN reach this kernel).
+    scores = r2_ref[:] - 2.0 * qr  # r2 is (maxlen_pad, 1): broadcast lanes
+    low = jnp.int32((1 << pos_bits) - 1)
+    s = jax.lax.bitcast_convert_type(scores, jnp.int32)
+    key = s ^ (jax.lax.shift_right_arithmetic(s, jnp.int32(31)) & jnp.int32(0x7FFFFFFF))
+    key = (key & ~low) | jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
+    for j in range(blk_k):
+        m = jnp.min(key, axis=0, keepdims=True)  # (1, C) sublane min
+        pos = m & low
+        vkey = m ^ pos  # position bits cleared: the floored value key
+        v = vkey ^ (
+            jax.lax.shift_right_arithmetic(vkey, jnp.int32(31)) & jnp.int32(0x7FFFFFFF)
+        )
+        d_ref[j : j + 1, :] = jax.lax.bitcast_convert_type(v, jnp.float32)
+        p_ref[j : j + 1, :] = pos
+        key = jnp.where(key == m, jnp.int32(IVF_MASKED_KEY), key)
+    if blk_k < d_ref.shape[0]:  # sublane-pad rows: deterministic output
+        pad = jax.lax.broadcasted_iota(
+            jnp.int32, (d_ref.shape[0] - blk_k, key.shape[1]), 0
+        )
+        d_ref[blk_k:, :] = jnp.full_like(pad, IVF_MASKED_D2, jnp.float32)
+        p_ref[blk_k:, :] = jnp.zeros_like(pad)
+
+
+@functools.partial(jax.jit, static_argnames=("blk_k", "interpret"))
+def ivf_scan_select_pallas(
+    qv: jax.Array,
+    rows: jax.Array,
+    r2: jax.Array,
+    blk_k: int,
+    interpret: bool = False,
+):
+    """Fused IVF bucketed scan: per-list residual GEMM + exact per-slot
+    top-``blk_k``, one HBM pass over the index, scores VMEM-resident.
+
+    Replaces the XLA scan's einsum → ``approx_min_k`` pipeline
+    (models/knn.py `_bucketed_core`), whose measured cost was dominated by
+    the selection (9.3 of 26 ms/call at the bench shape) and whose
+    PartialReduce positional loss capped fast-config recall at ~0.945
+    (benchmarks/README.md round-3 frontier). Exactness restores that
+    recall headroom; fusion stops the (nlist, C, maxlen) score tensor
+    from ever reaching HBM.
+
+    Args:
+      qv: (nlist, C, d) compute dtype — pre-gathered query residuals
+        ``(queries − c_list)[bucket]`` (hoisted out of the kernel: dynamic
+        per-row gathers don't belong inside; sequential HBM streaming of
+        the pre-built buffer is the cheap direction).
+      rows: (nlist, maxlen, d) compute dtype — residual list rows
+        (index data; padded rows MUST be zero).
+      r2: (nlist, maxlen) float32 — per-row ‖δ‖² with a ≥1e30 sentinel on
+        invalid/padded rows (strictly below IVF_MASKED_D2).
+      blk_k: per-slot selection width (≤ maxlen).
+
+    Returns (best_d (nlist, blk_k, C) f32 ascending, best_p (nlist, blk_k,
+    C) int32 row positions). Ties resolve to the lowest position; emitted
+    distances are floored within a relative 2^(ceil(log2(maxlen))-24) of
+    the f32 score (the packed-key mantissa trade — kernel docstring).
+    """
+    nlist, C, d = qv.shape
+    maxlen = rows.shape[1]
+    if blk_k > maxlen:
+        raise ValueError(f"blk_k={blk_k} exceeds maxlen={maxlen}")
+    ml_pad = _ceil_to(maxlen, 8)
+    if ml_pad != maxlen:
+        rows = jnp.pad(rows, ((0, 0), (0, ml_pad - maxlen), (0, 0)))
+        r2 = jnp.pad(
+            r2, ((0, 0), (0, ml_pad - maxlen)), constant_values=1e30
+        )
+    pos_bits = max(1, (ml_pad - 1).bit_length())
+    if pos_bits > 16:
+        raise ValueError(f"maxlen={maxlen} too large for packed selection")
+    bk_pad = _ceil_to(blk_k, 8)
+    best_d, best_p = pl.pallas_call(
+        functools.partial(
+            _ivf_scan_select_kernel, blk_k=blk_k, pos_bits=pos_bits
+        ),
+        grid=(nlist,),
+        in_specs=[
+            pl.BlockSpec((None, C, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((None, ml_pad, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((None, ml_pad, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk_pad, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((None, bk_pad, C), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nlist, bk_pad, C), jnp.float32),
+            jax.ShapeDtypeStruct((nlist, bk_pad, C), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 2**20
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(qv, rows, r2[..., None].astype(jnp.float32))
+    return best_d[:, :blk_k], best_p[:, :blk_k]

@@ -217,6 +217,43 @@ def test_ivf_bucketed_matches_dense_no_drops(rng):
     )
 
 
+@pytest.mark.parametrize("rerank", [False, True])
+def test_ivf_bucketed_fused_matches_xla(rng, rerank):
+    # The fused Pallas scan+selection (interpret mode off-TPU) must agree
+    # with the XLA einsum+approx_min_k path wherever the latter is exact:
+    # on CPU approx_min_k lowers to an exact sort, so with no capacity
+    # drops (slack=1e9) both paths return identical neighbor sets. Also
+    # covers lists holding FEWER valid rows than the selection width
+    # (nlist=128 over 1024 rows -> sparse lists), where the kernel emits
+    # sentinel rows that must map to the (+inf, -1) missing contract.
+    from spark_rapids_ml_tpu.models.knn import build_ivf_flat, _ivf_query_fn
+
+    db = rng.normal(size=(1024, 16)).astype(np.float32)
+    queries = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    index = build_ivf_flat(db, nlist=128, seed=0)
+    dev = [
+        jnp.asarray(index.centroids, jnp.float32),
+        jnp.asarray(index.lists),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+    ]
+    k, nprobe = 10, 16
+    kw = dict(mode="bucketed", slack=1e9, rerank=rerank)
+    xla = _ivf_query_fn(k, nprobe, "float32", "float32", fused="off", **kw)
+    fus = _ivf_query_fn(k, nprobe, "float32", "float32", fused="on", **kw)
+    xd, xi = xla(*dev, queries)
+    fd, fi = fus(*dev, queries)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(xi), axis=1), np.sort(np.asarray(fi), axis=1)
+    )
+    finite = np.isfinite(np.asarray(xd))
+    np.testing.assert_allclose(
+        np.sort(np.asarray(fd), axis=1)[finite],
+        np.sort(np.asarray(xd), axis=1)[finite],
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_ivf_bucketed_recall_default_slack(rng):
     # Clustered data + clustered queries (the capacity-pressure case):
     # default slack must still deliver high recall through the estimator
@@ -324,6 +361,39 @@ def test_ivf_sharded_index_matches_unsharded(rng, mesh8):
         [len(set(i_shard[i]) & set(ref_i[i])) / k for i in range(len(queries))]
     )
     assert recall > 0.85, recall
+
+
+def test_ivf_sharded_fused_matches_unsharded(rng, mesh8):
+    # The fused Pallas scan+selection must compose with the shard_map
+    # sharded executor (interpret mode on the CPU mesh): sharded results
+    # must match the single-device fused executor's.
+    from spark_rapids_ml_tpu import config
+
+    centers = rng.normal(size=(16, 12)) * 8
+    db = np.concatenate([c + rng.normal(size=(120, 12)) for c in centers]).astype(
+        np.float32
+    )
+    queries = np.concatenate([c + rng.normal(size=(2, 12)) for c in centers]).astype(
+        np.float32
+    )
+    k = 5
+    with config.option("ann_fused_scan", "on"):
+        model = (
+            ApproximateNearestNeighbors(mesh=mesh8)
+            .setK(k)
+            .setNlist(16)
+            .setNprobe(4)
+            .fit({"features": db})
+        )
+        d_plain, i_plain = model.kneighbors(queries)
+        model.shard_index(mesh8)
+        d_shard, i_shard = model.kneighbors(queries)
+    np.testing.assert_array_equal(
+        np.sort(i_plain, axis=1), np.sort(i_shard, axis=1)
+    )
+    np.testing.assert_allclose(
+        np.sort(d_plain, axis=1), np.sort(d_shard, axis=1), rtol=1e-5
+    )
 
 
 def test_ivf_sharded_model_copy_preserves_sharding(rng, mesh8):

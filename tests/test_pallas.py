@@ -1,5 +1,6 @@
 """Pallas kernel parity tests (interpret mode — no TPU needed)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -201,3 +202,50 @@ def test_newton_stats_block_validation(rng):
             x, np.ones(100, np.float32), np.ones(100, np.float32),
             np.zeros(128, np.float32), 0.0, block_n=64, interpret=True,
         )
+
+
+def test_ivf_scan_select_parity(rng):
+    # Exact per-slot top-k vs a sort-based oracle, including: ties
+    # (first-occurrence/lowest-position contract), padded-row 1e30
+    # sentinels, maxlen and blk_k not multiples of 8, and adversarial
+    # ascending/descending score orderings.
+    from spark_rapids_ml_tpu.ops.pallas_kernels import ivf_scan_select_pallas
+
+    nlist, C, d, maxlen, blk_k = 6, 24, 32, 19, 7
+    qv = rng.normal(size=(nlist, C, d)).astype(np.float32)
+    rows = rng.normal(size=(nlist, maxlen, d)).astype(np.float32)
+    r2 = (rows**2).sum(-1).astype(np.float32)
+    r2[2, 10:] = 1e30
+    rows[2, 10:] = 0  # list with fewer valid rows than... still >= blk_k
+    r2[4, 3:] = 1e30
+    rows[4, 3:] = 0  # FEWER valid rows than blk_k: sentinels must emit
+    rows[3, 5] = rows[3, 6]
+    r2[3, 5] = r2[3, 6]  # exact tie -> lowest position wins
+    # Adversarial orderings: make list 5's scores monotone per slot by
+    # zeroing qv (scores = r2 alone) with ascending then descending r2.
+    qv[5] = 0
+    r2[5] = np.linspace(1.0, 2.0, maxlen, dtype=np.float32)
+
+    bd, bp = ivf_scan_select_pallas(
+        jnp.asarray(qv), jnp.asarray(rows), jnp.asarray(r2), blk_k,
+        interpret=True,
+    )
+    scores = r2[:, None, :] - 2 * np.einsum("lcd,lmd->lcm", qv, rows)
+    ref_p = np.argsort(scores, axis=2, kind="stable")[:, :, :blk_k]
+    ref_d = np.take_along_axis(scores, ref_p, axis=2)
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(bd), (0, 2, 1)), ref_d, rtol=1e-5, atol=1e-4
+    )
+    np.testing.assert_array_equal(np.transpose(np.asarray(bp), (0, 2, 1)), ref_p)
+    # Ascending per-slot output contract.
+    assert np.all(np.diff(np.asarray(bd), axis=1) >= 0)
+
+
+def test_ivf_scan_select_blk_k_validation(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import ivf_scan_select_pallas
+
+    qv = np.zeros((2, 8, 16), np.float32)
+    rows = np.zeros((2, 5, 16), np.float32)
+    r2 = np.zeros((2, 5), np.float32)
+    with pytest.raises(ValueError, match="blk_k"):
+        ivf_scan_select_pallas(qv, rows, r2, 6, interpret=True)
