@@ -31,8 +31,15 @@ N_BASE = int(os.environ.get("SRML_BENCH_BASE_ROWS", 1 << 20))  # 1M×768 = 3.2 G
 N_QUERY = int(os.environ.get("SRML_BENCH_QUERIES", 4096))
 K = int(os.environ.get("SRML_BENCH_K", 10))
 NLIST = int(os.environ.get("SRML_BENCH_NLIST", 1024))
-NPROBE = int(os.environ.get("SRML_BENCH_NPROBE", 32))
+# nprobe 20 / slack 1.4: the round-3 measured frontier point — with the
+# fused kernel's EXACT per-slot selection, probe count (not selection
+# loss) sets recall, and the same-run sweep showed recall@10 *rising* as
+# nprobe fell (smaller final-merge pool -> less PartialReduce loss) while
+# q/s plateaued below nprobe 20 (other stages dominate). 32/1.5 was the
+# approx-selection round-2 point; both sweeps are in benchmarks/README.md.
+NPROBE = int(os.environ.get("SRML_BENCH_NPROBE", 20))
 NCLUST = int(os.environ.get("SRML_BENCH_CLUSTERS", 4096))
+SLACK = float(os.environ.get("SRML_BENCH_SLACK", 1.4))
 
 A100_QUERIES_PER_SEC = 2e5
 
@@ -106,12 +113,12 @@ def main() -> None:
     norms, lists_lo = _residual_index_data(dev[1], dev[0], jnp.bfloat16)
     reps = int(os.environ.get("SRML_BENCH_REPS", 8))
 
-    def measure(rerank: bool):
+    def measure(rerank: bool, slack: float = SLACK, nprobe: int = NPROBE):
         """(q/s, recall@10) at one operating point — BOTH points are
         emitted every run (r2 review: the default config ships
         rerank=on, the headline ran rerank=off; report both always)."""
         query = _ivf_query_fn(
-            K, NPROBE, "bfloat16", "float32", rerank=rerank,
+            K, nprobe, "bfloat16", "float32", rerank=rerank, slack=slack,
             fused=str(config.get("ann_fused_scan")),
         )
         ids0 = np.asarray(
@@ -147,16 +154,30 @@ def main() -> None:
         dt = float(np.median(lats))
         return N_QUERY / dt / n_chips, recall
 
-    if os.environ.get("SRML_BENCH_AB_FUSED"):
-        # Same-run interleaved A/B of the fused Pallas scan+selection vs
-        # the XLA einsum+approx_min_k scan (within-session chip drift
+    ab = os.environ.get("SRML_BENCH_AB_FUSED")
+    if ab:
+        # Same-run interleaved A/B arms (within-session chip drift
         # forbids cross-run comparison — benchmarks/README.md): one extra
         # JSON line per arm, then the normal headline (auto = fused).
-        for arm in ("off", "on"):
-            config.set("ann_fused_scan", arm)
-            qps, rec = measure(rerank=False)
+        # SRML_BENCH_AB_FUSED=1 → the fused-off/on pair; or a
+        # semicolon-separated list of arm specs, e.g.
+        # "fused=off;fused=on;fused=on,slack=1.25,nprobe=28".
+        specs = (
+            ["fused=off", "fused=on"]
+            if ab == "1"
+            else [a for a in ab.split(";") if a]
+        )
+        for spec in specs:
+            kv = dict(p.split("=") for p in spec.split(","))
+            config.set("ann_fused_scan", kv.get("fused", "auto"))
+            qps, rec = measure(
+                rerank=kv.get("rerank", "off") == "on",
+                slack=float(kv.get("slack", SLACK)),
+                nprobe=int(kv.get("nprobe", NPROBE)),
+            )
             emit(
-                f"ivfflat_ab_fused_{arm}_norerank", qps, "queries/s/chip",
+                "ivfflat_ab_" + spec.replace("=", "").replace(",", "_"),
+                qps, "queries/s/chip",
                 qps / A100_QUERIES_PER_SEC, recall_at_10=round(rec, 4),
             )
         config.set("ann_fused_scan", "auto")
