@@ -113,13 +113,15 @@ def main() -> None:
     norms, lists_lo = _residual_index_data(dev[1], dev[0], jnp.bfloat16)
     reps = int(os.environ.get("SRML_BENCH_REPS", 8))
 
-    def measure(rerank: bool, slack: float = SLACK, nprobe: int = NPROBE):
+    def measure(rerank: bool, slack: float = SLACK, nprobe: int = NPROBE,
+                rerank_width: int = 0):
         """(q/s, recall@10) at one operating point — BOTH points are
         emitted every run (r2 review: the default config ships
         rerank=on, the headline ran rerank=off; report both always)."""
         query = _ivf_query_fn(
             K, nprobe, "bfloat16", "float32", rerank=rerank, slack=slack,
             fused=str(config.get("ann_fused_scan")),
+            rerank_width=rerank_width,
         )
         ids0 = np.asarray(
             query(*dev, queries, resid_norms=norms, lists_lo=lists_lo)[1]
@@ -174,6 +176,7 @@ def main() -> None:
                 rerank=kv.get("rerank", "off") == "on",
                 slack=float(kv.get("slack", SLACK)),
                 nprobe=int(kv.get("nprobe", NPROBE)),
+                rerank_width=int(kv.get("rw", 0)),
             )
             emit(
                 "ivfflat_ab_" + spec.replace("=", "").replace(",", "_"),

@@ -81,6 +81,13 @@ _DEFAULTS: Dict[str, Any] = {
     # (q, R, d) raw-row gather is the single most expensive post-scan op).
     # Keep "on" when bf16 score noise matters more than throughput.
     "ann_rerank": _env("ANN_RERANK", True, lambda v: str(v).lower() not in ("0", "false", "off")),
+    # Exact-rerank shortlist width, in units of k: the rerank rescores the
+    # R = ann_rerank_width*k best approximate candidates from the raw f32
+    # rows ((q, R, d) gather — the dominant rerank cost). 0 = auto
+    # (2*ann_shortlist_mult, the historical width sized for approx
+    # selection noise); with the exact fused selection a narrower 2-3
+    # keeps recall while cutting the gather proportionally.
+    "ann_rerank_width": _env("ANN_RERANK_WIDTH", 0, int),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

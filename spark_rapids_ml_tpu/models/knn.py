@@ -623,7 +623,8 @@ def _bucketed_core(
     queries, probe, probe_d2, lists, list_ids, list_mask, resid_norms,
     n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
     list_block: int = 16, shortlist_mult: int = 2, rerank: bool = True,
-    *, lists_lo, centroids, fused: str = "auto", _debug_stage=None,
+    *, lists_lo, centroids, fused: str = "auto", rerank_width: int = 0,
+    _debug_stage=None,
 ):
     """The capacity-bucketed scorer over ONE device's lists.
 
@@ -927,9 +928,16 @@ def _bucketed_core(
         missing = jnp.isinf(neg) | (ids_k < 0)
         win_ids = jnp.where(missing, -1, ids_k)
         return jnp.where(missing, jnp.inf, jnp.maximum(-neg, 0.0)), win_ids
-    # Exact rerank (the ScaNN two-stage): select a 2·mult·k-wide shortlist
+    # Exact rerank (the ScaNN two-stage): select an R = width·k shortlist
     # by approximate score, rescore exactly in f32 from the stored rows.
-    R = min(2 * shortlist_mult * k, nprobe * blk_k)
+    # The (q, R, d) raw-row gather is the dominant rerank cost and scales
+    # linearly with R. Auto width: 2·mult for the approx XLA scan (sized
+    # for its PartialReduce selection noise), mult for the fused kernel —
+    # with EXACT per-slot selection the extra pool bought nothing
+    # (measured same-run at the bench shape: rw 4 → 132.9k q/s, rw 2 →
+    # 148.7k, recall@10 0.9706 identical to 4 decimals).
+    auto_w = shortlist_mult if use_fused else 2 * shortlist_mult
+    R = min((rerank_width or auto_w) * k, nprobe * blk_k)
     negd_R, posR = jax.lax.approx_min_k(cand_d, R, recall_target=0.99)
     negR = -negd_R
     wl = jnp.take_along_axis(cand_list, posR, axis=1)  # (q, R)
@@ -984,7 +992,8 @@ def _residual_index_data(lists, centroids, compute_dtype, chunk: int = 64):
 @functools.lru_cache(maxsize=32)
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
                   slack: float = 1.5, shortlist_mult: int = 2,
-                  rerank: bool = True, fused: str = "auto", _debug_stage=None):
+                  rerank: bool = True, fused: str = "auto",
+                  rerank_width: int = 0, _debug_stage=None):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -1121,7 +1130,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             list_block=16, shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=centroids, fused=fused,
-            _debug_stage=_debug_stage,
+            rerank_width=rerank_width, _debug_stage=_debug_stage,
         )
 
     @jax.jit
@@ -1189,7 +1198,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
 def _ivf_query_fn_sharded(
     k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5,
     shortlist_mult: int = 2,
-    rerank: bool = True, fused: str = "auto",
+    rerank: bool = True, fused: str = "auto", rerank_width: int = 0,
 ):
     """Sharded IVF query: inverted lists sharded over the ``data`` mesh
     axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
@@ -1240,6 +1249,7 @@ def _ivf_query_fn_sharded(
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=cent_local, fused=fused,
+            rerank_width=rerank_width,
         )
         # Merge the per-device top-k: O(q·k·devices) over ICI.
         cat_d = jax.lax.all_gather(dists, DATA_AXIS, axis=1, tiled=True)
@@ -1477,6 +1487,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
                     rerank=bool(config.get("ann_rerank")),
                     fused=str(config.get("ann_fused_scan")),
+                    rerank_width=int(config.get("ann_rerank_width")),
                 )
             else:
                 fn = _ivf_query_fn(
@@ -1485,6 +1496,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     shortlist_mult=int(config.get("ann_shortlist_mult")),
                     rerank=bool(config.get("ann_rerank")),
                     fused=str(config.get("ann_fused_scan")),
+                    rerank_width=int(config.get("ann_rerank_width")),
                 )
             cent, lists, ids_dev, mask = self._ensure_dev_index()
             cd = jnp.dtype(config.get("compute_dtype"))
