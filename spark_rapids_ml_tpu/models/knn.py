@@ -47,7 +47,10 @@ from spark_rapids_ml_tpu.core.params import (
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops.distances import sq_euclidean
-from spark_rapids_ml_tpu.ops.pallas_kernels import ivf_scan_select_pallas
+from spark_rapids_ml_tpu.ops.pallas_kernels import (
+    ivf_scan_select_pallas,
+    probe_select_pallas,
+)
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding
 from spark_rapids_ml_tpu.utils.profiling import trace_span
@@ -608,6 +611,16 @@ def _bucketed_capacity(q: int, nprobe: int, nlist: int, slack: float) -> int:
     return min(q, max(8, ((cap + 7) // 8) * 8))
 
 
+def _probe_select_fits(nlist: int, d: int, qb: int) -> bool:
+    """Feasibility gate for probe_select_pallas: the packed position bits
+    must fit (nlist ≤ 65536 after 8-padding) and the resident centroid
+    panel + (nlist, qb) f32 distance tile must fit VMEM."""
+    nl8 = -(-nlist // 8) * 8
+    if max(1, (nl8 - 1).bit_length()) > 16:
+        return False
+    return (nl8 * (d + qb + 1) + d * qb) * 4 <= 48 * 2**20
+
+
 def _fused_scan_fits(C: int, maxlen: int, d: int, compute_dtype) -> bool:
     """VMEM feasibility gate for ivf_scan_select_pallas's ``auto`` mode:
     per grid step the kernel holds the (C_pad, d) query block, the
@@ -1088,6 +1101,34 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
 
     @jax.jit
     def probe_bucketed(centroids, queries):
+        # Fused probe kernel (same gate family as the scan kernel): f32
+        # centroid GEMM + EXACT packed-key top-nprobe per query in one
+        # Pallas call — removes both the XLA approx_min_k's cost (the
+        # probe stage's dominant op) and its recall_target=0.95
+        # approximation, making probe coverage exact. f64 accum configs
+        # and non-dividing query blocks fall through to the XLA path.
+        fu = str(fused).lower()
+        q = queries.shape[0]
+        nlist_, d_ = centroids.shape
+        qb = min(512, q)
+        # "on" means "use wherever representable" (same semantics as the
+        # scan gate's f64 carve-out): infeasible shapes — f64 accum,
+        # non-dividing query batches, nlist past the packed-key bits or
+        # the VMEM tile — fall through to the XLA probe either way.
+        use_kernel = (
+            fu == "on"
+            or (fu == "auto" and jax.default_backend() == "tpu")
+        ) and (
+            jnp.dtype(accum_dtype) != jnp.float64
+            and q % qb == 0
+            and _probe_select_fits(nlist_, d_, qb)
+        )
+        if use_kernel:
+            probe, probe_d2 = probe_select_pallas(
+                centroids, queries, nprobe, block_q=qb,
+                interpret=jax.default_backend() != "tpu",
+            )
+            return probe, probe_d2
         from spark_rapids_ml_tpu.ops.gram import mm_precision
 
         # Full-f32 centroid distances: the values feed the residual

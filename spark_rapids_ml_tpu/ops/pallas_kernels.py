@@ -673,6 +673,7 @@ def ivf_scan_select_pallas(
         functools.partial(
             _ivf_scan_select_kernel, blk_k=blk_k, pos_bits=pos_bits
         ),
+        name="ivf_scan_select",
         grid=(nlist,),
         in_specs=[
             pl.BlockSpec((None, C, d), lambda i: (i, 0, 0)),
@@ -695,3 +696,122 @@ def ivf_scan_select_pallas(
         interpret=interpret,
     )(qv, rows, r2[..., None].astype(jnp.float32))
     return best_d[:, :blk_k], best_p[:, :blk_k]
+
+
+# ---------------------------------------------------------------------------
+# Fused IVF probe: centroid distances + EXACT per-query top-nprobe
+# ---------------------------------------------------------------------------
+
+
+def _probe_select_kernel(
+    cent_ref, c2h_ref, qT_ref, q2_ref, d_ref, p_ref, *, nprobe, pos_bits
+):
+    """One query block per grid step: ‖q−c‖² against ALL centroids + exact
+    top-nprobe per query, the (nlist, qb) distance tile VMEM-resident.
+
+    Same layout discipline and packed-key extraction as
+    ``_ivf_scan_select_kernel`` — here LISTS ride the sublanes and QUERIES
+    the lanes, so the per-query selection reduces over sublanes. The f32
+    GEMM runs at HIGHEST precision: probe distances feed the residual
+    identity's cross-list ‖q−c‖² term, where bf16-magnitude noise corrupts
+    the candidate ordering (models/knn.py probe_bucketed). Replacing the
+    XLA ``approx_min_k(recall_target=0.95)`` makes probing EXACT — the one
+    approximation that op added to probe coverage is gone.
+    """
+    cq = jax.lax.dot_general(
+        cent_ref[:], qT_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (nlist_pad, qb)
+    # True ‖q−c‖²: the ‖q‖² term is a per-query (lane) constant — it
+    # cannot change this selection OR the downstream cross-list ranking,
+    # but the emitted values ARE the user-visible distance components, so
+    # keep them true distances. Padded centroid rows carry a 1e30 c2h
+    # sentinel and never win (nprobe ≤ nlist enforced by callers).
+    scores = c2h_ref[:] - 2.0 * cq + q2_ref[:]
+    low = jnp.int32((1 << pos_bits) - 1)
+    s = jax.lax.bitcast_convert_type(scores, jnp.int32)
+    key = s ^ (jax.lax.shift_right_arithmetic(s, jnp.int32(31)) & jnp.int32(0x7FFFFFFF))
+    key = (key & ~low) | jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
+    for j in range(nprobe):
+        m = jnp.min(key, axis=0, keepdims=True)  # (1, qb) sublane min
+        pos = m & low
+        vkey = m ^ pos
+        v = vkey ^ (
+            jax.lax.shift_right_arithmetic(vkey, jnp.int32(31))
+            & jnp.int32(0x7FFFFFFF)
+        )
+        d_ref[j : j + 1, :] = jax.lax.bitcast_convert_type(v, jnp.float32)
+        p_ref[j : j + 1, :] = pos
+        key = jnp.where(key == m, jnp.int32(IVF_MASKED_KEY), key)
+    if nprobe < d_ref.shape[0]:
+        pad = jax.lax.broadcasted_iota(
+            jnp.int32, (d_ref.shape[0] - nprobe, key.shape[1]), 0
+        )
+        d_ref[nprobe:, :] = jnp.full_like(pad, IVF_MASKED_D2, jnp.float32)
+        p_ref[nprobe:, :] = jnp.zeros_like(pad)
+
+
+@functools.partial(jax.jit, static_argnames=("nprobe", "block_q", "interpret"))
+def probe_select_pallas(
+    centroids: jax.Array,
+    queries: jax.Array,
+    nprobe: int,
+    block_q: int = 512,
+    interpret: bool = False,
+):
+    """Exact IVF probe: (probe ids (q, nprobe) int32 ascending-by-distance,
+    probe_d2 (q, nprobe) f32 true ‖q−c‖²) in one fused kernel.
+
+    centroids: (nlist, d) — padded rows allowed if masked by the caller
+    via huge norms; here rows are taken as-is and ``nprobe ≤ nlist`` is
+    the caller's contract. queries: (q, d); q must divide block_q or be
+    smaller. Emitted distances carry the packed-key mantissa floor
+    (relative 2^(ceil(log2(nlist))-24) — see _ivf_scan_select_kernel).
+    """
+    nlist, d = centroids.shape
+    q = queries.shape[0]
+    qb = min(block_q, q)
+    if q % qb:
+        raise ValueError(f"q={q} not divisible by block_q={qb}")
+    nl_pad = _ceil_to(nlist, 8)
+    cent = jnp.asarray(centroids, jnp.float32)
+    c2 = jnp.sum(jnp.square(cent), axis=1, keepdims=True)  # (nlist, 1)
+    if nl_pad != nlist:
+        cent = jnp.pad(cent, ((0, nl_pad - nlist), (0, 0)))
+        c2 = jnp.pad(c2, ((0, nl_pad - nlist), (0, 0)), constant_values=1e30)
+    pos_bits = max(1, (nl_pad - 1).bit_length())
+    if pos_bits > 16:
+        raise ValueError(f"nlist={nlist} too large for packed probe selection")
+    qf = jnp.asarray(queries, jnp.float32)
+    qT = qf.T  # (d, q)
+    q2 = jnp.sum(jnp.square(qf), axis=1)[None, :]  # (1, q)
+    np_pad = _ceil_to(nprobe, 8)
+    best_d, best_p = pl.pallas_call(
+        functools.partial(
+            _probe_select_kernel, nprobe=nprobe, pos_bits=pos_bits
+        ),
+        name="ivf_probe_select",
+        grid=(q // qb,),
+        in_specs=[
+            pl.BlockSpec((nl_pad, d), lambda i: (0, 0)),
+            pl.BlockSpec((nl_pad, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d, qb), lambda i: (0, i)),
+            pl.BlockSpec((1, qb), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((np_pad, qb), lambda i: (0, i)),
+            pl.BlockSpec((np_pad, qb), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_pad, q), jnp.float32),
+            jax.ShapeDtypeStruct((np_pad, q), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 2**20
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(cent, c2, qT, q2)
+    return best_p[:nprobe].T, best_d[:nprobe].T

@@ -218,6 +218,13 @@ def test_ivf_bucketed_matches_dense_no_drops(rng):
 
 
 @pytest.mark.parametrize("rerank", [False, True])
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "cpu",
+    reason="CPU-only algebraic check: on real TPUs the XLA arm's "
+    "approx_min_k probe/selection are genuinely approximate, so exact "
+    "equality with the fused (exact) arm only holds where they lower to "
+    "exact sorts",
+)
 def test_ivf_bucketed_fused_matches_xla(rng, rerank):
     # The fused Pallas scan+selection (interpret mode off-TPU) must agree
     # with the XLA einsum+approx_min_k path wherever the latter is exact:
@@ -246,11 +253,15 @@ def test_ivf_bucketed_fused_matches_xla(rng, rerank):
     np.testing.assert_array_equal(
         np.sort(np.asarray(xi), axis=1), np.sort(np.asarray(fi), axis=1)
     )
+    # Value tolerance covers the fused kernels' packed-key mantissa floor
+    # (probe_d2 and scan scores are floored within a relative
+    # 2^(ceil(log2(n))-24) — ops/pallas_kernels.py; neighbor IDS above
+    # must still match exactly).
     finite = np.isfinite(np.asarray(xd))
     np.testing.assert_allclose(
         np.sort(np.asarray(fd), axis=1)[finite],
         np.sort(np.asarray(xd), axis=1)[finite],
-        rtol=1e-5, atol=1e-5,
+        rtol=5e-4, atol=5e-4,
     )
 
 

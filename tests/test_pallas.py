@@ -249,3 +249,37 @@ def test_ivf_scan_select_blk_k_validation(rng):
     r2 = np.zeros((2, 5), np.float32)
     with pytest.raises(ValueError, match="blk_k"):
         ivf_scan_select_pallas(qv, rows, r2, 6, interpret=True)
+
+
+def test_probe_select_parity(rng):
+    # Exact per-query top-nprobe centroid probe vs a sort oracle: true
+    # distances (the per-query norm term is included), ascending order,
+    # first-occurrence ties, non-multiple-of-8 nlist.
+    from spark_rapids_ml_tpu.ops.pallas_kernels import probe_select_pallas
+
+    nlist, d, q, nprobe = 37, 24, 128, 5
+    cent = rng.normal(size=(nlist, d)).astype(np.float32)
+    qs = rng.normal(size=(q, d)).astype(np.float32)
+    cent[7] = cent[11]  # duplicate centroid -> tie resolves to lower id
+    ids, d2 = probe_select_pallas(
+        jnp.asarray(cent), jnp.asarray(qs), nprobe, block_q=64, interpret=True
+    )
+    ref = ((qs[:, None, :] - cent[None]) ** 2).sum(-1)
+    ref_ids = np.argsort(ref, axis=1, kind="stable")[:, :nprobe]
+    np.testing.assert_array_equal(np.asarray(ids), ref_ids)
+    np.testing.assert_allclose(
+        np.asarray(d2), np.take_along_axis(ref, ref_ids, axis=1),
+        rtol=1e-3, atol=1e-3,
+    )
+    assert np.all(np.diff(np.asarray(d2), axis=1) >= 0)
+
+
+def test_probe_select_block_validation(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import probe_select_pallas
+
+    cent = np.zeros((8, 16), np.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        probe_select_pallas(
+            cent, np.zeros((600, 16), np.float32), 2, block_q=512,
+            interpret=True,
+        )
