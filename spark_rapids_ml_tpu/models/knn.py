@@ -62,7 +62,11 @@ from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
 @functools.lru_cache(maxsize=32)
-def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
+def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
+    """metric "l2": ascending squared-Euclidean (callers post-process to
+    euclidean/sqeuclidean/cosine — the latter two are monotone transforms
+    on appropriately normalized inputs). metric "ip": descending inner
+    product (MIPS); returned "distances" are the similarities."""
     compute_dtype = jnp.dtype(cd)
     accum_dtype = jnp.dtype(ad)
 
@@ -77,10 +81,21 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
         # then all of its rows. The union of per-shard top-min(k, m_local)
         # still contains the global top-k (k <= n total valid rows).
         kl = min(k, m_local)
-        d2 = sq_euclidean(
-            queries.astype(compute_dtype), db.astype(compute_dtype),
-            accum_dtype=accum_dtype,
-        )  # (q, m_local)
+        if metric == "ip":
+            from spark_rapids_ml_tpu.ops.gram import mm_precision
+
+            with mm_precision(compute_dtype):
+                d2 = -jnp.einsum(
+                    "qd,md->qm",
+                    queries.astype(compute_dtype),
+                    db.astype(compute_dtype),
+                    preferred_element_type=accum_dtype,
+                )  # negated: the shared min-merge machinery then applies
+        else:
+            d2 = sq_euclidean(
+                queries.astype(compute_dtype), db.astype(compute_dtype),
+                accum_dtype=accum_dtype,
+            )  # (q, m_local)
         # Masked-out (padding) rows get +inf so they never win.
         d2 = jnp.where(mask[None, :] > 0, d2, jnp.inf)
         neg, local_idx = jax.lax.top_k(-d2, kl)  # (q, kl)
@@ -103,6 +118,17 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str):
     return jax.jit(f)
 
 
+KNN_METRICS = ("euclidean", "sqeuclidean", "cosine", "inner_product")
+
+
+def _normalized_rows(x: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    """Unit-normalize rows (cosine-metric preprocessing). Zero rows stay
+    zero: their cosine distance to everything is then the constant 1."""
+    x = np.asarray(x, np.float32 if x.dtype != np.float64 else np.float64)
+    n = np.linalg.norm(x, axis=1, keepdims=True)
+    return x / np.maximum(n, eps)
+
+
 class _NNParams(HasFeaturesCol, HasSeed):
     k = ParamDecl(
         "k",
@@ -110,13 +136,23 @@ class _NNParams(HasFeaturesCol, HasSeed):
         TypeConverters.toInt,
         validator=ParamValidators.gt(0),
     )
+    metric = ParamDecl(
+        "metric",
+        "distance metric: euclidean (default), sqeuclidean, cosine, or "
+        "inner_product (exact KNN only; returns similarities descending)",
+        TypeConverters.toString,
+        validator=ParamValidators.inList(KNN_METRICS),
+    )
 
     def __init__(self, uid=None):
         super().__init__(uid=uid)
-        self.setDefault(k=5, featuresCol="features", seed=0)
+        self.setDefault(k=5, featuresCol="features", seed=0, metric="euclidean")
 
     def getK(self) -> int:
         return self.getOrDefault(self.k)
+
+    def getMetric(self) -> str:
+        return self.getOrDefault(self.metric)
 
 
 class NearestNeighbors(Estimator, _NNParams, MLWritable, MLReadable):
@@ -130,6 +166,9 @@ class NearestNeighbors(Estimator, _NNParams, MLWritable, MLReadable):
 
     def setK(self, value: int) -> "NearestNeighbors":
         return self._set(k=value)
+
+    def setMetric(self, value: str) -> "NearestNeighbors":
+        return self._set(metric=value)
 
     def _copy_extra_state(self, source):
         self._mesh = getattr(source, "_mesh", None)
@@ -145,7 +184,10 @@ class NearestNeighbors(Estimator, _NNParams, MLWritable, MLReadable):
 class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
     _uid_prefix = "NearestNeighborsModel"
     # device-resident index state rebuilds via _ensure_index after unpickle
-    _transient_attrs = ("_mesh", "_db_sharded", "_db_mask", "_db_ids", "_n_global")
+    _transient_attrs = (
+        "_mesh", "_db_sharded", "_db_mask", "_db_ids", "_n_global",
+        "_index_metric",
+    )
 
     def __init__(self, database: Optional[np.ndarray] = None, mesh=None, uid=None):
         super().__init__(uid=uid)
@@ -155,6 +197,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         self._db_mask = None
         self._db_ids = None
         self._n_global = None
+        self._index_metric = None
 
     def _model_data(self):
         return {"database": self.database}
@@ -168,6 +211,10 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         self._mesh = getattr(source, "_mesh", None)
 
     def _ensure_index(self, mesh):
+        metric = self.getMetric()
+        if getattr(self, "_index_metric", None) != metric:
+            self._db_sharded = None  # metric changed: rebuild (cosine
+            self._index_metric = metric  # shards the NORMALIZED copy)
         if self._db_sharded is None:
             from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 
@@ -183,8 +230,13 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
                 lo = int(counts[: jax.process_index()].sum())
             else:
                 lo = 0
+            db = (
+                _normalized_rows(self.database)
+                if metric == "cosine"
+                else self.database
+            )
             self._db_sharded, self._db_mask, self._n_global = shard_rows(
-                self.database, mesh
+                db, mesh
             )
             # Explicit id map; +1 shift so shard_rows's zero-padding decodes
             # to -1 (a real row 0 must stay distinguishable from padding).
@@ -198,7 +250,10 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
     def kneighbors(
         self, queries: np.ndarray, k: Optional[int] = None
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (distances (q, k), indices (q, k)), Euclidean, ascending.
+        """Returns (distances (q, k), indices (q, k)) under ``metric``:
+        euclidean (default) / sqeuclidean / cosine ascending, or
+        inner_product DESCENDING (the "distances" are the similarities —
+        the MIPS convention).
 
         Multi-process: every process passes the SAME query batch and its
         own local database slice was used at fit; returned indices are
@@ -212,7 +267,10 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         n = self._n_global
         if not 0 < k <= n:
             raise ValueError(f"k = {k} out of range (0, numRows = {n}]")
+        metric = self.getMetric()
         queries = np.asarray(queries)
+        if metric == "cosine":
+            queries = _normalized_rows(queries)
         q = queries.shape[0]
         bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
         qp, _ = pad_rows(queries, bucket)
@@ -220,13 +278,25 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
             from spark_rapids_ml_tpu.parallel.sharding import replicated_array
 
             fn = _exact_knn_fn(
-                mesh, k, config.get("compute_dtype"), config.get("accum_dtype")
+                mesh, k, config.get("compute_dtype"), config.get("accum_dtype"),
+                metric="ip" if metric == "inner_product" else "l2",
             )
             d2, idx = jax.device_get(
                 fn(self._db_sharded, self._db_mask, self._db_ids,
                    replicated_array(qp, mesh))
             )
-        return np.sqrt(np.maximum(d2[:q], 0)), idx[:q].astype(np.int64)
+        idx = idx[:q].astype(np.int64)
+        if metric == "inner_product":
+            # d2 holds NEGATED products (the shared ascending merge); the
+            # +inf of never-found slots decodes to -inf similarity.
+            return -d2[:q], idx
+        if metric == "sqeuclidean":
+            return np.maximum(d2[:q], 0), idx
+        if metric == "cosine":
+            # rows and queries are unit vectors: ||q-x||^2 = 2 - 2cos,
+            # so the cosine distance (1 - cos) is half the squared L2.
+            return np.clip(d2[:q] / 2.0, 0, None), idx
+        return np.sqrt(np.maximum(d2[:q], 0)), idx
 
     def _transform(self, dataset):
         x = as_matrix(dataset, self.getFeaturesCol())
@@ -1383,14 +1453,29 @@ class ApproximateNearestNeighbors(Estimator, _ANNParams, MLWritable, MLReadable)
     def setNprobe(self, value: int) -> "ApproximateNearestNeighbors":
         return self._set(nprobe=value)
 
+    def setMetric(self, value: str) -> "ApproximateNearestNeighbors":
+        return self._set(metric=value)
+
     def _copy_extra_state(self, source):
         self._mesh = getattr(source, "_mesh", None)
 
     def _fit(self, dataset) -> "ApproximateNearestNeighborsModel":
-        x = as_matrix(dataset, self.getFeaturesCol())
+        metric = self.getMetric()
+        if metric == "inner_product":
+            raise ValueError(
+                "metric='inner_product' is supported by the exact "
+                "NearestNeighbors only (IVF-Flat partitions by L2 "
+                "proximity; MIPS needs a different quantizer)"
+            )
+        x = np.asarray(as_matrix(dataset, self.getFeaturesCol()))
+        if metric == "cosine":
+            # The index stores the UNIT-normalized rows: L2 on them is a
+            # monotone transform of cosine distance, so the whole IVF
+            # machinery (quantizer, residual scan, rerank) applies as-is.
+            x = _normalized_rows(x)
         with trace_span("ivf build"):
             index = build_ivf_flat(
-                np.asarray(x), nlist=self.getNlist(), seed=self.getSeed(), mesh=self._mesh
+                x, nlist=self.getNlist(), seed=self.getSeed(), mesh=self._mesh
             )
         model = ApproximateNearestNeighborsModel(index=index)
         model.uid = self.uid
@@ -1496,7 +1581,8 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
     def kneighbors(
         self, queries: np.ndarray, k: Optional[int] = None
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Approximate (distances, indices), Euclidean, ascending.
+        """Approximate (distances, indices) under ``metric`` — euclidean
+        (default) / sqeuclidean / cosine — ascending.
 
         IVF semantics: only the ``nprobe`` nearest lists are searched. If the
         probed lists hold fewer than k valid points for some query, the tail
@@ -1516,7 +1602,10 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                 f"candidate pool nprobe*maxlen = {pool} < k = {k}; "
                 f"increase nprobe (or nlist granularity)"
             )
+        metric = self.getMetric()
         queries = np.asarray(queries)
+        if metric == "cosine":
+            queries = _normalized_rows(queries)  # index rows were at fit
         q = queries.shape[0]
         bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
         qp, _ = pad_rows(queries, bucket)
@@ -1553,7 +1642,13 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                 fn(cent, lists, ids_dev, mask, jnp.asarray(qp),
                    n_valid=q, resid_norms=rnorms, lists_lo=lists_lo)
             )
-        return np.sqrt(np.maximum(d2[:q], 0)), ids[:q].astype(np.int64)
+        ids = ids[:q].astype(np.int64)
+        if metric == "sqeuclidean":
+            return np.maximum(d2[:q], 0), ids
+        if metric == "cosine":
+            # unit rows: cosine distance = ||q - x||^2 / 2 (see exact path)
+            return np.clip(d2[:q] / 2.0, 0, None), ids
+        return np.sqrt(np.maximum(d2[:q], 0)), ids
 
     def _transform(self, dataset):
         x = as_matrix(dataset, self.getFeaturesCol())

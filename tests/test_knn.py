@@ -549,3 +549,119 @@ def test_clustered_build_bounds_maxlen_and_keeps_recall(rng, mesh8):
         [len(set(got[i]) & set(gt[i])) / 10 for i in range(len(q))]
     )
     assert recall >= 0.9
+
+
+def test_exact_cosine_matches_sklearn(db_and_queries, mesh8):
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    db, queries = db_and_queries
+    k = 7
+    model = NearestNeighbors(mesh=mesh8).setK(k).setMetric("cosine").fit(
+        {"features": db}
+    )
+    dists, idx = model.kneighbors(queries)
+    sk = SkNN(n_neighbors=k, metric="cosine", algorithm="brute").fit(db)
+    ref_d, ref_i = sk.kneighbors(queries)
+    np.testing.assert_array_equal(idx, ref_i)
+    np.testing.assert_allclose(dists, ref_d, rtol=1e-5, atol=1e-5)
+
+
+def test_exact_sqeuclidean_is_squared_euclidean(db_and_queries, mesh8):
+    db, queries = db_and_queries
+    m_e = NearestNeighbors(mesh=mesh8).setK(5).fit({"features": db})
+    m_s = (
+        NearestNeighbors(mesh=mesh8).setK(5).setMetric("sqeuclidean").fit(
+            {"features": db}
+        )
+    )
+    d_e, i_e = m_e.kneighbors(queries)
+    d_s, i_s = m_s.kneighbors(queries)
+    np.testing.assert_array_equal(i_e, i_s)
+    np.testing.assert_allclose(d_e**2, d_s, rtol=1e-5, atol=1e-6)
+
+
+def test_exact_inner_product_descending_vs_numpy(db_and_queries, mesh8):
+    db, queries = db_and_queries
+    k = 6
+    model = (
+        NearestNeighbors(mesh=mesh8).setK(k).setMetric("inner_product").fit(
+            {"features": db}
+        )
+    )
+    sims, idx = model.kneighbors(queries)
+    ip = queries @ db.T
+    ref_i = np.argsort(-ip, axis=1, kind="stable")[:, :k]
+    np.testing.assert_array_equal(idx, ref_i)
+    np.testing.assert_allclose(
+        sims, np.take_along_axis(ip, ref_i, axis=1), rtol=1e-5, atol=1e-5
+    )
+    assert np.all(np.diff(sims, axis=1) <= 1e-6)  # descending similarities
+
+
+def test_exact_metric_switch_rebuilds_index(db_and_queries, mesh8):
+    # Same model queried under two metrics: the cached (possibly
+    # normalized) device index must rebuild on the switch.
+    db, queries = db_and_queries
+    model = NearestNeighbors(mesh=mesh8).setK(5).fit({"features": db})
+    d_e, _ = model.kneighbors(queries)
+    model._set(metric="cosine")
+    d_c, _ = model.kneighbors(queries)
+    assert np.all(d_c <= 2.0 + 1e-6)  # cosine distances, not L2
+    model._set(metric="euclidean")
+    d_e2, _ = model.kneighbors(queries)
+    np.testing.assert_allclose(d_e, d_e2, rtol=1e-6)
+
+
+def test_ann_cosine_recall(rng, mesh8):
+    # Clustered directions: IVF on unit-normalized rows must recover the
+    # brute-force cosine neighbors.
+    centers = rng.normal(size=(16, 24))
+    db = np.concatenate(
+        [c * rng.uniform(0.5, 2.0, size=(150, 1)) + 0.05 * rng.normal(size=(150, 24)) for c in centers]
+    ).astype(np.float32)
+    queries = np.concatenate(
+        [c * rng.uniform(0.5, 2.0, size=(3, 1)) + 0.05 * rng.normal(size=(3, 24)) for c in centers]
+    ).astype(np.float32)
+    k = 10
+    ann = (
+        ApproximateNearestNeighbors()
+        .setK(k)
+        .setNlist(16)
+        .setNprobe(8)
+        .setMetric("cosine")
+        .fit({"features": db})
+    )
+    dists, idx = ann.kneighbors(queries)
+    # brute cosine ground truth
+    dbn = db / np.linalg.norm(db, axis=1, keepdims=True)
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    ref_i = np.argsort(1 - qn @ dbn.T, axis=1, kind="stable")[:, :k]
+    recall = np.mean(
+        [len(set(idx[i]) & set(ref_i[i])) / k for i in range(len(queries))]
+    )
+    assert recall > 0.9, recall
+    assert np.all(dists >= -1e-6) and np.all(dists[np.isfinite(dists)] <= 2 + 1e-6)
+
+
+def test_ann_inner_product_rejected(rng):
+    with pytest.raises(ValueError, match="inner_product"):
+        ApproximateNearestNeighbors().setMetric("inner_product").fit(
+            {"features": rng.normal(size=(100, 8)).astype(np.float32)}
+        )
+
+
+def test_metric_param_persists(db_and_queries, mesh8, tmp_path):
+    db, queries = db_and_queries
+    model = (
+        NearestNeighbors(mesh=mesh8).setK(4).setMetric("cosine").fit(
+            {"features": db}
+        )
+    )
+    path = str(tmp_path / "nn_cosine")
+    model.save(path)
+    loaded = NearestNeighborsModel.load(path)
+    assert loaded.getMetric() == "cosine"
+    d0, i0 = model.kneighbors(queries)
+    d1, i1 = loaded.kneighbors(queries)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_allclose(d0, d1, rtol=1e-6)
