@@ -281,6 +281,7 @@ class DataPlaneClient:
         nlist: Optional[int] = None,
         nprobe: Optional[int] = None,
         seed: int = 0,
+        metric: str = "euclidean",
     ) -> Dict[str, np.ndarray]:
         """Build the index from a knn job's accumulated rows ON the daemon
         and register it as ``register_as`` for :meth:`kneighbors` serving.
@@ -288,6 +289,7 @@ class DataPlaneClient:
         "maxlen"]}) — the index itself never crosses the wire."""
         params: Dict[str, Any] = {
             "mode": mode, "register_as": register_as, "seed": seed,
+            "metric": metric,
         }
         if nlist is not None:
             params["nlist"] = nlist

@@ -502,6 +502,7 @@ class _Job:
                 raise ValueError("finalize before any feed: no rows")
             rows = np.concatenate(blocks)
             mode = str(params.get("mode", "exact"))
+            metric = str(params.get("metric") or "euclidean")
             info = {
                 "n_rows": np.asarray([rows.shape[0]], np.int64),
                 "n_cols": np.asarray([rows.shape[1]], np.int64),
@@ -511,15 +512,26 @@ class _Job:
 
                 from spark_rapids_ml_tpu.models.knn import (
                     ApproximateNearestNeighborsModel,
+                    _normalized_rows,
                     build_ivf_flat_device,
                 )
 
+                if metric == "inner_product":
+                    raise ValueError(
+                        "metric='inner_product' needs mode='exact' (IVF "
+                        "partitions by L2 proximity)"
+                    )
+                if metric == "cosine":
+                    # Same contract as the core fit: the index stores
+                    # unit-normalized rows; kneighbors normalizes queries.
+                    rows = _normalized_rows(rows)
                 nlist = int(params["nlist"])
                 index = build_ivf_flat_device(
                     jnp.asarray(rows), nlist=nlist,
                     seed=int(params.get("seed") or 0),
                 )
                 model = ApproximateNearestNeighborsModel(index=index)
+                model._set(metric=metric)
                 if params.get("nprobe"):
                     model._set(nprobe=int(params["nprobe"]))
                 info["nlist"] = np.asarray([nlist], np.int64)
@@ -528,6 +540,7 @@ class _Job:
                 from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
 
                 model = NearestNeighborsModel(database=rows, mesh=self.mesh)
+                model._set(metric=metric)
             else:
                 raise ValueError(f"unknown knn mode {mode!r} (exact|ivf)")
             self.dropped = True  # rows are consumed by the built index

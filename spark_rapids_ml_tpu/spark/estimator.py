@@ -238,6 +238,15 @@ class _SparkAdapter:
         input_col = core.getOrDefault("featuresCol")
         sel = df.select(input_col)
         ivf = core.hasParam("nlist")
+        metric = (
+            core.getOrDefault("metric") if core.hasParam("metric")
+            else "euclidean"
+        )
+        if ivf and metric == "inner_product":
+            raise ValueError(
+                "metric='inner_product' is supported by the exact "
+                "NearestNeighbors only"
+            )
 
         from spark_rapids_ml_tpu.serve.client import DataPlaneClient
 
@@ -254,10 +263,12 @@ class _SparkAdapter:
                     info = client.finalize_knn(
                         job, register_as=name, mode="ivf",
                         nlist=core.getNlist(), nprobe=core.getNprobe(),
-                        seed=core.getSeed(),
+                        seed=core.getSeed(), metric=metric,
                     )
                 else:
-                    info = client.finalize_knn(job, register_as=name, mode="exact")
+                    info = client.finalize_knn(
+                        job, register_as=name, mode="exact", metric=metric
+                    )
             except Exception:
                 try:
                     client.drop(job)

@@ -345,6 +345,36 @@ def test_spark_ann_daemon_fed_build_and_query(rng, mesh8):
     np.testing.assert_array_equal(got, idx)
 
 
+def test_spark_ann_daemon_cosine_metric(rng, mesh8):
+    """The daemon-side IVF build must honor metric='cosine': rows are
+    unit-normalized before the device build and queries normalize at
+    serve time, so returned neighbors match brute-force cosine."""
+    from spark_rapids_ml_tpu.spark.estimator import SparkApproximateNearestNeighbors
+
+    kc, d, k = 8, 12, 5
+    dirs = rng.normal(size=(kc, d))
+    x = np.concatenate(
+        [dr * rng.uniform(0.5, 3.0, size=(60, 1)) + 0.03 * rng.normal(size=(60, d)) for dr in dirs]
+    ).astype(np.float32)
+    df = simdf_from_numpy(x, n_partitions=3)
+    model = (
+        SparkApproximateNearestNeighbors()
+        .setK(k).setNlist(kc).setNprobe(kc).setMetric("cosine")
+        .fit(df)
+    )
+    q = x[:24]
+    dists, idx = model.kneighbors(q)
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    cos_d = 1.0 - qn @ xn.T
+    want = np.argsort(cos_d, axis=1, kind="stable")[:, :k]
+    recall = np.mean(
+        [len(set(idx[i]) & set(want[i])) / k for i in range(len(q))]
+    )
+    assert recall > 0.9, recall
+    assert np.all(dists[np.isfinite(dists)] <= 2 + 1e-5)
+
+
 def test_spark_knn_fit_survives_task_retry(rng, mesh8):
     """Row blocks stage per (partition, attempt); a mid-partition death
     must not duplicate or lose rows."""
