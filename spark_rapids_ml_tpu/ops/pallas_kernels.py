@@ -620,12 +620,13 @@ def _ivf_scan_select_kernel(
         p_ref[blk_k:, :] = jnp.zeros_like(pad)
 
 
-@functools.partial(jax.jit, static_argnames=("blk_k", "interpret"))
+@functools.partial(jax.jit, static_argnames=("blk_k", "keep_pad", "interpret"))
 def ivf_scan_select_pallas(
     qv: jax.Array,
     rows: jax.Array,
     r2: jax.Array,
     blk_k: int,
+    keep_pad: bool = False,
     interpret: bool = False,
 ):
     """Fused IVF bucketed scan: per-list residual GEMM + exact per-slot
@@ -695,6 +696,13 @@ def ivf_scan_select_pallas(
         else None,
         interpret=interpret,
     )(qv, rows, r2[..., None].astype(jnp.float32))
+    if keep_pad:
+        # Callers gathering rows from the (…, blk_k_pad) output keep the
+        # 8-multiple lane width: slicing BEFORE a gather materializes an
+        # unaligned-row copy, and gathering 64B-aligned rows then slicing
+        # after measured ~1.7× faster (benchmarks/README.md round 3).
+        # Pad rows carry (IVF_MASKED_D2, 0).
+        return best_d, best_p
     return best_d[:, :blk_k], best_p[:, :blk_k]
 
 

@@ -665,3 +665,39 @@ def test_metric_param_persists(db_and_queries, mesh8, tmp_path):
     d1, i1 = loaded.kneighbors(queries)
     np.testing.assert_array_equal(i0, i1)
     np.testing.assert_allclose(d0, d1, rtol=1e-6)
+
+
+def test_ivf_fused_bf16_recall(rng):
+    # The production configuration: bfloat16 residual scan through the
+    # fused kernel (interpret mode on CPU). Recall on clustered data must
+    # hold — covers the packed-key selection on genuinely noisy bf16
+    # scores, not just the exact-f32 algebraic checks above.
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.knn import build_ivf_flat, _ivf_query_fn
+
+    centers = rng.normal(size=(16, 24)) * 8
+    db = np.concatenate([c + rng.normal(size=(120, 24)) for c in centers]).astype(
+        np.float32
+    )
+    queries = np.concatenate([c + rng.normal(size=(4, 24)) for c in centers]).astype(
+        np.float32
+    )
+    k = 10
+    index = build_ivf_flat(db, nlist=16, seed=0)
+    dev = [
+        jnp.asarray(index.centroids, jnp.float32),
+        jnp.asarray(index.lists),
+        jnp.asarray(index.list_ids),
+        jnp.asarray(index.list_mask),
+    ]
+    fn = _ivf_query_fn(
+        k, 6, "bfloat16", "float32", mode="bucketed", rerank=True, fused="on"
+    )
+    _, idx = fn(*dev, jnp.asarray(queries))
+    from oracles import knn_brute
+
+    _, ref_i = knn_brute(db, queries, k)
+    recall = np.mean(
+        [len(set(np.asarray(idx)[i]) & set(ref_i[i])) / k for i in range(len(queries))]
+    )
+    assert recall > 0.9, recall
