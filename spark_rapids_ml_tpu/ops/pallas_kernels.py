@@ -823,3 +823,96 @@ def probe_select_pallas(
         interpret=interpret,
     )(cent, c2, qT, q2)
     return best_p[:nprobe].T, best_d[:nprobe].T
+
+
+# ---------------------------------------------------------------------------
+# Fused LinearRegression normal-equation statistics: one HBM pass
+# ---------------------------------------------------------------------------
+
+
+def _linreg_stats_kernel(x_ref, y_ref, m_ref, g_ref, xty_ref, cs_ref, ys_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        g_ref[:] = jnp.zeros_like(g_ref)
+        xty_ref[:] = jnp.zeros_like(xty_ref)
+        cs_ref[:] = jnp.zeros_like(cs_ref)
+        ys_ref[:] = jnp.zeros_like(ys_ref)
+
+    m = m_ref[:]  # (bn, 1) f32 {0,1}
+    xb = x_ref[:] * m.astype(x_ref.dtype)
+    yf = y_ref[:] * m  # (bn, 1) f32
+    g_ref[:] += jax.lax.dot_general(
+        xb, xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=_dot_prec(xb.dtype),
+    )
+    xf = xb.astype(jnp.float32)
+    # Xᵀy on the VPU: a (1, bn)×(bn, d) MXU call would waste 127/128 of
+    # the systolic array's M tiles; the row-weighted column sum is cheap
+    # next to the Gram GEMM and rides the same x read.
+    xty_ref[:] += jnp.sum(xf * yf, axis=0, keepdims=True)
+    cs_ref[:] += jnp.sum(xf, axis=0, keepdims=True)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (m.shape[0], 128), 1)
+    ys_ref[:] += jnp.sum(
+        jnp.where(
+            lane == 0, yf, jnp.where(lane == 1, yf * yf, jnp.where(lane == 2, m, 0.0))
+        ),
+        axis=0,
+        keepdims=True,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def linreg_stats_pallas(
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    block_n: int = GRAM_COLSUM_BLOCK_N,
+    interpret: bool = False,
+):
+    """One-HBM-pass fused (XᵀX, Xᵀy, Σx, Σy, Σy², n) over masked rows —
+    the LinearRegression analogue of ``gram_colsum_pallas`` (SURVEY §7.6:
+    "literally the PCA reduction with an extra Xᵀy"). The XLA path's
+    separate dots re-read X for Xᵀy and the sums (+30% wall measured at
+    1M×1024 bf16); here every statistic rides the Gram's single read with
+    the accumulators VMEM-resident.
+
+    x: (n, d) compute dtype; y: (n,) any float; mask: (n,) {0,1}.
+    Returns (xtx (d, d) f32, xty (d,) f32, sx (d,) f32, sy, syy, n — all
+    f32 scalars; exact row counts up to 2^24 rows per call).
+    """
+    n, d = x.shape
+    bn = min(block_n, n)
+    if n % bn:
+        raise ValueError(f"n={n} not divisible by block_n={bn}")
+    if d * d * 4 > GRAM_COLSUM_VMEM_BUDGET:
+        raise ValueError(f"d={d}: (d, d) f32 accumulator exceeds the VMEM budget")
+    y2 = jnp.asarray(y, jnp.float32).reshape(n, 1)
+    m2 = jnp.asarray(mask, jnp.float32).reshape(n, 1)
+    g, xty, cs, ys = pl.pallas_call(
+        _linreg_stats_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, 128), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 2**20
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(x, y2, m2)
+    return g, xty[0], cs[0], ys[0, 0], ys[0, 1], ys[0, 2]

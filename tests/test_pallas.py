@@ -283,3 +283,43 @@ def test_probe_select_block_validation(rng):
             cent, np.zeros((600, 16), np.float32), 2, block_q=512,
             interpret=True,
         )
+
+
+def test_linreg_stats_parity(rng):
+    from spark_rapids_ml_tpu.ops.pallas_kernels import linreg_stats_pallas
+
+    n, d = 1024, 256
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    mask[-100:] = 0.0
+    xtx, xty, sx, sy, syy, cnt = linreg_stats_pallas(
+        x, y, mask, block_n=256, interpret=True
+    )
+    xm = x * mask[:, None]
+    ym = y * mask
+    np.testing.assert_allclose(np.asarray(xtx), xm.T @ xm, rtol=1e-5, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(xty), xm.T @ ym, rtol=1e-5, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(sx), xm.sum(0), rtol=1e-5, atol=1e-2)
+    np.testing.assert_allclose(float(sy), ym.sum(), rtol=1e-5)
+    np.testing.assert_allclose(float(syy), (ym**2).sum(), rtol=1e-5)
+    assert float(cnt) == float(mask.sum())
+
+
+def test_linreg_stats_fn_pallas_matches_xla(rng):
+    # The sharded stats fn with the fused kernel forced on (interpret on
+    # CPU) must match the XLA path to bf16-GEMM tolerance.
+    from spark_rapids_ml_tpu.models.linear_regression import _normal_eq_stats_fn
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(data=4, model=1)
+    n, d = 2048, 128
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    a = _normal_eq_stats_fn(mesh, "float32", "float32", False)(x, y, mask)
+    b = _normal_eq_stats_fn(mesh, "float32", "float32", True)(x, y, mask)
+    for va, vb in zip(a, b):
+        np.testing.assert_allclose(
+            np.asarray(va), np.asarray(vb), rtol=1e-4, atol=1e-2
+        )
