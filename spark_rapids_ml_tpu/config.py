@@ -83,10 +83,11 @@ _DEFAULTS: Dict[str, Any] = {
     "ann_rerank": _env("ANN_RERANK", True, lambda v: str(v).lower() not in ("0", "false", "off")),
     # Exact-rerank shortlist width, in units of k: the rerank rescores the
     # R = ann_rerank_width*k best approximate candidates from the raw f32
-    # rows ((q, R, d) gather — the dominant rerank cost). 0 = auto
-    # (2*ann_shortlist_mult, the historical width sized for approx
-    # selection noise); with the exact fused selection a narrower 2-3
-    # keeps recall while cutting the gather proportionally.
+    # rows ((q, R, d) gather — the dominant rerank cost). 0 = auto:
+    # 2*ann_shortlist_mult on the XLA scan (sized for its approx-selection
+    # noise), ann_shortlist_mult on the fused kernel (exact selection —
+    # the same-run width sweep measured identical recall at half the
+    # width; benchmarks/README.md).
     "ann_rerank_width": _env("ANN_RERANK_WIDTH", 0, int),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual

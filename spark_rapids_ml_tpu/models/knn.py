@@ -121,12 +121,24 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
 KNN_METRICS = ("euclidean", "sqeuclidean", "cosine", "inner_product")
 
 
-def _normalized_rows(x: np.ndarray, eps: float = 1e-12) -> np.ndarray:
-    """Unit-normalize rows (cosine-metric preprocessing). Zero rows stay
-    zero: their cosine distance to everything is then the constant 1."""
+def _normalized_rows(
+    x: np.ndarray, zero_slot: int = 0, eps: float = 1e-12
+) -> np.ndarray:
+    """Cosine-metric preprocessing: unit rows + TWO augmentation columns.
+
+    A zero row becomes a unit vector in augmentation column ``zero_slot``
+    (0 for database/index rows, 1 for queries): orthogonal to every real
+    vector AND to the other side's zero vectors, so its cosine distance is
+    exactly 1 — matching sklearn's normalize()-then-dot semantics. A plain
+    zero-stays-zero embedding would report 0.5 (= ‖q−0‖²/2), silently
+    ranking zero rows ABOVE genuinely dissimilar neighbors."""
     x = np.asarray(x, np.float32 if x.dtype != np.float64 else np.float64)
-    n = np.linalg.norm(x, axis=1, keepdims=True)
-    return x / np.maximum(n, eps)
+    nrm = np.linalg.norm(x, axis=1, keepdims=True)
+    out = np.concatenate(
+        [x / np.maximum(nrm, eps), np.zeros((x.shape[0], 2), x.dtype)], axis=1
+    )
+    out[nrm[:, 0] <= eps, x.shape[1] + zero_slot] = 1.0
+    return out
 
 
 class _NNParams(HasFeaturesCol, HasSeed):
@@ -186,7 +198,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
     # device-resident index state rebuilds via _ensure_index after unpickle
     _transient_attrs = (
         "_mesh", "_db_sharded", "_db_mask", "_db_ids", "_n_global",
-        "_index_metric",
+        "_index_rep",
     )
 
     def __init__(self, database: Optional[np.ndarray] = None, mesh=None, uid=None):
@@ -197,7 +209,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         self._db_mask = None
         self._db_ids = None
         self._n_global = None
-        self._index_metric = None
+        self._index_rep = None
 
     def _model_data(self):
         return {"database": self.database}
@@ -212,9 +224,14 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
 
     def _ensure_index(self, mesh):
         metric = self.getMetric()
-        if getattr(self, "_index_metric", None) != metric:
-            self._db_sharded = None  # metric changed: rebuild (cosine
-            self._index_metric = metric  # shards the NORMALIZED copy)
+        # Only the cosine boundary changes the SHARDED DATA (the
+        # augmented-normalized copy); euclidean/sqeuclidean/inner_product
+        # all shard the raw rows — switching among them must not repeat a
+        # multi-GB reshard.
+        rep = "cosine" if metric == "cosine" else "raw"
+        if getattr(self, "_index_rep", None) != rep:
+            self._db_sharded = None
+            self._index_rep = rep
         if self._db_sharded is None:
             from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 
@@ -231,7 +248,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
             else:
                 lo = 0
             db = (
-                _normalized_rows(self.database)
+                _normalized_rows(self.database, zero_slot=0)
                 if metric == "cosine"
                 else self.database
             )
@@ -270,7 +287,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         metric = self.getMetric()
         queries = np.asarray(queries)
         if metric == "cosine":
-            queries = _normalized_rows(queries)
+            queries = _normalized_rows(queries, zero_slot=1)
         q = queries.shape[0]
         bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
         qp, _ = pad_rows(queries, bucket)
@@ -1476,10 +1493,11 @@ class ApproximateNearestNeighbors(Estimator, _ANNParams, MLWritable, MLReadable)
             )
         x = np.asarray(as_matrix(dataset, self.getFeaturesCol()))
         if metric == "cosine":
-            # The index stores the UNIT-normalized rows: L2 on them is a
-            # monotone transform of cosine distance, so the whole IVF
-            # machinery (quantizer, residual scan, rerank) applies as-is.
-            x = _normalized_rows(x)
+            # The index stores the UNIT-normalized (augmented) rows: L2 on
+            # them is a monotone transform of cosine distance, so the
+            # whole IVF machinery (quantizer, residual scan, rerank)
+            # applies as-is.
+            x = _normalized_rows(x, zero_slot=0)
         with trace_span("ivf build"):
             index = build_ivf_flat(
                 x, nlist=self.getNlist(), seed=self.getSeed(), mesh=self._mesh
@@ -1487,13 +1505,17 @@ class ApproximateNearestNeighbors(Estimator, _ANNParams, MLWritable, MLReadable)
         model = ApproximateNearestNeighborsModel(index=index)
         model.uid = self.uid
         self._copy_params_to(model)
+        model._index_metric = metric
         return model
 
 
 class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable):
     _uid_prefix = "ApproximateNearestNeighborsModel"
-    # device index + residual cache rebuild via _ensure_dev_index on use
-    _transient_attrs = ("_mesh", "_dev_index", "_resid_cache", "_shard_mesh")
+    # device index + residual cache rebuild via _ensure_dev_index on use;
+    # _index_metric re-derives from the persisted metric param on load
+    _transient_attrs = (
+        "_mesh", "_dev_index", "_resid_cache", "_shard_mesh", "_index_metric"
+    )
 
     def __init__(self, index: Optional[IVFFlatIndex] = None, uid=None):
         super().__init__(uid=uid)
@@ -1524,6 +1546,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         self.index = source.index
         self._dev_index = None
         self._resid_cache = None
+        self._index_metric = getattr(source, "_index_metric", None)
         # Re-run the sharded placement (it pads nlist to a device multiple
         # — an invariant _ensure_dev_index alone would not restore).
         src_mesh = getattr(source, "_shard_mesh", None)
@@ -1610,9 +1633,21 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                 f"increase nprobe (or nlist granularity)"
             )
         metric = self.getMetric()
+        fit_metric = getattr(self, "_index_metric", None)
+        if fit_metric is None:
+            # Loaded/legacy model: the persisted metric param IS the fit
+            # metric (it was copied from the estimator at fit).
+            fit_metric = metric
+            self._index_metric = fit_metric
+        if metric != fit_metric:
+            raise ValueError(
+                f"index was built under metric={fit_metric!r}; the "
+                f"normalization is baked into the stored lists, so refit "
+                f"to query with metric={metric!r}"
+            )
         queries = np.asarray(queries)
         if metric == "cosine":
-            queries = _normalized_rows(queries)  # index rows were at fit
+            queries = _normalized_rows(queries, zero_slot=1)  # index at fit
         q = queries.shape[0]
         bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
         qp, _ = pad_rows(queries, bucket)

@@ -161,8 +161,12 @@ def streaming_normal_eq_update(mesh: Mesh, compute_dtype=None, accum_dtype=None)
     executor-fed batches."""
     cd = jnp.dtype(compute_dtype or config.get("compute_dtype")).name
     ad = jnp.dtype(accum_dtype or config.get("accum_dtype")).name
+    # The config-fed flag only forces the kernel on real TPU backends —
+    # off-TPU it would run in interpret mode (the explicit-True force is
+    # for tests calling the private fns directly; ops/gram.py convention).
     return _streaming_normal_eq_update(
-        mesh, cd, ad, bool(config.get("use_pallas"))
+        mesh, cd, ad,
+        bool(config.get("use_pallas")) and jax.default_backend() == "tpu",
     )
 
 
@@ -284,7 +288,8 @@ def fit_linear_regression(
         ys, _, _ = shard_rows(y, mesh)
         stats = _normal_eq_stats_fn(
             mesh, config.get("compute_dtype"), config.get("accum_dtype"),
-            bool(config.get("use_pallas")),
+            bool(config.get("use_pallas"))
+            and jax.default_backend() == "tpu",  # see streaming_normal_eq_update
         )(xs, ys, mask)
     return finalize_normal_eq_stats(
         stats, reg, elastic_net, fit_intercept, max_iter, tol, n_true

@@ -560,6 +560,48 @@ IVF_MASKED_KEY = 0x7FFFFFFF
 IVF_MASKED_D2 = 3.0e38
 
 
+def _sortable_int(v):
+    """The order-preserving f32↔int32 bijection (IEEE trick: flip the
+    non-sign bits of negatives). Self-inverse; finite inputs assumed."""
+    return v ^ (
+        jax.lax.shift_right_arithmetic(v, jnp.int32(31)) & jnp.int32(0x7FFFFFFF)
+    )
+
+
+def _packed_keys(scores, pos_bits):
+    """(maxlen, C) f32 scores → UNIQUE packed int32 keys: sortable value
+    in the high bits, sublane position in the low ``pos_bits``. Shared by
+    the scan-selection and probe-selection kernels."""
+    low = jnp.int32((1 << pos_bits) - 1)
+    key = _sortable_int(jax.lax.bitcast_convert_type(scores, jnp.int32))
+    return (key & ~low) | jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
+
+
+def _packed_extract(key, d_ref, p_ref, count, pos_bits):
+    """``count`` exact ascending min-extraction passes over packed keys:
+    each pass is one sublane min-reduce + one single-element equality mask
+    (keys unique ⇒ ties resolve to the lowest position). Decoded values
+    are floored within a relative 2^(pos_bits-24) (the packed-key mantissa
+    trade). Sublane-pad output rows get the (IVF_MASKED_D2, 0) sentinel so
+    the output is deterministic."""
+    low = jnp.int32((1 << pos_bits) - 1)
+    for j in range(count):
+        m = jnp.min(key, axis=0, keepdims=True)  # (1, C) sublane min
+        pos = m & low
+        vkey = m ^ pos  # position bits cleared: the floored value key
+        d_ref[j : j + 1, :] = jax.lax.bitcast_convert_type(
+            _sortable_int(vkey), jnp.float32
+        )
+        p_ref[j : j + 1, :] = pos
+        key = jnp.where(key == m, jnp.int32(IVF_MASKED_KEY), key)
+    if count < d_ref.shape[0]:
+        pad = jax.lax.broadcasted_iota(
+            jnp.int32, (d_ref.shape[0] - count, key.shape[1]), 0
+        )
+        d_ref[count:, :] = jnp.full_like(pad, IVF_MASKED_D2, jnp.float32)
+        p_ref[count:, :] = jnp.zeros_like(pad)
+
+
 def _ivf_scan_select_kernel(
     qv_ref, rows_ref, r2_ref, d_ref, p_ref, *, blk_k, pos_bits
 ):
@@ -598,26 +640,7 @@ def _ivf_scan_select_kernel(
     # than blk_k valid rows emits them, and the caller's id table maps
     # them to -1. Finite scores assumed (no ±inf/NaN reach this kernel).
     scores = r2_ref[:] - 2.0 * qr  # r2 is (maxlen_pad, 1): broadcast lanes
-    low = jnp.int32((1 << pos_bits) - 1)
-    s = jax.lax.bitcast_convert_type(scores, jnp.int32)
-    key = s ^ (jax.lax.shift_right_arithmetic(s, jnp.int32(31)) & jnp.int32(0x7FFFFFFF))
-    key = (key & ~low) | jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
-    for j in range(blk_k):
-        m = jnp.min(key, axis=0, keepdims=True)  # (1, C) sublane min
-        pos = m & low
-        vkey = m ^ pos  # position bits cleared: the floored value key
-        v = vkey ^ (
-            jax.lax.shift_right_arithmetic(vkey, jnp.int32(31)) & jnp.int32(0x7FFFFFFF)
-        )
-        d_ref[j : j + 1, :] = jax.lax.bitcast_convert_type(v, jnp.float32)
-        p_ref[j : j + 1, :] = pos
-        key = jnp.where(key == m, jnp.int32(IVF_MASKED_KEY), key)
-    if blk_k < d_ref.shape[0]:  # sublane-pad rows: deterministic output
-        pad = jax.lax.broadcasted_iota(
-            jnp.int32, (d_ref.shape[0] - blk_k, key.shape[1]), 0
-        )
-        d_ref[blk_k:, :] = jnp.full_like(pad, IVF_MASKED_D2, jnp.float32)
-        p_ref[blk_k:, :] = jnp.zeros_like(pad)
+    _packed_extract(_packed_keys(scores, pos_bits), d_ref, p_ref, blk_k, pos_bits)
 
 
 @functools.partial(jax.jit, static_argnames=("blk_k", "keep_pad", "interpret"))
@@ -737,27 +760,9 @@ def _probe_select_kernel(
     # keep them true distances. Padded centroid rows carry a 1e30 c2h
     # sentinel and never win (nprobe ≤ nlist enforced by callers).
     scores = c2h_ref[:] - 2.0 * cq + q2_ref[:]
-    low = jnp.int32((1 << pos_bits) - 1)
-    s = jax.lax.bitcast_convert_type(scores, jnp.int32)
-    key = s ^ (jax.lax.shift_right_arithmetic(s, jnp.int32(31)) & jnp.int32(0x7FFFFFFF))
-    key = (key & ~low) | jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
-    for j in range(nprobe):
-        m = jnp.min(key, axis=0, keepdims=True)  # (1, qb) sublane min
-        pos = m & low
-        vkey = m ^ pos
-        v = vkey ^ (
-            jax.lax.shift_right_arithmetic(vkey, jnp.int32(31))
-            & jnp.int32(0x7FFFFFFF)
-        )
-        d_ref[j : j + 1, :] = jax.lax.bitcast_convert_type(v, jnp.float32)
-        p_ref[j : j + 1, :] = pos
-        key = jnp.where(key == m, jnp.int32(IVF_MASKED_KEY), key)
-    if nprobe < d_ref.shape[0]:
-        pad = jax.lax.broadcasted_iota(
-            jnp.int32, (d_ref.shape[0] - nprobe, key.shape[1]), 0
-        )
-        d_ref[nprobe:, :] = jnp.full_like(pad, IVF_MASKED_D2, jnp.float32)
-        p_ref[nprobe:, :] = jnp.zeros_like(pad)
+    _packed_extract(
+        _packed_keys(scores, pos_bits), d_ref, p_ref, nprobe, pos_bits
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("nprobe", "block_q", "interpret"))

@@ -523,8 +523,9 @@ class _Job:
                     )
                 if metric == "cosine":
                     # Same contract as the core fit: the index stores
-                    # unit-normalized rows; kneighbors normalizes queries.
-                    rows = _normalized_rows(rows)
+                    # unit-normalized (augmented) rows; kneighbors
+                    # normalizes queries into the query slot.
+                    rows = _normalized_rows(rows, zero_slot=0)
                 nlist = int(params["nlist"])
                 index = build_ivf_flat_device(
                     jnp.asarray(rows), nlist=nlist,
@@ -532,6 +533,7 @@ class _Job:
                 )
                 model = ApproximateNearestNeighborsModel(index=index)
                 model._set(metric=metric)
+                model._index_metric = metric
                 if params.get("nprobe"):
                     model._set(nprobe=int(params["nprobe"]))
                 info["nlist"] = np.asarray([nlist], np.int64)

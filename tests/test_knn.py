@@ -701,3 +701,42 @@ def test_ivf_fused_bf16_recall(rng):
         [len(set(np.asarray(idx)[i]) & set(ref_i[i])) / k for i in range(len(queries))]
     )
     assert recall > 0.9, recall
+
+
+def test_cosine_zero_vectors_match_sklearn(mesh8, rng):
+    # Zero rows and zero queries: the augmented-normalization embedding
+    # must reproduce sklearn's normalize()-then-dot semantics exactly —
+    # a zero vector sits at cosine distance 1 from everything (NOT the
+    # 0.5 a plain zero-stays-zero embedding reports, which would rank it
+    # above genuinely dissimilar neighbors).
+    from sklearn.preprocessing import normalize
+
+    db = rng.normal(size=(60, 8)).astype(np.float64)
+    db[7] = 0.0  # a zero database row
+    queries = rng.normal(size=(6, 8)).astype(np.float64)
+    queries[2] = 0.0  # a zero query
+    k = 60  # full ranking: the zero row's position matters
+    model = NearestNeighbors(mesh=mesh8).setK(k).setMetric("cosine").fit(
+        {"features": db}
+    )
+    dists, idx = model.kneighbors(queries)
+    sim = normalize(queries) @ normalize(db).T  # sklearn zero -> zero
+    ref = 1.0 - sim
+    got = np.take_along_axis(
+        np.full((6, 60), np.nan), np.argsort(idx, axis=1), axis=1
+    )
+    # Compare the full distance-by-db-row matrix.
+    by_row = np.empty((6, 60))
+    for i in range(6):
+        by_row[i, idx[i]] = dists[i]
+    np.testing.assert_allclose(by_row, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_ann_metric_switch_after_fit_rejected(rng):
+    db = rng.normal(size=(200, 8)).astype(np.float32)
+    ann = ApproximateNearestNeighbors().setK(5).setNlist(8).setNprobe(8).fit(
+        {"features": db}
+    )
+    ann._set(metric="cosine")
+    with pytest.raises(ValueError, match="built under"):
+        ann.kneighbors(db[:4])
