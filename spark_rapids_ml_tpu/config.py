@@ -95,7 +95,11 @@ _DEFAULTS: Dict[str, Any] = {
     # VMEM-resident. "auto" = on when the backend is TPU and the per-list
     # tile fits VMEM (the XLA einsum+approx_min_k scan is the portable
     # fallback); "on" forces it (interpret mode off-TPU — used by tests);
-    # "off" forces the XLA scan.
+    # "off" forces the XLA scan. Precision: the kernel's exact selection
+    # packs ids into the low mantissa bits of the f32 score key, so with
+    # ann_rerank=off the returned DISTANCES are floored to ~24-ceil(log2
+    # maxlen) mantissa bits (ids exact; rerank=on recomputes true f32
+    # distances). Force "off" for full-f32 rerank-off values.
     "ann_fused_scan": _env("ANN_FUSED_SCAN", "auto", str),
 }
 

@@ -118,6 +118,9 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
     return jax.jit(f)
 
 
+# APPEND-ONLY: ANN model payloads persist the fit metric as an ordinal into
+# this tuple (_model_data "fit_metric"), so existing positions are an
+# on-disk contract — add new metrics at the END.
 KNN_METRICS = ("euclidean", "sqeuclidean", "cosine", "inner_product")
 
 
@@ -1511,11 +1514,13 @@ class ApproximateNearestNeighbors(Estimator, _ANNParams, MLWritable, MLReadable)
 
 class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable):
     _uid_prefix = "ApproximateNearestNeighborsModel"
-    # device index + residual cache rebuild via _ensure_dev_index on use;
-    # _index_metric re-derives from the persisted metric param on load
-    _transient_attrs = (
-        "_mesh", "_dev_index", "_resid_cache", "_shard_mesh", "_index_metric"
-    )
+    # device index + residual cache rebuild via _ensure_dev_index on use.
+    # _index_metric is NOT transient: the metric's normalization is baked
+    # into the stored lists, so it travels with the index (pickle AND
+    # save/load) rather than re-deriving from the mutable metric param —
+    # a _set(metric=...) after load must hit the built-under guard, not
+    # silently mis-score (round-3 advisor finding).
+    _transient_attrs = ("_mesh", "_dev_index", "_resid_cache", "_shard_mesh")
 
     def __init__(self, index: Optional[IVFFlatIndex] = None, uid=None):
         super().__init__(uid=uid)
@@ -1525,12 +1530,20 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         self._shard_mesh = None  # set by shard_index()
 
     def _model_data(self):
-        return {
+        data = {
             "centroids": self.index.centroids,
             "lists": self.index.lists,
             "list_ids": self.index.list_ids.astype(np.float64),
             "list_mask": self.index.list_mask,
         }
+        fit_metric = getattr(self, "_index_metric", None)
+        if fit_metric is not None:
+            # Persisted as a KNN_METRICS ordinal (the payload store is
+            # numeric); legacy saves without it fall back to the param.
+            data["fit_metric"] = np.array(
+                [KNN_METRICS.index(fit_metric)], dtype=np.float64
+            )
+        return data
 
     @classmethod
     def _from_model_data(cls, uid, data):
@@ -1540,7 +1553,11 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
             list_ids=data["list_ids"].astype(np.int64),
             list_mask=data["list_mask"],
         )
-        return cls(index=index, uid=uid)
+        model = cls(index=index, uid=uid)
+        code = data.get("fit_metric")
+        if code is not None:
+            model._index_metric = KNN_METRICS[int(np.asarray(code).reshape(-1)[0])]
+        return model
 
     def _copy_extra_state(self, source):
         self.index = source.index
@@ -1618,6 +1635,13 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         probed lists hold fewer than k valid points for some query, the tail
         entries of that query's result carry index -1 and distance +inf
         ("fewer than k found" — same convention as IVF in cuML/FAISS).
+
+        Precision note: with ``ann_rerank`` off, the fused TPU scan
+        (``ann_fused_scan`` auto/on) returns distances quantized to ~24−⌈log₂
+        maxlen⌉ mantissa bits — its exact selection packs candidate ids into
+        the low bits of the f32 score key. Neighbor IDs are unaffected and
+        the default rerank recomputes full-precision distances; set
+        ``ann_fused_scan="off"`` if rerank-off configs need full-f32 values.
         """
         if self.index is None:
             raise RuntimeError("model has no index (unfitted?)")

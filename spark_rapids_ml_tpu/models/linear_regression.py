@@ -94,6 +94,7 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str, use_pallas: Optional[bool]
         pallas_ok = (
             bool(use_pallas)
             and accum_dtype == jnp.float32
+            and n_local > 0
             and n_local % min(512, n_local) == 0
             and d % 128 == 0
             and d * d * 4 <= 64 * 2**20

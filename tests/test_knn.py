@@ -722,9 +722,6 @@ def test_cosine_zero_vectors_match_sklearn(mesh8, rng):
     dists, idx = model.kneighbors(queries)
     sim = normalize(queries) @ normalize(db).T  # sklearn zero -> zero
     ref = 1.0 - sim
-    got = np.take_along_axis(
-        np.full((6, 60), np.nan), np.argsort(idx, axis=1), axis=1
-    )
     # Compare the full distance-by-db-row matrix.
     by_row = np.empty((6, 60))
     for i in range(6):
@@ -740,3 +737,38 @@ def test_ann_metric_switch_after_fit_rejected(rng):
     ann._set(metric="cosine")
     with pytest.raises(ValueError, match="built under"):
         ann.kneighbors(db[:4])
+
+
+def test_ann_metric_switch_after_load_rejected(rng, tmp_path):
+    # The fit metric travels WITH the index (not re-derived from the
+    # mutable param): a loaded model whose metric param is flipped before
+    # its first query must hit the built-under guard, not silently score
+    # cosine-normalized (d+2)-wide lists against raw queries.
+    from spark_rapids_ml_tpu.models.knn import ApproximateNearestNeighborsModel
+
+    db = rng.normal(size=(200, 8)).astype(np.float32)
+    ann = (
+        ApproximateNearestNeighbors()
+        .setK(5).setNlist(8).setNprobe(8).setMetric("cosine")
+        .fit({"features": db})
+    )
+    # The persisted ordinal contract: these positions are on-disk format.
+    from spark_rapids_ml_tpu.models.knn import KNN_METRICS
+
+    assert KNN_METRICS[:4] == (
+        "euclidean", "sqeuclidean", "cosine", "inner_product"
+    )
+    path = str(tmp_path / "ann_cosine")
+    ann.save(path)
+    loaded = ApproximateNearestNeighborsModel.load(path)
+    assert loaded._index_metric == "cosine"
+    loaded._set(metric="euclidean")
+    with pytest.raises(ValueError, match="built under"):
+        loaded.kneighbors(db[:4])
+    # Pickle round-trip (executor shipping) preserves it too.
+    import pickle
+
+    clone = pickle.loads(pickle.dumps(ann))
+    clone._set(metric="sqeuclidean")
+    with pytest.raises(ValueError, match="built under"):
+        clone.kneighbors(db[:4])
