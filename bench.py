@@ -58,6 +58,9 @@ def main() -> None:
     from spark_rapids_ml_tpu.ops.eigh import pca_from_gram_randomized
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
+    # Since round 4 these ARE the shipped TPU-auto defaults; pinned here so
+    # the recorded number stays tied to this exact profile even if defaults
+    # move.
     config.set("compute_dtype", "bfloat16")
     config.set("accum_dtype", "float32")
     config.set("use_pallas", True)

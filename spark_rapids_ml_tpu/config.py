@@ -31,6 +31,31 @@ def _as_bool(s: str) -> bool:
     return s.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _as_bool_or_auto(s: str):
+    return "auto" if s.strip().lower() == "auto" else _as_bool(s)
+
+
+def _backend_is_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+# Lazy resolution of "auto" defaults at get() time: the shipped TPU profile
+# IS the measured configuration (bf16 MXU compute / f32 accumulation /
+# Pallas kernels) — a fresh checkout on a real TPU reproduces the headline
+# bench numbers with zero env vars, while CPU meshes (tests, dev boxes)
+# resolve to the portable f32/XLA path unchanged. Explicit values (set()
+# or SRML_TPU_* env) always win over "auto".
+_AUTO_RESOLVERS: Dict[str, Callable[[], Any]] = {
+    "use_pallas": _backend_is_tpu,
+    "compute_dtype": lambda: "bfloat16" if _backend_is_tpu() else "float32",
+}
+
+
 _DEFAULTS: Dict[str, Any] = {
     # Master switch, analogous to spark.rapids.sql.enabled: when False all
     # estimators run their host (numpy) fallback path.
@@ -39,8 +64,11 @@ _DEFAULTS: Dict[str, Any] = {
     # with the reference's double-precision cuBLAS path; float32 is the fast
     # TPU-native mode (MXU). (SURVEY.md §7 hard part (c).)
     "accum_dtype": _env("ACCUM_DTYPE", "float32", str),
-    # Compute dtype for the big GEMMs; bfloat16 engages the MXU at full rate.
-    "compute_dtype": _env("COMPUTE_DTYPE", "float32", str),
+    # Compute dtype for the big GEMMs; bfloat16 engages the MXU at full
+    # rate. "auto" (default) = bfloat16 on a real TPU backend, float32
+    # elsewhere — the measured TPU profile ships as the default. Set
+    # "float32" explicitly for full-precision parity runs on TPU.
+    "compute_dtype": _env("COMPUTE_DTYPE", "auto", str),
     # Default mesh axis sizes; None = use all local devices on the data axis.
     "mesh_data_axis": _env("MESH_DATA_AXIS", None, int),
     "mesh_model_axis": _env("MESH_MODEL_AXIS", 1, int),
@@ -51,7 +79,9 @@ _DEFAULTS: Dict[str, Any] = {
     # Emit profiler trace annotations (NVTX-range equivalent; SURVEY.md §5).
     "tracing": _env("TRACING", False, _as_bool),
     # Use Pallas kernels for hot ops (Gram, pairwise distance) on TPU.
-    "use_pallas": _env("USE_PALLAS", False, _as_bool),
+    # "auto" (default) = on iff the backend is a real TPU (the per-kernel
+    # shape/dtype gates still apply — see _pallas_backend_ok and friends).
+    "use_pallas": _env("USE_PALLAS", "auto", _as_bool_or_auto),
     # Feature-sharded Gram algorithm: "allgather" (one ICI all_gather of the
     # full feature width per device) or "ring" (ppermute pipeline — one
     # block in flight, for feature dims too large to gather). "auto" =
@@ -108,7 +138,15 @@ _conf: Dict[str, Any] = dict(_DEFAULTS)
 
 
 def get(key: str) -> Any:
-    """Get a runtime config value."""
+    """Get a runtime config value ("auto" keys resolve per backend)."""
+    value = get_raw(key)
+    if value == "auto" and key in _AUTO_RESOLVERS:
+        return _AUTO_RESOLVERS[key]()
+    return value
+
+
+def get_raw(key: str) -> Any:
+    """Get the stored value without "auto" resolution (option/save-restore)."""
     with _lock:
         if key not in _conf:
             raise KeyError(f"unknown config key: {key!r} (known: {sorted(_conf)})")
@@ -139,7 +177,7 @@ class option:
         self._saved: Optional[Any] = None
 
     def __enter__(self) -> "option":
-        self._saved = get(self._key)
+        self._saved = get_raw(self._key)  # preserve "auto", don't bake it
         set(self._key, self._value)
         return self
 

@@ -82,6 +82,15 @@ class DataPlaneClient:
             )
         return bool(resp["ok"])
 
+    def server_id(self) -> Optional[str]:
+        """The daemon's self-reported instance id (from ping). Address
+        strings alias (localhost vs 127.0.0.1 vs FQDN); this id is how
+        callers decide whether two addresses are the same daemon. None
+        when talking to a pre-id daemon."""
+        resp, _ = self._roundtrip({"op": "ping"})
+        sid = resp.get("id")
+        return None if sid is None else str(sid)
+
     @staticmethod
     def _to_ipc(data, input_col: str, label_col: str) -> bytes:
         import pyarrow as pa
@@ -210,6 +219,74 @@ class DataPlaneClient:
         )
         return protocol.recv_arrays(sock, resp), int(resp["rows"])
 
+    # -- cross-daemon merge (multi-host data plane) -------------------------
+
+    def _send_arrays_op(self, req: Dict[str, Any], arrays: Dict[str, np.ndarray]):
+        """Request carrying raw array frames (ensure_model framing)."""
+        sock = self._conn()
+        req = {"v": protocol.PROTOCOL_VERSION, **req}
+        if self._token is not None:
+            req["token"] = self._token
+        protocol.send_arrays(sock, {k: np.asarray(v) for k, v in arrays.items()}, req)
+        resp = protocol.recv_json(sock)
+        if resp is None:
+            raise ConnectionError("daemon closed the connection")
+        if not resp.get("ok", False):
+            raise RuntimeError(f"daemon error: {resp.get('error')}")
+        return resp
+
+    def export_state(self, job: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """Snapshot a job's committed O(d²) partials for a cross-daemon
+        merge. Returns (state arrays keyed s0..sN in jax tree order,
+        meta with rows/pass_rows/iteration/algo/n_cols). Read-only."""
+        resp, sock = self._roundtrip({"op": "export_state", "job": job})
+        arrays = protocol.recv_arrays(sock, resp)
+        meta = {k: v for k, v in resp.items() if k not in ("ok", "arrays")}
+        return arrays, meta
+
+    def merge_state(
+        self,
+        job: str,
+        arrays: Dict[str, np.ndarray],
+        rows: int,
+        algo: str = "pca",
+        n_cols: Optional[int] = None,
+        params: Optional[Dict[str, Any]] = None,
+    ) -> int:
+        """Fold a peer daemon's exported state into ``job`` (creating it
+        when absent — ``algo``/``n_cols``/``params`` mirror a first feed).
+        ``rows`` is the exporter's committed contribution; returns the
+        job's new total."""
+        resp = self._send_arrays_op(
+            {
+                "op": "merge_state",
+                "job": job,
+                "algo": algo,
+                "n_cols": n_cols,
+                "params": params or {},
+                "rows": int(rows),
+            },
+            arrays,
+        )
+        return int(resp["rows"])
+
+    def get_iterate(self, job: str) -> Tuple[Dict[str, np.ndarray], int]:
+        """(iterate arrays, iteration) of an iterative job — kmeans
+        {"centers"}; logreg {"w", "b"}."""
+        resp, sock = self._roundtrip({"op": "get_iterate", "job": job})
+        arrays = protocol.recv_arrays(sock, resp)
+        return arrays, int(resp["iteration"])
+
+    def set_iterate(
+        self, job: str, arrays: Dict[str, np.ndarray], iteration: int
+    ) -> None:
+        """Install a driver-pushed iterate on a peer daemon's job and open
+        pass ``iteration`` (resets the pass statistics and staging)."""
+        self._send_arrays_op(
+            {"op": "set_iterate", "job": job, "iteration": int(iteration)},
+            arrays,
+        )
+
     # -- model serving (daemon-side transform) -----------------------------
 
     def ensure_model(
@@ -223,24 +300,11 @@ class DataPlaneClient:
         wins). ``arrays`` is the model's ``_model_data()`` payload; raw
         array frames follow the JSON header, mirroring the finalize
         response framing. Returns True when this call created it."""
-        sock = self._conn()
-        req = {
-            "v": protocol.PROTOCOL_VERSION,
-            "op": "ensure_model",
-            "model": name,
-            "algo": algo,
-            "params": params or {},
-        }
-        if self._token is not None:
-            req["token"] = self._token
-        protocol.send_arrays(
-            sock, {k: np.asarray(v) for k, v in arrays.items()}, req
+        resp = self._send_arrays_op(
+            {"op": "ensure_model", "model": name, "algo": algo,
+             "params": params or {}},
+            arrays,
         )
-        resp = protocol.recv_json(sock)
-        if resp is None:
-            raise ConnectionError("daemon closed the connection")
-        if not resp.get("ok", False):
-            raise RuntimeError(f"daemon error: {resp.get('error')}")
         return bool(resp["created"])
 
     def model_exists(self, name: str) -> bool:

@@ -47,6 +47,7 @@ import hmac
 import socket
 import threading
 import time
+import uuid
 from typing import Any, Dict, Optional
 
 import jax
@@ -401,6 +402,143 @@ class _Job:
             self.touched = self._clock()  # exit stamp (see fold)
             return self.rows
 
+    def export_state(self):
+        """Snapshot the job's COMMITTED accumulated state for a cross-daemon
+        merge (multi-host data plane): the O(d²) partials leave as raw
+        arrays, flattened in jax tree order. Uncommitted stages are
+        deliberately excluded — the driver only accounts rows that were
+        acked through commit. Read-only; the job keeps serving."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            if self.algo == "knn":
+                raise ValueError(
+                    "knn job state is the dataset itself — route every "
+                    "executor to ONE daemon for knn fits (the index builds "
+                    "and serves there); see docs/protocol.md"
+                )
+            self.touched = self._clock()
+            leaves = jax.tree_util.tree_leaves(self.state)
+            arrays = {
+                f"s{i}": np.asarray(jax.device_get(a)) for i, a in enumerate(leaves)
+            }
+            meta = {
+                "rows": self.rows,
+                "pass_rows": self.pass_rows,
+                "iteration": self.iteration,
+                "algo": self.algo,
+                "n_cols": self.n_cols,
+                # Which partitions this state holds (this pass): lets the
+                # driver name a cross-daemon-retry orphan precisely
+                # instead of reporting a bare row-count mismatch.
+                "committed": {str(p): n for p, n in self.committed.items()},
+            }
+            self.touched = self._clock()  # exit stamp (device_get can be slow)
+            return arrays, meta
+
+    def merge_remote(self, arrays: Dict[str, np.ndarray], rows: int) -> int:
+        """Fold another daemon's exported state into this job — the
+        associative add that makes the data plane span hosts (the
+        ``RDD.reduce`` across executors, RapidsRowMatrix.scala:139, with
+        daemons as the leaves). ``rows`` is the contributed committed-row
+        count; it joins both the job total and the current pass."""
+        import jax.numpy as jnp
+
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            if self.algo == "knn":
+                raise ValueError("knn jobs cannot merge remote state")
+            self.touched = self._clock()
+            leaves, treedef = jax.tree_util.tree_flatten(self.state)
+            if len(arrays) != len(leaves):
+                raise ValueError(
+                    f"merge_state carried {len(arrays)} arrays; job state "
+                    f"has {len(leaves)} (algo/params mismatch between "
+                    "daemons?)"
+                )
+            merged = []
+            for i, leaf in enumerate(leaves):
+                inc = arrays.get(f"s{i}")
+                if inc is None:
+                    raise ValueError(f"merge_state missing array 's{i}'")
+                if tuple(inc.shape) != tuple(leaf.shape):
+                    raise ValueError(
+                        f"merge_state array s{i} shape {tuple(inc.shape)} != "
+                        f"job state shape {tuple(leaf.shape)}"
+                    )
+                merged.append(leaf + jnp.asarray(inc, leaf.dtype))
+            self.state = jax.tree_util.tree_unflatten(treedef, merged)
+            self.rows += int(rows)
+            self.pass_rows += int(rows)
+            self.touched = self._clock()  # exit stamp
+            return self.rows
+
+    def get_iterate(self):
+        """Current iterate of an iterative job (kmeans centers / logreg
+        coefficients) + its pass counter — what a driver pushes to peer
+        daemons with ``set_iterate`` at each pass boundary."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            self.touched = self._clock()
+            if self.algo == "kmeans":
+                if self.centers is None:
+                    raise ValueError("kmeans job has no centers yet (seed first)")
+                arrays = {"centers": np.asarray(jax.device_get(self.centers))}
+            elif self.algo == "logreg":
+                arrays = {
+                    "w": np.asarray(jax.device_get(self.w)),
+                    "b": np.asarray(jax.device_get(self.b)).reshape(-1),
+                }
+            else:
+                raise ValueError(
+                    f"algo {self.algo!r} is single-pass; it has no iterate"
+                )
+            return arrays, {"iteration": self.iteration}
+
+    def set_iterate(self, arrays: Dict[str, np.ndarray], iteration: int) -> None:
+        """Install a driver-pushed iterate and open the given pass: reset
+        the pass statistics and staging, set the pass counter. This is the
+        peer-daemon face of ``step`` — the primary daemon steps, every
+        other daemon ``set_iterate``s the result, and the next scan's
+        feeds carry the new pass_id everywhere."""
+        import jax.numpy as jnp
+
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            self.touched = self._clock()
+            if self.algo == "kmeans":
+                c = np.asarray(arrays["centers"])
+                if c.shape != (self.k, self.n_cols):
+                    raise ValueError(
+                        f"centers shape {c.shape} != ({self.k}, {self.n_cols})"
+                    )
+                self.centers = jnp.asarray(c, self._accum)
+            elif self.algo == "logreg":
+                w = np.asarray(arrays["w"])
+                if w.shape[0] != self.n_cols:
+                    raise ValueError(
+                        f"coefficients shape {w.shape} != n_cols {self.n_cols}"
+                    )
+                self.w = jnp.asarray(w, self._accum)
+                b = np.asarray(arrays["b"])
+                self.b = jnp.asarray(
+                    b.reshape(-1) if getattr(self, "n_classes", 2) > 2 else b.reshape(()),
+                    self._accum,
+                )
+            else:
+                raise ValueError(
+                    f"algo {self.algo!r} is single-pass; set_iterate not applicable"
+                )
+            self.state = self._zero_state()
+            self.staged.clear()
+            self.committed.clear()
+            self.iteration = int(iteration)
+            self.pass_rows = 0
+            self.touched = self._clock()  # exit stamp
+
     def step(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Pass boundary for iterative jobs: apply the update at the end of
         one full dataset scan, reset the pass accumulator, and report
@@ -744,6 +882,10 @@ class DataPlaneDaemon:
         # wall-sleeping (r2 review weak #7); production uses monotonic.
         self._clock = clock
         self._reap_interval = reap_interval
+        # Self-reported identity: host:port spellings alias (localhost vs
+        # 127.0.0.1 vs FQDN), so the driver keys daemons by this id (from
+        # ping) — never by the address string a client happened to use.
+        self.instance_id = uuid.uuid4().hex[:12]
         self._jobs: Dict[str, _Job] = {}
         self._jobs_lock = threading.Lock()
         self._models: Dict[str, _ServedModel] = {}
@@ -888,7 +1030,7 @@ class DataPlaneDaemon:
             # flight when the JSON header is rejected.
             if op in _PAYLOAD_OPS:
                 protocol.recv_frame(conn)
-            elif op == "ensure_model":
+            elif op in ("ensure_model", "merge_state", "set_iterate"):
                 for _ in req.get("arrays") or []:
                     protocol.recv_frame(conn)
 
@@ -939,6 +1081,21 @@ class DataPlaneDaemon:
                 with job.lock:
                     job.dropped = True
             protocol.send_json(conn, {"ok": True, "dropped": job is not None})
+        elif op == "export_state":
+            job = self._get_job(req)
+            arrays, meta = job.export_state()
+            protocol.send_arrays(conn, arrays, {"ok": True, **meta})
+        elif op == "merge_state":
+            self._op_merge_state(conn, req)
+        elif op == "get_iterate":
+            job = self._get_job(req)
+            arrays, meta = job.get_iterate()
+            protocol.send_arrays(conn, arrays, {"ok": True, **meta})
+        elif op == "set_iterate":
+            arrays = protocol.recv_arrays(conn, req)
+            job = self._get_job(req)
+            job.set_iterate(arrays, int(req["iteration"]))
+            protocol.send_json(conn, {"ok": True})
         elif op == "ensure_model":
             self._op_ensure_model(conn, req)
         elif op == "transform":
@@ -958,7 +1115,11 @@ class DataPlaneDaemon:
                 m = self._models.pop(str(req.get("model")), None)
             protocol.send_json(conn, {"ok": True, "dropped": m is not None})
         elif op == "ping":
-            protocol.send_json(conn, {"ok": True, "v": protocol.PROTOCOL_VERSION})
+            protocol.send_json(
+                conn,
+                {"ok": True, "v": protocol.PROTOCOL_VERSION,
+                 "id": self.instance_id},
+            )
         else:
             raise ValueError(f"unknown op {op!r}")
 
@@ -1075,6 +1236,46 @@ class DataPlaneDaemon:
                 self._jobs[name] = job
         job.seed_centers(x)
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
+
+    def _op_merge_state(self, conn, req: Dict[str, Any]) -> None:
+        """Fold a peer daemon's exported job state into the named job —
+        the cross-daemon reduce. Creates the job if absent (the request
+        carries ``algo``/``n_cols``/``params`` like a first feed), so a
+        driver can merge into a fresh primary even when every row was fed
+        elsewhere. ``rows`` is the exporter's committed contribution."""
+        arrays = protocol.recv_arrays(conn, req)
+        name = str(req["job"])
+        req_algo = str(_opt(req, "algo", "pca"))
+        contrib = int(_opt(req, "rows", 0))
+        with self._jobs_lock:
+            job = self._jobs.get(name)
+        if job is None:
+            n_cols = req.get("n_cols")
+            if n_cols is None:
+                raise ValueError("merge_state into an unknown job needs n_cols")
+            # Merge into the fresh job BEFORE publishing it: a rejected
+            # payload (shape/count mismatch) must not leave an orphan
+            # mis-shaped job parked under the name (the same invariant
+            # the feed path keeps for rejected first feeds).
+            job = _Job(req_algo, int(n_cols), self._mesh, req.get("params"),
+                       clock=self._clock)
+            rows = job.merge_remote(arrays, contrib)
+            with self._jobs_lock:
+                current = self._jobs.get(name)
+                if current is None:
+                    self._jobs[name] = job
+                    protocol.send_json(conn, {"ok": True, "rows": rows})
+                    return
+            # Raced a concurrent creation: discard our unpublished copy
+            # and fold into the published job instead (arrays land once).
+            job = current
+        if job.algo != req_algo:
+            raise ValueError(
+                f"job {name!r} is algo {job.algo!r}; merge_state carried "
+                f"{req_algo!r}"
+            )
+        rows = job.merge_remote(arrays, contrib)
+        protocol.send_json(conn, {"ok": True, "rows": rows})
 
     def _op_ensure_model(self, conn, req: Dict[str, Any]) -> None:
         """Register a fitted model for serving (idempotent). The request

@@ -4,13 +4,23 @@ Who runs the data-plane daemon depends on the deployment:
 
 * **Cluster**: each TPU host runs one ``DataPlaneDaemon`` (one process owns
   the host's chips, like the reference's one-GPU-per-executor resource
-  model, README.md:110-113). The driver learns the address from
+  model, README.md:110-113). The driver learns the primary address from
   ``spark.srml.daemon.address`` / ``$SRML_DAEMON_ADDRESS`` and ships it to
   tasks; an executor colocated with a *different* TPU host overrides the
   target with its OWN host's daemon via the executor-local
-  ``$SRML_DAEMON_ADDRESS`` (the executor→local-host routing rule — data
-  flows executor → nearest TPU host; only the tiny partials cross hosts
-  through the jax.distributed mesh underneath the daemon's mesh).
+  ``$SRML_DAEMON_ADDRESS`` (the executor→local-host routing rule — row
+  data flows executor → nearest TPU host). At finalize the driver pulls
+  each peer daemon's O(d²) partials (``export_state``) and folds them
+  into the primary (``merge_state``) — the cross-daemon reduce that
+  makes the Spark-fed fit span hosts (the any-number-of-executors
+  ``RDD.reduce`` property, RapidsRowMatrix.scala:139); iterative fits
+  sync the Lloyd/Newton iterate back out with ``get_iterate``/
+  ``set_iterate`` at every pass boundary (spark/estimator.py). KMeans
+  needs the full daemon set up front (centers must be seeded before the
+  first scan): list it in ``spark.srml.daemon.addresses`` /
+  ``$SRML_DAEMON_ADDRESSES`` (comma-separated; other algorithms discover
+  peers from the task acks and need no list). Every daemon address must
+  be reachable from BOTH its executors and the driver.
 * **Local / tests**: nothing configured — the driver starts one in-process
   daemon, shared across fits (jit caches stay warm), torn down at exit.
 
@@ -58,6 +68,20 @@ def resolve(spark=None) -> Tuple[str, int, Optional[str]]:
     if addr:
         return (*_parse_addr(addr), token)
     return (*_local_daemon().address, token)
+
+
+def resolve_all(spark=None) -> list:
+    """The full daemon set for fits that must know every peer BEFORE the
+    first scan (kmeans: centers are seeded on all daemons up front).
+    Parsed from ``$SRML_DAEMON_ADDRESSES`` / ``spark.srml.daemon.addresses``
+    (comma-separated host:port). Empty when unconfigured — single-pass
+    algorithms then discover peers from task acks instead."""
+    addrs = os.environ.get("SRML_DAEMON_ADDRESSES")
+    if not addrs and spark is not None:
+        addrs = _spark_conf_get(spark, "spark.srml.daemon.addresses")
+    if not addrs:
+        return []
+    return [_parse_addr(a.strip()) for a in addrs.split(",") if a.strip()]
 
 
 def _local_daemon():

@@ -91,6 +91,13 @@ def _df_to_arrow(df, columns):
     return pa.Table.from_pandas(pdf, preserve_index=False)
 
 
+# Executor-side cache: daemon instance id per (host, port). The id is
+# constant for a daemon's lifetime, and a daemon restart mid-fit fails
+# the fit anyway (its jobs vanish) — so one ping per executor process,
+# not one per task per pass.
+_DAEMON_ID_CACHE: dict = {}
+
+
 class _FeedTask:
     """The executor-side partition feeder (a plain-pickle-able callable —
     shipped to tasks by Spark's closure serializer; imports happen on the
@@ -119,6 +126,13 @@ class _FeedTask:
         h, p = ds.executor_daemon_address(self.host, self.port)
         rows = 0
         with DataPlaneClient(h, p, token=self.token) as c:
+            # The daemon's self-reported identity: the driver keys its
+            # merge/reconcile on this, never on the address spelling (an
+            # alias of the primary must not look like a peer).
+            daemon_id = _DAEMON_ID_CACHE.get((h, p))
+            if daemon_id is None:
+                daemon_id = c.server_id() or f"{h}:{p}"
+                _DAEMON_ID_CACHE[(h, p)] = daemon_id
             for batch in batches:
                 if batch.num_rows == 0:
                     continue
@@ -138,10 +152,16 @@ class _FeedTask:
                 c.commit(
                     self.job, partition=pid, attempt=attempt, pass_id=self.pass_id
                 )
+        # The ack names the daemon this task actually fed (id + a
+        # reachable address): the driver merges partials from exactly
+        # this set and reconciles the row counts — no daemon's rows can
+        # be silently dropped.
         yield pa.RecordBatch.from_pydict(
             {
                 "partition": pa.array([pid], pa.int32()),
                 "rows": pa.array([rows], pa.int64()),
+                "daemon": pa.array([f"{h}:{p}"], pa.string()),
+                "daemon_id": pa.array([daemon_id], pa.string()),
             }
         )
 
@@ -178,6 +198,100 @@ def _probe_num_classes(df, label_col) -> int:
     ).collect()
     mx = max((float(r["maxlabel"]) for r in acks), default=-1.0)
     return max(int(mx) + 1, 2)
+
+
+def _ack_rows(acks):
+    """(total rows, rows by daemon id, id → reachable address, partition →
+    winning daemon id) from one feed pass's task acks. Daemons are keyed
+    by their self-reported instance id — address spellings alias."""
+    per: dict = {}
+    addr_of: dict = {}
+    owner: dict = {}
+    for r in acks:
+        did = r["daemon_id"]
+        per[did] = per.get(did, 0) + int(r["rows"])
+        addr_of.setdefault(did, r["daemon"])
+        if int(r["rows"]) > 0:
+            owner[int(r["partition"])] = did
+    return sum(per.values()), per, addr_of, owner
+
+
+def _split_brain(context: str, expected: int, got: int, detail: str) -> RuntimeError:
+    """The loud failure the multi-daemon plane promises: committed rows
+    and task-acked rows MUST reconcile — a mismatch means the model would
+    silently miss (or double-count) data, and the fit must fail instead
+    of returning it."""
+    if got > expected:
+        hint = (
+            "the daemon holds MORE rows than this fit's winning task acks "
+            "— a task likely committed here, lost its ack, and was re-run "
+            "against a different daemon (cross-daemon retry), or rows were "
+            "fed outside this fit. Keep executor→daemon routing sticky "
+            "across retries (host-local daemons + Spark locality)."
+        )
+    else:
+        hint = (
+            "the daemon holds FEWER rows than tasks acked — its job was "
+            "TTL-evicted or recreated mid-fit. Raise the daemon ttl "
+            "relative to fit duration."
+        )
+    return RuntimeError(
+        f"daemon row-count mismatch at {context}: tasks acked {expected} "
+        f"rows ({detail}) but the daemon plane accounts {got}; {hint} "
+        "Refit after fixing the cause."
+    )
+
+
+def _merge_peer_daemons(
+    client, job, primary_id, per_daemon, addr_of, owner, get_peer,
+    wire_algo, feed_params, drop_peer,
+):
+    """Pull every peer daemon's committed partials into the primary — the
+    cross-daemon reduce (the any-number-of-executors ``RDD.reduce``,
+    reference RapidsRowMatrix.scala:139, with daemons as leaves). Each
+    peer's export is reconciled against what its tasks acked BEFORE it is
+    folded — per partition, so a cross-daemon retry orphan or a lost
+    partition is named precisely — and a short/overfull peer fails the
+    fit instead of corrupting it."""
+    for did, fed in sorted(per_daemon.items()):
+        if did == primary_id or fed == 0:
+            continue
+        addr = addr_of[did]
+        peer = get_peer(did, addr)
+        arrays, meta = peer.export_state(job)
+        if drop_peer:
+            peer.drop(job)
+        committed = {int(p): int(n) for p, n in (meta.get("committed") or {}).items()}
+        owned = {p for p, d in owner.items() if d == did}
+        orphans = sorted(p for p in committed if p not in owned)
+        lost = sorted(p for p in owned if p not in committed)
+        if int(meta["pass_rows"]) != fed or orphans or lost:
+            parts = []
+            if orphans:
+                parts.append(
+                    f"partitions {orphans} committed here but acked on "
+                    "another daemon (cross-daemon retry orphans)"
+                )
+            if lost:
+                parts.append(f"partitions {lost} acked here but not committed")
+            raise _split_brain(
+                f"peer daemon {addr} export", fed, int(meta["pass_rows"]),
+                "; ".join(parts) or f"{addr}={fed}",
+            )
+        client.merge_state(
+            job, arrays, rows=int(meta["pass_rows"]), algo=wire_algo,
+            n_cols=int(meta["n_cols"]), params=feed_params,
+        )
+
+
+def _sync_iterate_to_peers(client, job, peers, get_peer):
+    """Push the primary's post-step iterate to every peer daemon, opening
+    the next pass there (set_iterate resets their pass statistics)."""
+    if not peers:
+        return
+    arrays, iteration = client.get_iterate(job)
+    for did in sorted(peers):
+        get_peer(did).set_iterate(job, arrays, iteration)
 
 
 class _SparkAdapter:
@@ -253,9 +367,38 @@ class _SparkAdapter:
         fn = _FeedTask(
             host, port, token, job, "knn", input_col, "label", {}, None
         )
-        acks = sel.mapInArrow(fn, "partition int, rows long").collect()
-        if sum(r["rows"] for r in acks) == 0:
+        acks = sel.mapInArrow(
+            fn, "partition int, rows long, daemon string, daemon_id string"
+        ).collect()
+        total, per_daemon, addr_of, _ = _ack_rows(acks)
+        if total == 0:
             raise ValueError("cannot fit on an empty DataFrame")
+        with DataPlaneClient(host, port, token=token) as pc0:
+            primary_id = pc0.server_id() or f"{host}:{port}"
+        # KNN state is the dataset itself — it cannot merge across daemons
+        # the way O(d²) partials do; the build must see every row, so all
+        # executors must route to the ONE daemon that builds and serves
+        # the index (shard_index spreads it over that daemon's mesh).
+        stray = sorted(addr_of[d] for d, n in per_daemon.items()
+                       if d != primary_id and n > 0)
+        if stray:
+            # Free the dataset-sized jobs everywhere BEFORE failing — a
+            # knn job holds the raw rows, and leaking them until TTL on
+            # every daemon could OOM the corrected refit.
+            for addr in list(addr_of.values()) + [f"{host}:{port}"]:
+                try:
+                    ah, ap = daemon_session._parse_addr(addr)
+                    with DataPlaneClient(ah, ap, token=token) as dc:
+                        dc.drop(job)
+                except Exception:
+                    pass
+            raise RuntimeError(
+                f"knn fit fed {len(stray)} daemon(s) other than the "
+                f"driver-resolved {host}:{port} ({', '.join(stray)}): the "
+                "index build would silently miss their rows. Unset the "
+                "executor-local SRML_DAEMON_ADDRESS override (or point "
+                "spark.srml.daemon.address at the one daemon) for knn fits."
+            )
         name = f"knnidx-{job}"
         with DataPlaneClient(host, port, token=token) as client:
             try:
@@ -275,6 +418,19 @@ class _SparkAdapter:
                 except Exception:
                     pass
                 raise
+        if int(info["n_rows"][0]) != total:
+            # Free the short registration before failing: queries against
+            # it would answer from a silently-partial database.
+            try:
+                with DataPlaneClient(host, port, token=token) as client:
+                    client.drop_model(name)
+            except Exception:
+                pass
+            raise _split_brain(
+                "knn index build", total, int(info["n_rows"][0]),
+                ", ".join(f"{addr_of[d]}={n}"
+                          for d, n in sorted(per_daemon.items())),
+            )
         return _DaemonKNNModel(
             core, host, port, token, name,
             n_rows=int(info["n_rows"][0]), input_col=input_col,
@@ -310,7 +466,31 @@ class _SparkAdapter:
         from spark_rapids_ml_tpu.serve.client import DataPlaneClient
 
         feed_params = {}
+        # Peer daemons (executor-local routing): keyed by self-reported
+        # instance id (address spellings alias); discovered from task
+        # acks pass by pass, seeded up front for kmeans (resolve_all).
+        peers: dict = {}
+        total_fed = 0
+        fed_by_daemon: dict = {}
         client = DataPlaneClient(host, port, token=token)
+        primary_id = client.server_id() or f"{host}:{port}"
+        addr_by_id = {primary_id: f"{host}:{port}"}
+        # One long-lived client per peer daemon for the whole fit (the
+        # primary already has one): merges and iterate syncs happen every
+        # pass, and per-op TCP connect churn would dominate small passes.
+        peer_clients: dict = {}
+
+        def peer_client(did, addr=None):
+            c = peer_clients.get(did)
+            if c is None:
+                h2, p2 = (
+                    daemon_session._parse_addr(addr)
+                    if addr is not None else peers[did]
+                )
+                c = DataPlaneClient(h2, p2, token=token)
+                peer_clients[did] = c
+            return c
+
         try:
             if algo == "logreg":
                 # Spark ML infers numClasses from the labels; here one
@@ -327,26 +507,77 @@ class _SparkAdapter:
                 }
                 # Deterministic driver-side seeding: a small prefix sample
                 # (≥ k rows) — ONE tiny Spark job, like the reference's
-                # numCols probe (RapidsPCA.scala:73-74).
+                # numCols probe (RapidsPCA.scala:73-74). The SAME batch +
+                # rng seed goes to every configured daemon
+                # (spark.srml.daemon.addresses), so all hosts open pass 0
+                # with bitwise-identical centers; a peer daemon NOT listed
+                # there fails its tasks loudly (centers unseeded).
                 seed_n = max(k, min(4096, 32 * k))
                 seed_tbl = _df_to_arrow(sel.limit(seed_n), [input_col])
                 client.seed_kmeans(
                     job, seed_tbl, k=k, input_col=input_col, params=feed_params
                 )
+                for ph, pp in daemon_session.resolve_all(spark):
+                    pc = DataPlaneClient(ph, pp, token=token)
+                    pid_ = pc.server_id() or f"{ph}:{pp}"
+                    if pid_ == primary_id or pid_ in peers:
+                        pc.close()  # an alias of a daemon already seeded
+                        continue
+                    peers[pid_] = (ph, pp)
+                    peer_clients[pid_] = pc
+                    pc.seed_kmeans(
+                        job, seed_tbl, k=k, input_col=input_col,
+                        params=feed_params,
+                    )
 
-            def run_pass(pass_id):
+            def run_pass(pass_id, merge=True, drop_peer=False):
+                """One executor scan; folds peer-daemon partials into the
+                primary and reconciles row counts. Returns the pass total."""
+                nonlocal total_fed
                 fn = _FeedTask(
                     host, port, token, job, wire_algo, input_col,
                     label_col or "label", feed_params, pass_id,
                 )
-                acks = sel.mapInArrow(fn, "partition int, rows long").collect()
-                return sum(r["rows"] for r in acks)
+                acks = sel.mapInArrow(
+                    fn,
+                    "partition int, rows long, daemon string, daemon_id string",
+                ).collect()
+                n, per, addr_of, owner = _ack_rows(acks)
+                for did, cnt in per.items():
+                    fed_by_daemon[did] = fed_by_daemon.get(did, 0) + cnt
+                    addr_by_id.setdefault(did, addr_of[did])
+                    # Only a daemon that actually holds rows becomes a
+                    # peer: an all-empty-partitions executor acks rows=0
+                    # without ever creating the job there — set_iterate
+                    # against it would fail an otherwise-consistent fit.
+                    if cnt > 0 and did != primary_id and did not in peers:
+                        peers[did] = daemon_session._parse_addr(addr_of[did])
+                if merge:
+                    _merge_peer_daemons(
+                        client, job, primary_id, per, addr_of, owner,
+                        peer_client, wire_algo, feed_params,
+                        drop_peer=drop_peer,
+                    )
+                total_fed += n
+                return n
+
+            def finalize_guarded(params):
+                """Primary finalize + the split-brain row guard: the
+                daemon-accounted total must equal what tasks acked."""
+                arrays, fin_rows = client.finalize(job, params)
+                if fin_rows != total_fed:
+                    detail = ", ".join(
+                        f"{addr_by_id.get(d, d)}={n}"
+                        for d, n in sorted(fed_by_daemon.items())
+                    )
+                    raise _split_brain("finalize", total_fed, fin_rows, detail)
+                return arrays, fin_rows
 
             if algo == "scaler":
-                n = run_pass(None)
+                n = run_pass(None, drop_peer=True)
                 if n == 0:
                     raise ValueError("cannot fit on an empty DataFrame")
-                arrays, _ = client.finalize(job, {"raw_moments": True})
+                arrays, _ = finalize_guarded({"raw_moments": True})
                 from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
 
                 cnt = float(arrays["count"][0])
@@ -359,14 +590,15 @@ class _SparkAdapter:
                     mean=mean, std=np.sqrt(np.maximum(var, 0.0))
                 )
             elif algo == "pca":
-                n = run_pass(None)
+                n = run_pass(None, drop_peer=True)
                 if n == 0:
                     raise ValueError("cannot fit on an empty DataFrame")
-                arrays = client.finalize_pca(
-                    job,
-                    k=core.getK(),
-                    mean_center=core.getMeanCentering(),
-                    solver=core.getSolver(),
+                arrays, _ = finalize_guarded(
+                    {
+                        "k": core.getK(),
+                        "mean_center": core.getMeanCentering(),
+                        "solver": core.getSolver(),
+                    }
                 )
                 from spark_rapids_ml_tpu.models.pca import PCAModel
 
@@ -376,11 +608,10 @@ class _SparkAdapter:
                     mean=arrays["mean"],
                 )
             elif algo == "linreg":
-                n = run_pass(None)
+                n = run_pass(None, drop_peer=True)
                 if n == 0:
                     raise ValueError("cannot fit on an empty DataFrame")
-                arrays, rows = client.finalize(
-                    job,
+                arrays, rows = finalize_guarded(
                     {
                         "reg": core.getRegParam(),
                         "elastic_net": core.getElasticNetParam(),
@@ -412,6 +643,12 @@ class _SparkAdapter:
                     if run_pass(it) == 0:
                         raise ValueError("cannot fit on an empty DataFrame")
                     info = client.step(job)
+                    # Every peer opens the new pass with the primary's
+                    # post-step centers (set_iterate resets its pass
+                    # stats) — the cross-host Lloyd lockstep. Runs even on
+                    # the converged pass: the final cost-only scan below
+                    # feeds peers against the updated centers.
+                    _sync_iterate_to_peers(client, job, peers, peer_client)
                     if info["moved2"] <= tol2:
                         break
                 # One final cost-only scan at the UPDATED centers (r2
@@ -420,7 +657,7 @@ class _SparkAdapter:
                 # stale). finalize reads the unstepped pass's inertia —
                 # the exact fit_kmeans_stream trainingCost semantics.
                 n_rows = run_pass(info["iteration"])
-                arrays = client.finalize_kmeans(job)
+                arrays, _ = finalize_guarded({})
                 cost = float(arrays["cost"][0])
                 from spark_rapids_ml_tpu.models.kmeans import (
                     KMeansModel,
@@ -449,8 +686,12 @@ class _SparkAdapter:
                         raise ValueError("cannot fit on an empty DataFrame")
                     info = client.step(job, params=step_params)
                     if info["delta"] <= core.getTol():
-                        break
-                arrays = client.finalize_logreg(job)
+                        break  # converged: nothing reads a peer sync now
+                    # Peers open the new pass with the primary's post-step
+                    # coefficients (pass 0 needs no sync: every daemon
+                    # starts at the zero iterate).
+                    _sync_iterate_to_peers(client, job, peers, peer_client)
+                arrays, _ = finalize_guarded({})
                 from spark_rapids_ml_tpu.models.logistic_regression import (
                     LogisticRegressionModel,
                     LogisticTrainingSummary,
@@ -475,6 +716,13 @@ class _SparkAdapter:
             except Exception:
                 pass
             client.close()
+            for did in list(peers):
+                try:
+                    peer_client(did).drop(job)
+                except Exception:
+                    pass
+            for pc in peer_clients.values():
+                pc.close()
             if multi_pass:
                 sel.unpersist()
         model.uid = core.uid

@@ -1,0 +1,33 @@
+"""Standalone data-plane daemon process for multi-host tests.
+
+Spawned by tests/test_spark_multidaemon.py: each instance is one OS
+process owning "its host's" daemon (the deployment unit of
+spark/daemon_session.py), so the 2-daemon tests exercise real process
+isolation — separate JAX runtimes, separate device state, TCP between
+everything — not two registries in one interpreter.
+
+Prints ``READY <port>`` on stdout once listening; serves until stdin
+closes (the parent's handle drop is the shutdown signal, so an aborted
+test never leaks the process).
+"""
+
+import sys
+
+
+def main() -> None:
+    import jax
+
+    # The dev image's sitecustomize pins the tunneled TPU platform; this
+    # worker must run on host CPU like the test session (see sparksim).
+    jax.config.update("jax_platforms", "cpu")
+
+    from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+
+    daemon = DataPlaneDaemon(host="127.0.0.1", port=0, ttl=600.0).start()
+    print(f"READY {daemon.address[1]}", flush=True)
+    sys.stdin.read()  # block until the parent closes our stdin
+    daemon.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -143,6 +143,7 @@ class SimDataFrame:
         fail_plan: Optional[Dict[int, List[Optional[int]]]] = None,
         speculative: Optional[Sequence[int]] = None,
         max_attempts: int = 4,
+        env_plan: Optional[Dict[int, Dict[str, str]]] = None,
     ):
         self._parts = [
             p if isinstance(p, pa.Table) else pa.Table.from_batches([p])
@@ -156,6 +157,10 @@ class SimDataFrame:
         # primary succeeds (Spark speculation: same partition, new attempt).
         self._speculative = list(speculative or [])
         self._max_attempts = max_attempts
+        # env_plan: partition -> extra task env (models executors on
+        # DIFFERENT hosts: e.g. a per-executor SRML_DAEMON_ADDRESS that
+        # routes the task to its host-local daemon).
+        self._env_plan = env_plan or {}
         self._mapped: Optional[Callable] = None
 
     # -- the DataFrame surface the wrappers use ---------------------------
@@ -171,6 +176,7 @@ class SimDataFrame:
             self._fail_plan,
             self._speculative,
             self._max_attempts,
+            self._env_plan,
         )
         return out
 
@@ -204,7 +210,7 @@ class SimDataFrame:
     def mapInArrow(self, fn, schema) -> "SimDataFrame":
         out = SimDataFrame(
             self._parts, self.sparkSession, self._fail_plan,
-            self._speculative, self._max_attempts,
+            self._speculative, self._max_attempts, self._env_plan,
         )
         out._mapped = fn
         return out
@@ -248,6 +254,7 @@ class SimDataFrame:
             k: v for k, v in os.environ.items()
             if k.startswith(("SRML_", "JAX_"))
         }
+        env.update(self._env_plan.get(pid, {}))
         proc = ctx.Process(
             target=_run_task,
             args=(self._mapped, list(batches), pid, attempt, fail_after, q, env),

@@ -1,0 +1,405 @@
+"""Multi-host data plane: Spark-fed fits spanning MULTIPLE daemons.
+
+The reference's reduce works across any number of executors
+(RapidsRowMatrix.scala:139); here the equivalent is executors feeding
+their host-local daemons and the driver folding every daemon's O(d²)
+partials into the primary at each pass boundary (export_state /
+merge_state / get_iterate / set_iterate — docs/protocol.md). These tests
+route half the partitions to a second daemon via the executor-local
+``SRML_DAEMON_ADDRESS`` (sparksim env_plan — the documented routing rule)
+and require the fitted model to be BITWISE-equal to the single-daemon
+fit: the data is integer-valued, so every sufficient statistic is exact
+in f32 and any row lost, duplicated, or double-merged changes the model.
+
+The flagship test runs the two daemons in two separate OS processes
+(tests/daemon_worker.py) — real process isolation, like two TPU hosts.
+The rest use in-process daemons (same TCP protocol, faster).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon, _Job
+from spark_rapids_ml_tpu.spark import estimator as spark_est
+from spark_rapids_ml_tpu.spark.estimator import (
+    SparkKMeans,
+    SparkLinearRegression,
+    SparkLogisticRegression,
+    SparkPCA,
+)
+
+from sparksim import SimDataFrame, SimSparkSession, simdf_from_numpy
+
+spark_est.register_dataframe_type(SimDataFrame)
+
+
+def _addr(daemon) -> str:
+    return f"{daemon.address[0]}:{daemon.address[1]}"
+
+
+@pytest.fixture
+def two_daemons():
+    """Two in-process daemons — 'two TPU hosts' on one box; the protocol
+    traffic (executor feeds, driver merges) is identical real TCP."""
+    with DataPlaneDaemon(ttl=600.0) as a, DataPlaneDaemon(ttl=600.0) as b:
+        yield a, b
+
+
+def _int_matrix(rng, n, d):
+    """Integer-valued rows: every Gram/moment statistic is exact in f32,
+    so daemon-merge order cannot perturb the model — equality checks are
+    bitwise, and any accounting bug (lost/duplicated rows) is a hard
+    mismatch rather than a tolerance blur."""
+    return rng.integers(-8, 9, size=(n, d)).astype(np.float64)
+
+
+def _split_session(primary, peer, n_partitions=4):
+    """Driver resolves ``primary``; the upper half of the partitions
+    routes to ``peer`` via the executor-local env override."""
+    session = SimSparkSession({"spark.srml.daemon.address": _addr(primary)})
+    env_plan = {
+        pid: {"SRML_DAEMON_ADDRESS": _addr(peer)}
+        for pid in range(n_partitions // 2, n_partitions)
+    }
+    return session, env_plan
+
+
+def test_pca_two_daemons_bitwise_equal(rng, mesh8, two_daemons):
+    a, b = two_daemons
+    x = _int_matrix(rng, 800, 16)
+
+    single = simdf_from_numpy(
+        x, n_partitions=4,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = SparkPCA().setInputCol("features").setK(4).fit(single)
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    m_split = SparkPCA().setInputCol("features").setK(4).fit(split)
+    assert split.sparkSession.driver_rows_materialized == 0
+
+    np.testing.assert_array_equal(m_split.pc, m_single.pc)
+    np.testing.assert_array_equal(m_split.mean, m_single.mean)
+    np.testing.assert_array_equal(
+        m_split.explainedVariance, m_single.explainedVariance
+    )
+    # both peers' jobs were consumed (no leaked device state)
+    assert not a._jobs and not b._jobs
+
+
+def test_linreg_two_daemons_bitwise_equal(rng, mesh8, two_daemons):
+    a, b = two_daemons
+    x = _int_matrix(rng, 600, 12)
+    y = (x @ rng.integers(-3, 4, size=12)).astype(np.float64)
+
+    single = simdf_from_numpy(
+        x, n_partitions=4, label=y,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = SparkLinearRegression().setRegParam(1e-3).fit(single)
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, label=y, session=session,
+                             env_plan=env_plan)
+    m_split = SparkLinearRegression().setRegParam(1e-3).fit(split)
+
+    np.testing.assert_array_equal(m_split.coefficients, m_single.coefficients)
+    assert m_split.intercept == m_single.intercept
+    assert m_split.summary.rmse == m_single.summary.rmse
+
+
+def test_kmeans_two_daemons_bitwise_equal(rng, mesh8, two_daemons):
+    """Iterative multi-daemon: every pass merges peer partials before the
+    Lloyd step and pushes the stepped centers back out (set_iterate), so
+    all hosts scan pass p against identical centers. KMeans needs the
+    daemon set up front (centers seed before the first scan) — that is
+    the documented spark.srml.daemon.addresses contract."""
+    a, b = two_daemons
+    k, d = 4, 6
+    centers_true = rng.integers(-12, 13, size=(k, d)) * 4
+    x = np.concatenate(
+        [centers_true[i] + rng.integers(-1, 2, size=(150, d))
+         for i in range(k)]
+    ).astype(np.float64)
+    x = x[rng.permutation(len(x))]
+
+    single = simdf_from_numpy(
+        x, n_partitions=4,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = SparkKMeans().setK(k).setMaxIter(8).setSeed(3).fit(single)
+
+    session, env_plan = _split_session(a, b)
+    session.conf.set(
+        "spark.srml.daemon.addresses", f"{_addr(a)},{_addr(b)}"
+    )
+    split = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    m_split = SparkKMeans().setK(k).setMaxIter(8).setSeed(3).fit(split)
+
+    np.testing.assert_array_equal(m_split.centers, m_single.centers)
+    assert m_split.summary.numIter == m_single.summary.numIter
+    assert m_split.summary.trainingCost == m_single.summary.trainingCost
+
+
+def test_logreg_two_daemons_matches_single(rng, mesh8, two_daemons):
+    """Newton statistics involve sigmoids (not integer-exact), so the
+    cross-daemon fold order shifts the f32 sums at rounding level —
+    compare to the single-daemon fit at tight tolerance instead of
+    bitwise. Peers are discovered from pass-0 acks (no address list
+    needed: every daemon starts at the zero iterate)."""
+    a, b = two_daemons
+    n, d = 600, 8
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    w = rng.normal(size=d)
+    y = (x @ w > 0).astype(np.float64)
+
+    single = simdf_from_numpy(
+        x, n_partitions=4, label=y,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(15).fit(single)
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, label=y, session=session,
+                             env_plan=env_plan)
+    m_split = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(15).fit(split)
+
+    np.testing.assert_allclose(
+        m_split.coefficients, m_single.coefficients, atol=1e-5
+    )
+    np.testing.assert_allclose(m_split.intercept, m_single.intercept, atol=1e-5)
+    assert m_split.summary.numIter >= 2
+
+
+def test_multidaemon_survives_task_retry(rng, mesh8, two_daemons):
+    """Exactly-once composes with the multi-daemon merge: a task dying
+    mid-feed on the PEER daemon retries there, and the merged model is
+    still bitwise-equal to the clean split fit."""
+    a, b = two_daemons
+    x = _int_matrix(rng, 800, 16)
+
+    session, env_plan = _split_session(a, b)
+    clean = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    m_clean = SparkPCA().setInputCol("features").setK(3).fit(clean)
+
+    session2, env_plan2 = _split_session(a, b)
+    flaky = simdf_from_numpy(
+        x, n_partitions=4, session=session2, env_plan=env_plan2,
+        fail_plan={3: [1]},  # partition 3 (peer-routed) dies after 1 batch
+    )
+    m_flaky = SparkPCA().setInputCol("features").setK(3).fit(flaky)
+
+    np.testing.assert_array_equal(m_flaky.pc, m_clean.pc)
+    np.testing.assert_array_equal(m_flaky.mean, m_clean.mean)
+
+
+def test_split_brain_guard_fails_loudly(rng, mesh8, monkeypatch):
+    """A daemon that loses committed rows (the failure class behind every
+    silent-partial-model scenario: job eviction/recreation mid-fit) must
+    fail the fit with the row-count mismatch — never return a model."""
+    orig = _Job.commit
+
+    def lossy_commit(self, partition, attempt=0, pass_id=None):
+        if partition == 2:
+            # Simulate a lost stage: ack the commit without folding rows.
+            with self.lock:
+                self.staged.pop((partition, attempt), None)
+                self.committed[partition] = 0
+                return self.rows
+        return orig(self, partition, attempt, pass_id)
+
+    monkeypatch.setattr(_Job, "commit", lossy_commit)
+    with DataPlaneDaemon(ttl=600.0) as a:
+        session = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+        df = simdf_from_numpy(_int_matrix(rng, 400, 8), n_partitions=4,
+                              session=session)
+        with pytest.raises(RuntimeError, match="row-count mismatch"):
+            SparkPCA().setInputCol("features").setK(3).fit(df)
+
+
+def test_peer_export_shortfall_fails_loudly(rng, mesh8, monkeypatch):
+    """The per-peer guard: a peer whose export accounts fewer rows than
+    its tasks acked fails the fit BEFORE its partials are folded in."""
+    orig = _Job.export_state
+
+    def short_export(self):
+        arrays, meta = orig(self)
+        meta = {**meta, "pass_rows": meta["pass_rows"] - 7}
+        return arrays, meta
+
+    monkeypatch.setattr(_Job, "export_state", short_export)
+    with DataPlaneDaemon(ttl=600.0) as a, DataPlaneDaemon(ttl=600.0) as b:
+        session, env_plan = _split_session(a, b)
+        df = simdf_from_numpy(_int_matrix(rng, 400, 8), n_partitions=4,
+                              session=session, env_plan=env_plan)
+        with pytest.raises(RuntimeError, match="row-count mismatch"):
+            SparkPCA().setInputCol("features").setK(3).fit(df)
+
+
+def test_merge_state_rejected_payload_leaves_no_orphan_job(rng, mesh8):
+    """A merge_state whose payload mismatches the fresh job's state must
+    not park a mis-shaped job under the name — the corrected retry (and
+    ordinary feeds) must find a clean slate."""
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+    with DataPlaneDaemon(ttl=600.0) as a:
+        c = DataPlaneClient(*a.address)
+        with pytest.raises(RuntimeError, match="arrays"):
+            # pca state has 3 leaves (count, colsum, gram); one array
+            # is a count mismatch → rejected
+            c.merge_state("fresh", {"s0": np.zeros((3, 3))}, rows=5,
+                          algo="pca", n_cols=8)
+        assert "fresh" not in a._jobs, "rejected merge left an orphan job"
+        # the name is clean: a normal feed under it works
+        x = rng.normal(size=(16, 8))
+        c.feed("fresh", x, algo="pca")
+        res, rows = c.finalize("fresh", {"k": 2})
+        assert rows == 16 and res["pc"].shape == (8, 2)
+
+
+def test_empty_partitions_on_unfed_daemon_not_a_peer(rng, mesh8, two_daemons):
+    """An executor holding only EMPTY partitions acks rows=0 without ever
+    creating the job on its daemon; that daemon must not be treated as a
+    peer (set_iterate against it would fail a consistent fit)."""
+    import pyarrow as pa
+
+    from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
+
+    a, b = two_daemons
+    n, d = 300, 6
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    parts = [
+        pa.table({"features": matrix_to_list_column(xi),
+                  "label": pa.array(yi)})
+        for xi, yi in zip(np.array_split(x, 3), np.array_split(y, 3))
+    ]
+    parts.append(  # empty partition 3, routed to daemon B
+        pa.table({"features": matrix_to_list_column(np.zeros((0, d))),
+                  "label": pa.array(np.zeros(0))})
+    )
+    session = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+    df = SimDataFrame(parts, session=session,
+                      env_plan={3: {"SRML_DAEMON_ADDRESS": _addr(b)}})
+    model = SparkLogisticRegression().setMaxIter(8).fit(df)
+    assert model.summary.numIter >= 2
+    assert not b._jobs, "the zero-row daemon must never have seen the job"
+
+
+def test_primary_alias_is_not_a_peer(rng, mesh8):
+    """Daemons are identified by self-reported instance id, not address
+    spelling: tasks routed to 'localhost:PORT' while the driver resolves
+    '127.0.0.1:PORT' (the SAME daemon) must fit exactly like a single
+    daemon — no self-merge, no spurious split-brain failure."""
+    with DataPlaneDaemon(ttl=600.0) as a:
+        x = _int_matrix(rng, 400, 8)
+        session = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+        m_plain = SparkPCA().setInputCol("features").setK(3).fit(
+            simdf_from_numpy(x, n_partitions=4, session=session)
+        )
+        alias = f"localhost:{a.address[1]}"
+        env_plan = {pid: {"SRML_DAEMON_ADDRESS": alias} for pid in (2, 3)}
+        session2 = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+        m_alias = SparkPCA().setInputCol("features").setK(3).fit(
+            simdf_from_numpy(x, n_partitions=4, session=session2,
+                             env_plan=env_plan)
+        )
+        np.testing.assert_array_equal(m_alias.pc, m_plain.pc)
+        np.testing.assert_array_equal(m_alias.mean, m_plain.mean)
+
+
+def test_knn_multidaemon_routing_rejected(rng, mesh8, two_daemons):
+    """KNN state is the dataset itself — a split-routed knn fit must fail
+    loudly (the index build would silently miss the peer's rows)."""
+    from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
+
+    a, b = two_daemons
+    session, env_plan = _split_session(a, b)
+    df = simdf_from_numpy(rng.normal(size=(200, 6)), n_partitions=4,
+                          session=session, env_plan=env_plan)
+    with pytest.raises(RuntimeError, match="knn fit fed"):
+        SparkNearestNeighbors().setK(3).fit(df)
+
+
+def test_two_daemon_processes_end_to_end(rng, mesh8):
+    """The flagship: two daemons in two separate OS PROCESSES (separate
+    JAX runtimes — two 'TPU hosts'), executor tasks in further processes
+    splitting their feeds between them, driver merging partials over TCP.
+    The split fit must equal the single-daemon fit bitwise, for both a
+    single-pass (PCA) and an iterative (KMeans) algorithm."""
+    workers = []
+    try:
+        for _ in range(2):
+            env = {
+                k: v for k, v in os.environ.items()
+                if not k.startswith("SRML_")
+            }
+            env["JAX_PLATFORMS"] = "cpu"
+            repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in (repo_root, env.get("PYTHONPATH")) if p
+            )
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(os.path.dirname(__file__),
+                                              "daemon_worker.py")],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                cwd=repo_root, env=env, text=True,
+            )
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY "), line
+            workers.append((proc, int(line.split()[1])))
+        (pa_proc, port_a), (pb_proc, port_b) = workers
+        addr_a, addr_b = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"
+
+        x = _int_matrix(rng, 800, 16)
+        single = simdf_from_numpy(
+            x, n_partitions=4,
+            session=SimSparkSession({"spark.srml.daemon.address": addr_a}),
+        )
+        m_single = SparkPCA().setInputCol("features").setK(4).fit(single)
+
+        session = SimSparkSession({"spark.srml.daemon.address": addr_a})
+        env_plan = {2: {"SRML_DAEMON_ADDRESS": addr_b},
+                    3: {"SRML_DAEMON_ADDRESS": addr_b}}
+        split = simdf_from_numpy(x, n_partitions=4, session=session,
+                                 env_plan=env_plan)
+        m_split = SparkPCA().setInputCol("features").setK(4).fit(split)
+        assert split.sparkSession.driver_rows_materialized == 0
+        np.testing.assert_array_equal(m_split.pc, m_single.pc)
+        np.testing.assert_array_equal(m_split.mean, m_single.mean)
+
+        # Iterative across processes: KMeans with the address list.
+        k, d = 3, 6
+        centers_true = rng.integers(-12, 13, size=(k, d)) * 4
+        xk = np.concatenate(
+            [centers_true[i] + rng.integers(-1, 2, size=(120, d))
+             for i in range(k)]
+        ).astype(np.float64)
+        ks_single = simdf_from_numpy(
+            xk, n_partitions=4,
+            session=SimSparkSession({"spark.srml.daemon.address": addr_a}),
+        )
+        km_single = SparkKMeans().setK(k).setMaxIter(6).setSeed(7).fit(ks_single)
+        ks_sess = SimSparkSession({
+            "spark.srml.daemon.address": addr_a,
+            "spark.srml.daemon.addresses": f"{addr_a},{addr_b}",
+        })
+        ks_split = simdf_from_numpy(xk, n_partitions=4, session=ks_sess,
+                                    env_plan=env_plan)
+        km_split = SparkKMeans().setK(k).setMaxIter(6).setSeed(7).fit(ks_split)
+        np.testing.assert_array_equal(km_split.centers, km_single.centers)
+    finally:
+        for proc, _ in workers:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
