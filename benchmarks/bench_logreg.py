@@ -80,7 +80,7 @@ def main() -> None:
         stream_softmax_zero_state,
     )
 
-    C = int(os.environ.get("SRML_BENCH_CLASSES", 8))
+    C = int(os.environ.get("SRML_BENCH_CLASSES", 32))
     rows_mm = int(os.environ.get("SRML_BENCH_MM_ROWS", ROWS // 4))
     x_mm = jax.random.normal(jax.random.key(2), (rows_mm, D), dtype=jnp.float32)
     y_mm = jax.random.randint(jax.random.key(3), (rows_mm,), 0, C).astype(
@@ -114,11 +114,15 @@ def main() -> None:
         ROWS / dt_per_iter / n_chips,
         "row_iters/s/chip",
         (ROWS / dt_per_iter / n_chips) / A100_ROW_ITERS_PER_SEC,
-        multinomial_classes=C,
-        multinomial_row_iters_per_sec_per_chip=round(
-            rows_mm / dt_mm / n_chips, 1
-        ),
-        multinomial_vs_baseline=round((rows_mm / dt_mm / n_chips) / a100_mm, 4),
+    )
+    # Its own line (VERDICT r3 #8): the multinomial MM-Newton pass is a
+    # peer workload, not a footnote on the binary number.
+    emit(
+        f"logreg_multinomial_row_iters_per_sec_per_chip_d{D}_C{C}",
+        rows_mm / dt_mm / n_chips,
+        "row_iters/s/chip",
+        (rows_mm / dt_mm / n_chips) / a100_mm,
+        classes=C,
     )
 
 

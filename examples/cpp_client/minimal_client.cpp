@@ -1,0 +1,221 @@
+// Minimal dependency-free client for the spark_rapids_ml_tpu data-plane
+// daemon, written from docs/protocol.md ALONE — no Arrow, no JSON
+// library, nothing beyond POSIX sockets and the C++ standard library.
+// It exists as the existence proof for the "~100 lines in any language"
+// interop claim (README "Scope: PySpark, not Scala"): the feeding logic
+// itself is ~100 lines; the rest is a tiny JSON value scanner.
+//
+// Protocol recap (docs/protocol.md):
+//   frame    = 4-byte big-endian length + payload
+//   request  = one JSON frame [+ raw array frames for `feed_raw`]
+//   response = one JSON frame [+ one raw little-endian C-contiguous
+//              buffer frame per entry of its "arrays" spec, in order]
+//
+// Session: ping → feed_raw two partitions (+ commit: the exactly-once
+// path) → finalize PCA → print the returned arrays for the caller to
+// check (tests/test_cpp_client.py compares against the local oracle).
+//
+// Usage: minimal_client HOST PORT [N D K]
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+static int die(const std::string& msg) {
+  std::fprintf(stderr, "minimal_client: %s\n", msg.c_str());
+  std::exit(1);
+}
+
+// ---- framing ----------------------------------------------------------
+
+static void send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) die("send failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+static void recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) die("connection closed mid-frame");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+static void send_frame(int fd, const void* payload, size_t n) {
+  uint32_t be = htonl(static_cast<uint32_t>(n));
+  send_all(fd, &be, 4);
+  send_all(fd, payload, n);
+}
+
+static std::string recv_frame(int fd) {
+  uint32_t be = 0;
+  recv_all(fd, &be, 4);
+  std::string payload(ntohl(be), '\0');
+  if (!payload.empty()) recv_all(fd, payload.data(), payload.size());
+  return payload;
+}
+
+// ---- a tiny JSON value scanner (enough for the daemon's responses) ----
+
+// Returns the raw JSON value text for `"key":` at the top level of an
+// object (daemon responses are flat except for the "arrays" list).
+static std::string json_value(const std::string& js, const std::string& key,
+                              size_t from = 0) {
+  const std::string needle = "\"" + key + "\"";
+  size_t k = js.find(needle, from);
+  if (k == std::string::npos) return "";
+  size_t i = js.find(':', k + needle.size());
+  if (i == std::string::npos) return "";
+  ++i;
+  while (i < js.size() && js[i] == ' ') ++i;
+  size_t start = i;
+  int depth = 0;
+  bool in_str = false;
+  for (; i < js.size(); ++i) {
+    char c = js[i];
+    if (in_str) {
+      if (c == '\\') ++i;
+      else if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '"') in_str = true;
+    else if (c == '[' || c == '{') ++depth;
+    else if (c == ']' || c == '}') {
+      if (depth == 0) break;
+      --depth;
+    } else if ((c == ',') && depth == 0) break;
+  }
+  return js.substr(start, i - start);
+}
+
+struct ArraySpec {
+  std::string name, dtype;
+  std::vector<long> shape;
+};
+
+// Parse the ordered "arrays" spec list: [{"name": .., "dtype": ..,
+// "shape": [..]}, ...]
+static std::vector<ArraySpec> parse_specs(const std::string& js) {
+  std::vector<ArraySpec> out;
+  std::string list = json_value(js, "arrays");
+  size_t pos = 0;
+  while (true) {
+    size_t open = list.find('{', pos);
+    if (open == std::string::npos) break;
+    size_t close = list.find('}', open);
+    std::string obj = list.substr(open, close - open + 1);
+    ArraySpec spec;
+    std::string nm = json_value(obj, "name");
+    spec.name = nm.substr(1, nm.size() - 2);  // strip quotes
+    std::string dt = json_value(obj, "dtype");
+    spec.dtype = dt.substr(1, dt.size() - 2);
+    std::string sh = json_value(obj, "shape");
+    for (size_t i = 1; i < sh.size();) {  // inside [ ... ]
+      char* end = nullptr;
+      long v = std::strtol(sh.c_str() + i, &end, 10);
+      if (end == sh.c_str() + i) break;
+      spec.shape.push_back(v);
+      i = static_cast<size_t>(end - sh.c_str()) + 1;
+    }
+    out.push_back(spec);
+    pos = close + 1;
+  }
+  return out;
+}
+
+static std::string roundtrip_json(int fd, const std::string& req) {
+  send_frame(fd, req.data(), req.size());
+  std::string resp = recv_frame(fd);
+  if (json_value(resp, "ok") != "true")
+    die("daemon error: " + json_value(resp, "error") + " for " + req);
+  return resp;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) die("usage: minimal_client HOST PORT [N D K]");
+  long N = argc > 3 ? std::strtol(argv[3], nullptr, 10) : 512;
+  long D = argc > 4 ? std::strtol(argv[4], nullptr, 10) : 8;
+  long K = argc > 5 ? std::strtol(argv[5], nullptr, 10) : 2;
+
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(argv[1], argv[2], &hints, &res) != 0 || !res)
+    die("cannot resolve host");
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0)
+    die("cannot connect");
+  freeaddrinfo(res);
+
+  // 1. ping — the version handshake (v-exempt; server echoes its v).
+  std::string pong = roundtrip_json(fd, "{\"op\": \"ping\"}");
+  if (json_value(pong, "v") != "1") die("server does not speak v1");
+  std::printf("ping ok v=1\n");
+
+  // 2. Deterministic integer data (LCG; mirrored by the test's oracle),
+  //    fed as TWO partitions through the exactly-once feed_raw/commit
+  //    path in float64 raw frames.
+  std::vector<double> x(static_cast<size_t>(N) * D);
+  uint32_t state = 12345;
+  for (auto& v : x) {
+    state = state * 1664525u + 1013904223u;  // Numerical Recipes LCG
+    v = static_cast<double>(static_cast<long>((state >> 16) % 17) - 8);
+  }
+  long half = N / 2;
+  for (int part = 0; part < 2; ++part) {
+    long rows = part == 0 ? half : N - half;
+    const double* ptr = x.data() + (part == 0 ? 0 : half * D);
+    char head[512];
+    std::snprintf(head, sizeof(head),
+                  "{\"v\": 1, \"op\": \"feed_raw\", \"job\": \"cpp-demo\", "
+                  "\"algo\": \"pca\", \"n_cols\": %ld, \"partition\": %d, "
+                  "\"arrays\": [{\"name\": \"x\", \"dtype\": \"float64\", "
+                  "\"shape\": [%ld, %ld]}]}",
+                  D, part, rows, D);
+    send_frame(fd, head, std::strlen(head));
+    send_frame(fd, ptr, static_cast<size_t>(rows) * D * sizeof(double));
+    std::string resp = recv_frame(fd);
+    if (json_value(resp, "ok") != "true")
+      die("feed_raw rejected: " + json_value(resp, "error"));
+    char commit[256];
+    std::snprintf(commit, sizeof(commit),
+                  "{\"v\": 1, \"op\": \"commit\", \"job\": \"cpp-demo\", "
+                  "\"partition\": %d}", part);
+    roundtrip_json(fd, commit);
+  }
+
+  // 3. finalize → JSON header + one raw frame per spec entry, in order.
+  char fin[256];
+  std::snprintf(fin, sizeof(fin),
+                "{\"v\": 1, \"op\": \"finalize\", \"job\": \"cpp-demo\", "
+                "\"params\": {\"k\": %ld}}", K);
+  std::string header = roundtrip_json(fd, fin);
+  std::printf("rows %s\n", json_value(header, "rows").c_str());
+  for (const ArraySpec& spec : parse_specs(header)) {
+    std::string buf = recv_frame(fd);
+    if (spec.dtype != "float64") die("unexpected dtype " + spec.dtype);
+    std::printf("array %s", spec.name.c_str());
+    for (long s : spec.shape) std::printf(" %ld", s);
+    std::printf(" :");
+    const double* vals = reinterpret_cast<const double*>(buf.data());
+    for (size_t i = 0; i < buf.size() / sizeof(double); ++i)
+      std::printf(" %.17g", vals[i]);
+    std::printf("\n");
+  }
+  ::close(fd);
+  return 0;
+}

@@ -155,6 +155,40 @@ class DataPlaneClient:
         )
         return int(resp["rows"])
 
+    def feed_raw(
+        self,
+        job: str,
+        x: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        algo: str = "pca",
+        n_cols: Optional[int] = None,
+        params: Optional[Dict[str, Any]] = None,
+        partition: Optional[int] = None,
+        attempt: int = 0,
+        pass_id: Optional[int] = None,
+    ) -> int:
+        """:meth:`feed` semantics with a dependency-free payload: raw
+        little-endian buffers instead of Arrow IPC — the op that makes a
+        from-scratch client (no Arrow library) ~100 lines in any
+        language (docs/protocol.md; examples/cpp_client)."""
+        arrays: Dict[str, np.ndarray] = {"x": np.asarray(x)}
+        if y is not None:
+            arrays["y"] = np.asarray(y).reshape(-1)
+        resp = self._send_arrays_op(
+            {
+                "op": "feed_raw",
+                "job": job,
+                "algo": algo,
+                "n_cols": n_cols,
+                "params": params or {},
+                "partition": partition,
+                "attempt": attempt,
+                "pass_id": pass_id,
+            },
+            arrays,
+        )
+        return int(resp["rows"])
+
     def commit(
         self, job: str, partition: int, attempt: int = 0,
         pass_id: Optional[int] = None,

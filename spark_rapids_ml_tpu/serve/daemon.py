@@ -44,6 +44,7 @@ the reference for free).
 from __future__ import annotations
 
 import hmac
+import os
 import socket
 import threading
 import time
@@ -61,11 +62,40 @@ from spark_rapids_ml_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.daemon")
 
+#: Device-build cap for daemon-side IVF (bytes of raw f32 rows): past
+#: this, the full (n, d) matrix would not fit one chip's HBM alongside
+#: the build's working set, so the host build + shard-direct placement
+#: path runs instead (docs/ann-capacity.md).
+_IVF_DEVICE_BUILD_MAX_BYTES = int(
+    os.environ.get("SRML_IVF_DEVICE_BUILD_MAX", 4 << 30)
+)
+
 #: Ops whose request JSON is followed by one Arrow-IPC payload frame
 #: (docs/protocol.md). Rejection paths must drain that frame to keep the
 #: connection framing aligned. (``ensure_model`` instead carries raw
 #: array frames per its request's ``arrays`` spec — see _drain_payload.)
 _PAYLOAD_OPS = ("feed", "seed", "transform", "kneighbors")
+
+
+def _recv_arrays_aligned(conn, req: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Receive a request's raw array frames with framing-safe parsing:
+    ALL declared frames are drained off the socket before any dtype/shape
+    validation runs, so a bad spec (wrong byte count, bogus dtype — easy
+    for the from-scratch clients feed_raw invites) errors cleanly and the
+    connection stays usable, instead of leaving unread frames that desync
+    every subsequent request's length header."""
+    specs = req.get("arrays") or []
+    frames = []
+    for _ in specs:
+        frame = protocol.recv_frame(conn)
+        if frame is None:
+            raise protocol.ProtocolError("connection closed mid-array")
+        frames.append(frame)
+    out: Dict[str, np.ndarray] = {}
+    for spec, frame in zip(specs, frames):
+        arr = np.frombuffer(frame, dtype=np.dtype(spec["dtype"]))
+        out[str(spec["name"])] = arr.reshape(spec["shape"]).copy()
+    return out
 
 
 def _opt(req: Dict[str, Any], key: str, default):
@@ -517,16 +547,30 @@ class _Job:
                     )
                 self.centers = jnp.asarray(c, self._accum)
             elif self.algo == "logreg":
+                # Full shape validation at the op boundary (like the
+                # kmeans branch): a mis-shaped iterate installed here
+                # would otherwise crash opaquely inside the next feed's
+                # jitted update.
                 w = np.asarray(arrays["w"])
-                if w.shape[0] != self.n_cols:
+                b = np.asarray(arrays["b"]).reshape(-1)
+                n_classes = getattr(self, "n_classes", 2)
+                want_w = (
+                    (self.n_cols, n_classes) if n_classes > 2 else (self.n_cols,)
+                )
+                want_b = n_classes if n_classes > 2 else 1
+                if tuple(w.shape) != want_w:
                     raise ValueError(
-                        f"coefficients shape {w.shape} != n_cols {self.n_cols}"
+                        f"coefficients shape {tuple(w.shape)} != {want_w} "
+                        f"(n_cols={self.n_cols}, n_classes={n_classes})"
+                    )
+                if b.shape[0] != want_b:
+                    raise ValueError(
+                        f"intercept length {b.shape[0]} != {want_b} "
+                        f"(n_classes={n_classes})"
                     )
                 self.w = jnp.asarray(w, self._accum)
-                b = np.asarray(arrays["b"])
                 self.b = jnp.asarray(
-                    b.reshape(-1) if getattr(self, "n_classes", 2) > 2 else b.reshape(()),
-                    self._accum,
+                    b if n_classes > 2 else b.reshape(()), self._accum
                 )
             else:
                 raise ValueError(
@@ -651,6 +695,7 @@ class _Job:
                 from spark_rapids_ml_tpu.models.knn import (
                     ApproximateNearestNeighborsModel,
                     _normalized_rows,
+                    build_ivf_flat,
                     build_ivf_flat_device,
                 )
 
@@ -665,17 +710,44 @@ class _Job:
                     # normalizes queries into the query slot.
                     rows = _normalized_rows(rows, zero_slot=0)
                 nlist = int(params["nlist"])
-                index = build_ivf_flat_device(
-                    jnp.asarray(rows), nlist=nlist,
-                    seed=int(params.get("seed") or 0),
-                )
+                seed = int(params.get("seed") or 0)
+                # Build-path choice (docs/ann-capacity.md): the device
+                # build materializes the FULL (n, d) matrix on one chip —
+                # fast, but capped by single-chip HBM. Past the cap
+                # (config #5: 10M×768 f32 ≈ 31 GB vs 16 GB/chip) the host
+                # build buckets in host RAM (quantizer still trains on a
+                # device-sized sample) and no full copy ever lands on one
+                # device: shard_index below placements each list shard
+                # straight onto its own chip.
+                build = str(params.get("build") or "auto")
+                device_ok = rows.nbytes <= _IVF_DEVICE_BUILD_MAX_BYTES
+                if build == "device" or (build == "auto" and device_ok):
+                    index = build_ivf_flat_device(
+                        jnp.asarray(rows), nlist=nlist, seed=seed
+                    )
+                elif build in ("host", "auto"):
+                    index = build_ivf_flat(rows, nlist=nlist, seed=seed,
+                                           mesh=self.mesh)
+                else:
+                    raise ValueError(
+                        f"unknown build {build!r} (auto|device|host)"
+                    )
                 model = ApproximateNearestNeighborsModel(index=index)
                 model._set(metric=metric)
                 model._index_metric = metric
                 if params.get("nprobe"):
                     model._set(nprobe=int(params["nprobe"]))
+                # Databases ≫ one chip's HBM serve from the whole mesh:
+                # the inverted lists shard over the data axis and queries
+                # run the sharded bucketed executor with an O(q·k·devices)
+                # all_gather merge (BASELINE config #5's capacity path).
+                if self.mesh.shape[DATA_AXIS] > 1:
+                    model.shard_index(self.mesh)
                 info["nlist"] = np.asarray([nlist], np.int64)
                 info["maxlen"] = np.asarray([index.lists.shape[1]], np.int64)
+                info["sharded"] = np.asarray(
+                    [1 if model._shard_mesh is not None else 0], np.int64
+                )
             elif mode == "exact":
                 from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
 
@@ -1030,7 +1102,8 @@ class DataPlaneDaemon:
             # flight when the JSON header is rejected.
             if op in _PAYLOAD_OPS:
                 protocol.recv_frame(conn)
-            elif op in ("ensure_model", "merge_state", "set_iterate"):
+            elif op in ("ensure_model", "merge_state", "set_iterate",
+                        "feed_raw"):
                 for _ in req.get("arrays") or []:
                     protocol.recv_frame(conn)
 
@@ -1053,6 +1126,8 @@ class DataPlaneDaemon:
             )
         if op == "feed":
             self._op_feed(conn, req)
+        elif op == "feed_raw":
+            self._op_feed_raw(conn, req)
         elif op == "seed":
             self._op_seed(conn, req)
         elif op == "commit":
@@ -1092,7 +1167,7 @@ class DataPlaneDaemon:
             arrays, meta = job.get_iterate()
             protocol.send_arrays(conn, arrays, {"ok": True, **meta})
         elif op == "set_iterate":
-            arrays = protocol.recv_arrays(conn, req)
+            arrays = _recv_arrays_aligned(conn, req)
             job = self._get_job(req)
             job.set_iterate(arrays, int(req["iteration"]))
             protocol.send_json(conn, {"ok": True})
@@ -1140,22 +1215,57 @@ class DataPlaneDaemon:
             raise protocol.ProtocolError("connection closed before feed payload")
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
-        name = str(req["job"])
         input_col = _opt(req, "input_col", "features")
         x = table_column_to_matrix(table, input_col, req.get("n_cols"))
-        req_algo = str(_opt(req, "algo", "pca"))
-        # Single parse shared by label validation and the job-mismatch
-        # guard below, so the two can't disagree on the coercion rule.
-        req_classes = int((req.get("params") or {}).get("n_classes") or 2)
-        # Validate the batch BEFORE registering a job, so a rejected first
-        # feed doesn't leave an orphan empty job (with its d×d device
-        # buffers) parked under the name forever.
         y = None
-        if req_algo in ("linreg", "logreg"):
+        if str(_opt(req, "algo", "pca")) in ("linreg", "logreg"):
             label_col = _opt(req, "label_col", "label")
             if label_col not in table.column_names:
                 raise KeyError(f"label column {label_col!r} not in batch")
             y = np.asarray(table.column(label_col).to_numpy(zero_copy_only=False))
+        self._feed_validated(conn, req, x, y)
+
+    def _op_feed_raw(self, conn, req: Dict[str, Any]) -> None:
+        """`feed` semantics with a dependency-free payload: raw
+        little-endian C-contiguous buffers (the response framing turned
+        around) instead of an Arrow IPC stream — what makes a from-scratch
+        client in any language ~100 lines (examples/cpp_client). Arrays:
+        `x` (n, d) float32/float64 (required), `y` (n,) (linreg/logreg)."""
+        arrays = _recv_arrays_aligned(conn, req)
+        if "x" not in arrays:
+            raise ValueError("feed_raw needs an 'x' array in the request spec")
+        x = np.asarray(arrays["x"])
+        if x.ndim != 2:
+            raise ValueError(f"feed_raw 'x' must be 2-D, got shape {x.shape}")
+        if x.dtype not in (np.float32, np.float64):
+            raise ValueError(f"feed_raw 'x' must be float32/float64, got {x.dtype}")
+        n_cols = req.get("n_cols")
+        if n_cols is not None and int(n_cols) != x.shape[1]:
+            raise ValueError(
+                f"feed_raw 'x' width {x.shape[1]} != declared n_cols {n_cols}"
+            )
+        y = arrays.get("y")
+        if y is not None:
+            y = np.asarray(y).reshape(-1)
+            if y.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"feed_raw 'y' length {y.shape[0]} != rows {x.shape[0]}"
+                )
+        self._feed_validated(conn, req, x, y)
+
+    def _feed_validated(self, conn, req: Dict[str, Any], x, y) -> None:
+        """Shared feed tail (Arrow and raw payloads land here): validate
+        the batch BEFORE registering a job — a rejected first feed must
+        not leave an orphan empty job (with its d×d device buffers)
+        parked under the name forever."""
+        name = str(req["job"])
+        req_algo = str(_opt(req, "algo", "pca"))
+        # Single parse shared by label validation and the job-mismatch
+        # guard below, so the two can't disagree on the coercion rule.
+        req_classes = int((req.get("params") or {}).get("n_classes") or 2)
+        if req_algo in ("linreg", "logreg"):
+            if y is None:
+                raise ValueError(f"{req_algo} feed needs a label array")
             if req_algo == "logreg":
                 if req_classes > 2:
                     from spark_rapids_ml_tpu.models.logistic_regression import (
@@ -1243,7 +1353,7 @@ class DataPlaneDaemon:
         carries ``algo``/``n_cols``/``params`` like a first feed), so a
         driver can merge into a fresh primary even when every row was fed
         elsewhere. ``rows`` is the exporter's committed contribution."""
-        arrays = protocol.recv_arrays(conn, req)
+        arrays = _recv_arrays_aligned(conn, req)
         name = str(req["job"])
         req_algo = str(_opt(req, "algo", "pca"))
         contrib = int(_opt(req, "rows", 0))
@@ -1282,7 +1392,7 @@ class DataPlaneDaemon:
         JSON carries the ``arrays`` spec; raw array frames follow — the
         same framing finalize uses in the response direction. First caller
         wins; concurrent registrations under one name are deduplicated."""
-        arrays = protocol.recv_arrays(conn, req)
+        arrays = _recv_arrays_aligned(conn, req)
         name = str(req["model"])
         algo = str(req["algo"])
         params = _opt(req, "params", {})

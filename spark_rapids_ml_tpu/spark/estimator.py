@@ -91,10 +91,12 @@ def _df_to_arrow(df, columns):
     return pa.Table.from_pandas(pdf, preserve_index=False)
 
 
-# Executor-side cache: daemon instance id per (host, port). The id is
-# constant for a daemon's lifetime, and a daemon restart mid-fit fails
-# the fit anyway (its jobs vanish) — so one ping per executor process,
-# not one per task per pass.
+# Executor-side cache: daemon instance id per (fit job, host, port).
+# Scoping by JOB makes the cache safe under Spark python-worker reuse:
+# a daemon restarted BETWEEN fits gets a fresh ping on the next fit
+# (a stale id would make the driver treat the same daemon as a peer and
+# fail spuriously), while within one fit — where a restart loses the
+# job state and fails the fit anyway — passes and tasks share one ping.
 _DAEMON_ID_CACHE: dict = {}
 
 
@@ -129,10 +131,12 @@ class _FeedTask:
             # The daemon's self-reported identity: the driver keys its
             # merge/reconcile on this, never on the address spelling (an
             # alias of the primary must not look like a peer).
-            daemon_id = _DAEMON_ID_CACHE.get((h, p))
+            daemon_id = _DAEMON_ID_CACHE.get((self.job, h, p))
             if daemon_id is None:
                 daemon_id = c.server_id() or f"{h}:{p}"
-                _DAEMON_ID_CACHE[(h, p)] = daemon_id
+                if len(_DAEMON_ID_CACHE) > 256:  # bound worker-reuse growth
+                    _DAEMON_ID_CACHE.clear()
+                _DAEMON_ID_CACHE[(self.job, h, p)] = daemon_id
             for batch in batches:
                 if batch.num_rows == 0:
                     continue
@@ -519,16 +523,24 @@ class _SparkAdapter:
                 )
                 for ph, pp in daemon_session.resolve_all(spark):
                     pc = DataPlaneClient(ph, pp, token=token)
-                    pid_ = pc.server_id() or f"{ph}:{pp}"
-                    if pid_ == primary_id or pid_ in peers:
-                        pc.close()  # an alias of a daemon already seeded
-                        continue
-                    peers[pid_] = (ph, pp)
-                    peer_clients[pid_] = pc
-                    pc.seed_kmeans(
-                        job, seed_tbl, k=k, input_col=input_col,
-                        params=feed_params,
-                    )
+                    registered = False
+                    try:
+                        pid_ = pc.server_id() or f"{ph}:{pp}"
+                        if pid_ == primary_id or pid_ in peers:
+                            continue  # an alias of a daemon already seeded
+                        peers[pid_] = (ph, pp)
+                        peer_clients[pid_] = pc
+                        registered = True
+                        pc.seed_kmeans(
+                            job, seed_tbl, k=k, input_col=input_col,
+                            params=feed_params,
+                        )
+                    finally:
+                        # registered clients are closed by the outer
+                        # finally; everything else closes here (incl. on
+                        # an unreachable/unauthorized peer)
+                        if not registered:
+                            pc.close()
 
             def run_pass(pass_id, merge=True, drop_peer=False):
                 """One executor scan; folds peer-daemon partials into the
@@ -551,6 +563,25 @@ class _SparkAdapter:
                     # without ever creating the job there — set_iterate
                     # against it would fail an otherwise-consistent fit.
                     if cnt > 0 and did != primary_id and did not in peers:
+                        # Instance ids are opaque hex; a ":" means the
+                        # address-string FALLBACK for a daemon that does
+                        # not report an id — such a daemon predates the
+                        # multi-host ops entirely, and an aliased
+                        # spelling of the primary would masquerade as a
+                        # peer. Refuse clearly instead of failing later
+                        # with an opaque unknown-op error (or worse,
+                        # merging the primary into itself).
+                        if ":" in did or ":" in primary_id:
+                            raise RuntimeError(
+                                f"task acks name a second daemon "
+                                f"({addr_of[did]} vs primary "
+                                f"{addr_by_id[primary_id]}) but at least "
+                                "one daemon does not report an instance "
+                                "id — it predates the multi-host data "
+                                "plane. Upgrade every daemon, or unify "
+                                "the daemon address spelling and use one "
+                                "daemon."
+                            )
                         peers[did] = daemon_session._parse_addr(addr_of[did])
                 if merge:
                     _merge_peer_daemons(

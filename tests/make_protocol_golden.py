@@ -163,6 +163,10 @@ def main() -> None:
     with open(FIXTURE_SERVING, "wb") as f:
         f.write(data_s)
     print(f"wrote {FIXTURE_SERVING}: {len(data_s)} bytes, {len(expect_s)} requests")
+    data_m, expect_m = multihost_transcript()
+    with open(FIXTURE_MULTIHOST, "wb") as f:
+        f.write(data_m)
+    print(f"wrote {FIXTURE_MULTIHOST}: {len(data_m)} bytes, {len(expect_m)} requests")
 
 
 
@@ -267,6 +271,116 @@ def serving_transcript_frames() -> tuple[list, list]:
 def serving_transcript() -> tuple[bytes, list]:
     frames, expect = serving_transcript_frames()
     return b"".join(frame_bytes(p) for _, p in frames), expect
+
+
+# ---------------------------------------------------------------------------
+# v1 multi-host transcript (additive ops: feed_raw / export_state /
+# get_iterate / set_iterate). merge_state is deliberately NOT in a frozen
+# fixture: its payload is an export_state round-trip whose array layout is
+# documented as OPAQUE daemon-to-daemon state — freezing fabricated bytes
+# would promote the internal state layout into the wire contract. Its
+# conformance lives in live tests (tests/test_spark_multidaemon.py).
+# ---------------------------------------------------------------------------
+
+FIXTURE_MULTIHOST = os.path.join(
+    os.path.dirname(__file__), "fixtures", "protocol_v1_multihost.bin"
+)
+
+
+def multihost_transcript_frames() -> tuple[list, list]:
+    x = golden_matrix()
+    frames: list = []
+    expect = []
+
+    def _req(obj: dict, payloads=()) -> None:
+        frames.append(("json", json.dumps(obj).encode()))
+        frames.extend(payloads)
+
+    def _raw_spec(arrays: dict) -> tuple[list, list]:
+        spec = [
+            {"name": k, "dtype": str(np.asarray(v).dtype),
+             "shape": list(np.asarray(v).shape)}
+            for k, v in arrays.items()
+        ]
+        bufs = [("raw", np.ascontiguousarray(v).tobytes())
+                for v in arrays.values()]
+        return spec, bufs
+
+    # 1. feed_raw eager: raw float64 buffer instead of Arrow IPC
+    spec, bufs = _raw_spec({"x": x})
+    _req({"v": V, "op": "feed_raw", "job": "g-raw", "algo": "pca",
+          "n_cols": 3, "params": {}, "partition": None, "attempt": 0,
+          "pass_id": None, "arrays": spec}, bufs)
+    expect.append(("json", {"ok": True, "rows": 8}))
+
+    # 2-5. feed_raw through the exactly-once partition/commit path
+    for pid, part, rows_after in ((0, x[:4], 4), (1, x[4:], 8)):
+        spec, bufs = _raw_spec({"x": part})
+        _req({"v": V, "op": "feed_raw", "job": "g-raw2", "algo": "pca",
+              "n_cols": 3, "params": {}, "partition": pid, "attempt": 0,
+              "pass_id": None, "arrays": spec}, bufs)
+        expect.append(("json", {"ok": True}))
+        _req({"v": V, "op": "commit", "job": "g-raw2",
+              "partition": pid, "attempt": 0, "pass_id": None})
+        expect.append(("json", {"ok": True, "rows": rows_after}))
+
+    # 6. export_state: committed partials + accounting meta (arrays are
+    # opaque state — the replay checks framing + meta, not layout)
+    _req({"v": V, "op": "export_state", "job": "g-raw2"})
+    expect.append(("arrays", {"ok": True, "rows": 8, "pass_rows": 8,
+                              "iteration": 0, "algo": "pca", "n_cols": 3}))
+
+    # 7-8. finalize both jobs — feed_raw and Arrow-fed data are the same
+    # bytes, so the replay asserts the two models are identical
+    for job in ("g-raw", "g-raw2"):
+        _req({"v": V, "op": "finalize", "job": job,
+              "params": {"k": 2, "mean_center": True}, "drop": True})
+        expect.append(("arrays", {"ok": True, "rows": 8}))
+
+    # 9. feed_raw with labels (linreg): x + y arrays
+    y = (x @ np.asarray([1.0, -2.0, 3.0])) + 0.5
+    spec, bufs = _raw_spec({"x": x, "y": y})
+    _req({"v": V, "op": "feed_raw", "job": "g-rawlr", "algo": "linreg",
+          "n_cols": 3, "params": {}, "partition": None, "attempt": 0,
+          "pass_id": None, "arrays": spec}, bufs)
+    expect.append(("json", {"ok": True, "rows": 8}))
+    _req({"v": V, "op": "finalize", "job": "g-rawlr",
+          "params": {"reg": 0.0, "fit_intercept": True}, "drop": True})
+    expect.append(("arrays", {"ok": True, "rows": 8}))
+
+    # 10-14. iterate sync ops on a kmeans job: seed → feed → step →
+    # get_iterate → set_iterate (fixed centers; resets pass stats)
+    _req({"v": V, "op": "seed", "job": "g-mkm", "input_col": "features",
+          "n_cols": None, "params": {"k": 2, "seed": 7, "init": "k-means++"}},
+         [("arrow", _ipc_bytes(x))])
+    expect.append(("json", {"ok": True, "rows": 0}))
+    spec, bufs = _raw_spec({"x": x})
+    _req({"v": V, "op": "feed_raw", "job": "g-mkm", "algo": "kmeans",
+          "n_cols": 3, "params": {"k": 2, "seed": 7, "init": "k-means++"},
+          "partition": 0, "attempt": 0, "pass_id": 0, "arrays": spec}, bufs)
+    expect.append(("json", {"ok": True}))
+    _req({"v": V, "op": "commit", "job": "g-mkm",
+          "partition": 0, "attempt": 0, "pass_id": 0})
+    expect.append(("json", {"ok": True, "rows": 8}))
+    _req({"v": V, "op": "step", "job": "g-mkm", "params": {}})
+    expect.append(("json", {"ok": True, "iteration": 1}))
+    _req({"v": V, "op": "get_iterate", "job": "g-mkm"})
+    expect.append(("arrays", {"ok": True, "iteration": 1}))
+    centers = np.asarray([[0.0, 0.0, 0.0], [1.0, 1.0, 1.0]], np.float64)
+    spec, bufs = _raw_spec({"centers": centers})
+    _req({"v": V, "op": "set_iterate", "job": "g-mkm", "iteration": 2,
+          "arrays": spec}, bufs)
+    expect.append(("json", {"ok": True}))
+    _req({"v": V, "op": "drop", "job": "g-mkm"})
+    expect.append(("json", {"ok": True, "dropped": True}))
+
+    return frames, expect
+
+
+def multihost_transcript() -> tuple[bytes, list]:
+    frames, expect = multihost_transcript_frames()
+    return b"".join(frame_bytes(p) for _, p in frames), expect
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,81 @@
+"""Doc-sufficiency test: the from-scratch C++ client (built ONLY from
+docs/protocol.md — no Arrow, no JSON library) must interoperate with a
+live daemon: ping handshake, feed_raw through the exactly-once
+partition/commit path, PCA finalize, and numerically-correct results.
+
+If this fails after a protocol change, the spec and the implementation
+drifted — the frozen-contract promise broke for every third-party
+client (the JVM interop story rides on exactly this, README "Scope").
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+
+SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples", "cpp_client", "minimal_client.cpp",
+)
+
+
+def _lcg_matrix(n, d):
+    """The client's Numerical Recipes LCG, mirrored exactly: integer
+    values in [-8, 8] so every statistic is f32-exact."""
+    out = np.empty(n * d, dtype=np.float64)
+    state = 12345
+    for i in range(n * d):
+        state = (state * 1664525 + 1013904223) & 0xFFFFFFFF
+        out[i] = float(((state >> 16) % 17) - 8)
+    return out.reshape(n, d)
+
+
+@pytest.fixture(scope="module")
+def client_bin(tmp_path_factory):
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ on this host")
+    exe = str(tmp_path_factory.mktemp("cppclient") / "minimal_client")
+    subprocess.run([gxx, "-O2", "-o", exe, SRC], check=True)
+    return exe
+
+
+def test_cpp_client_full_session(client_bin, mesh8):
+    n, d, k = 512, 8, 2
+    with DataPlaneDaemon(mesh=mesh8) as daemon:
+        host, port = daemon.address
+        out = subprocess.run(
+            [client_bin, host, str(port), str(n), str(d), str(k)],
+            capture_output=True, text=True, timeout=300, check=True,
+        ).stdout
+    lines = out.strip().splitlines()
+    assert lines[0] == "ping ok v=1"
+    assert lines[1] == f"rows {n}"
+    arrays = {}
+    for line in lines[2:]:
+        assert line.startswith("array ")
+        head, vals = line.split(" :", 1)
+        parts = head.split()
+        name, shape = parts[1], tuple(int(s) for s in parts[2:])
+        arrays[name] = np.fromstring(vals, sep=" ").reshape(shape)
+    assert set(arrays) == {"pc", "explained_variance", "sigma", "mean"}
+    assert arrays["pc"].shape == (d, k)
+
+    x = _lcg_matrix(n, d)
+    np.testing.assert_allclose(arrays["mean"], x.mean(axis=0), atol=1e-9)
+    xc = x - x.mean(axis=0)
+    evals, evecs = np.linalg.eigh(xc.T @ xc / (n - 1))
+    order = np.argsort(evals)[::-1]
+    np.testing.assert_allclose(
+        np.abs(arrays["pc"]), np.abs(evecs[:, order[:k]]), atol=1e-8
+    )
+    # Reference semantics (rapidsml_jni.cu:254 seqRoot): the ratio
+    # normalizes the SQUARE ROOTS of the eigenvalues, σᵢ/Σσ.
+    s = np.sqrt(np.clip(evals[order], 0, None))
+    np.testing.assert_allclose(
+        arrays["explained_variance"], s[:k] / s.sum(), atol=1e-8
+    )

@@ -165,7 +165,10 @@ def test_ping_is_version_exempt_and_echoes_version(daemon):
     try:
         protocol.send_json(sock, {"op": "ping"})  # no v at all
         resp = protocol.recv_json(sock)
-        assert resp == {"ok": True, "v": protocol.PROTOCOL_VERSION}
+        # subset check: response fields are additive under v1 (clients
+        # must ignore unknown fields — e.g. the instance "id")
+        assert resp is not None
+        assert resp["ok"] is True and resp["v"] == protocol.PROTOCOL_VERSION
     finally:
         sock.close()
 
@@ -225,6 +228,84 @@ def test_replay_serving_transcript(daemon):
     # daemon-built exact index: self is nearest, partition-major ids
     np.testing.assert_array_equal(knn_query["indices"][:, 0], [0, 1, 2])
     np.testing.assert_allclose(knn_query["distances"][:, 0], 0.0, atol=1e-3)
+
+
+def test_replay_multihost_transcript(daemon):
+    """Replay the frozen multi-host-ops byte transcript (feed_raw /
+    export_state / get_iterate / set_iterate) and assert every response.
+    Numeric conformance: feed_raw-fed bytes ARE the Arrow-fed bytes, so
+    the raw-fed and partitioned-raw-fed PCA finalizes must be identical,
+    and the linreg finalize must recover the planted coefficients."""
+    from tests.make_protocol_golden import (
+        FIXTURE_MULTIHOST,
+        multihost_transcript,
+    )
+
+    assert os.path.exists(FIXTURE_MULTIHOST), (
+        "tests/fixtures/protocol_v1_multihost.bin must be committed"
+    )
+    with open(FIXTURE_MULTIHOST, "rb") as f:
+        stream = f.read()
+    _, expect = multihost_transcript()
+
+    sock = socket.create_connection(daemon.address, timeout=120)
+    try:
+        sock.sendall(stream)
+        results = []
+        for kind, checks in expect:
+            resp = protocol.recv_json(sock)
+            assert resp is not None, "daemon closed mid-transcript"
+            for key, want in checks.items():
+                assert resp.get(key) == want, (
+                    f"response {resp} missing/mismatched {key}={want!r}"
+                )
+            if kind == "arrays":
+                results.append(protocol.recv_arrays(sock, resp))
+    finally:
+        sock.close()
+
+    export, pca_raw, pca_raw2, linreg, iterate = results
+    assert export, "export_state returned no state arrays"
+    np.testing.assert_allclose(pca_raw["pc"], pca_raw2["pc"], atol=1e-12)
+    np.testing.assert_allclose(
+        linreg["coefficients"], [1.0, -2.0, 3.0], atol=1e-6
+    )
+    np.testing.assert_allclose(float(linreg["intercept"][0]), 0.5, atol=1e-6)
+    assert iterate["centers"].shape == (2, 3)
+
+
+def test_multihost_generator_matches_committed_fixture():
+    """Frame-by-frame drift check for the multihost transcript."""
+    import io
+    import json as _json
+    import struct
+
+    import pyarrow as pa
+
+    from tests.make_protocol_golden import (
+        FIXTURE_MULTIHOST,
+        multihost_transcript_frames,
+    )
+
+    frames, _ = multihost_transcript_frames()
+    with open(FIXTURE_MULTIHOST, "rb") as f:
+        committed = f.read()
+    stream = io.BytesIO(committed)
+    for kind, generated in frames:
+        header = stream.read(4)
+        (n,) = struct.unpack(">I", header)
+        recorded = stream.read(n)
+        if kind == "json":
+            assert _json.loads(generated) == _json.loads(recorded)
+        elif kind == "arrow":
+            with pa.ipc.open_stream(generated) as r:
+                gen_t = r.read_all()
+            with pa.ipc.open_stream(recorded) as r:
+                rec_t = r.read_all()
+            assert gen_t.equals(rec_t)
+        else:
+            assert generated == recorded
+    assert stream.read() == b"", "fixture has extra frames"
 
 
 def test_serving_generator_matches_committed_fixture():

