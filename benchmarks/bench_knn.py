@@ -114,14 +114,14 @@ def main() -> None:
     reps = int(os.environ.get("SRML_BENCH_REPS", 8))
 
     def measure(rerank: bool, slack: float = SLACK, nprobe: int = NPROBE,
-                rerank_width: int = 0):
+                rerank_width: int = 0, extract: str = "wide"):
         """(q/s, recall@10) at one operating point — BOTH points are
         emitted every run (r2 review: the default config ships
         rerank=on, the headline ran rerank=off; report both always)."""
         query = _ivf_query_fn(
             K, nprobe, "bfloat16", "float32", rerank=rerank, slack=slack,
             fused=str(config.get("ann_fused_scan")),
-            rerank_width=rerank_width,
+            rerank_width=rerank_width, extract=extract,
         )
         ids0 = np.asarray(
             query(*dev, queries, resid_norms=norms, lists_lo=lists_lo)[1]
@@ -187,6 +187,9 @@ def main() -> None:
 
     qps_off, recall_off = measure(rerank=False)
     qps_on, recall_on = measure(rerank=True)
+    # Third point: rerank with NARROW kernel extraction (config
+    # ann_extract) — the round-4 speed/recall dial between the two.
+    qps_nar, recall_nar = measure(rerank=True, extract="narrow")
     emit(
         f"ivfflat_queries_per_sec_per_chip_n{N_BASE}_d{D}"
         f"_k{K}_nprobe{NPROBE}_clustered",
@@ -197,6 +200,8 @@ def main() -> None:
         rerank_on_qps=round(qps_on, 1),
         rerank_on_recall=round(recall_on, 4),
         rerank_on_vs_baseline=round(qps_on / A100_QUERIES_PER_SEC, 4),
+        rerank_narrow_qps=round(qps_nar, 1),
+        rerank_narrow_recall=round(recall_nar, 4),
     )
 
 

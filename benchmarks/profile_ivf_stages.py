@@ -76,6 +76,7 @@ def main() -> None:
         ("scan_nosel", dict(rerank=False, _debug_stage="scan_nosel")),
         ("scan", dict(rerank=False, _debug_stage="scan")),
         ("full_norerank", dict(rerank=False)),
+        ("rerank_norescore", dict(rerank=True, _debug_stage="rerank_norescore")),
         ("full_rerank", dict(rerank=True)),
     ]
     fns = {
@@ -113,6 +114,9 @@ def main() -> None:
         "sel_in_scan_ms": round(med["scan"] - med["scan_nosel"], 3),
         "select_minus_scan_ms": round(med["full_norerank"] - med["scan"], 3),
         "rerank_extra_ms": round(med["full_rerank"] - med["full_norerank"], 3),
+        "rescore_in_graph_ms": round(
+            med["full_rerank"] - med["rerank_norescore"], 3
+        ),
     }
     print(json.dumps(out))
 

@@ -119,6 +119,13 @@ _DEFAULTS: Dict[str, Any] = {
     # the same-run width sweep measured identical recall at half the
     # width; benchmarks/README.md).
     "ann_rerank_width": _env("ANN_RERANK_WIDTH", 0, int),
+    # Fused-kernel per-(list, slot) extraction width under rerank:
+    # "wide" (default) extracts shortlist_mult·k so the exact rerank can
+    # rescue within-(list, slot) bf16 boundary misses; "narrow" extracts
+    # k — the extraction cost scales with the width, measured 151k → 177k
+    # q/s for recall@10 0.9706 → 0.9577 at the bench point (rerank-off
+    # configs always extract k; benchmarks/README.md round-4 frontier).
+    "ann_extract": _env("ANN_EXTRACT", "wide", str),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

@@ -727,7 +727,7 @@ def _bucketed_core(
     n_valid, k: int, nprobe: int, C: int, compute_dtype, accum_dtype,
     list_block: int = 16, shortlist_mult: int = 2, rerank: bool = True,
     *, lists_lo, centroids, fused: str = "auto", rerank_width: int = 0,
-    _debug_stage=None,
+    extract: str = "wide", _debug_stage=None,
 ):
     """The capacity-bucketed scorer over ONE device's lists.
 
@@ -871,7 +871,7 @@ def _bucketed_core(
     # The kernel computes and emits f32 scores: float64 accum configs
     # (supported by the XLA path) must not silently lose precision.
     f32_ok = jnp.dtype(accum_dtype) != jnp.float64
-    use_fused = _debug_stage is None and (
+    use_fused = _debug_stage in (None, "rerank_norescore") and (
         (fused == "on" and f32_ok)
         or (
             fused == "auto"
@@ -886,7 +886,18 @@ def _bucketed_core(
     # the gather-back pool. The rerank path keeps the mult·k width — its
     # slack absorbs bf16 score-vs-f32-rank mismatch, which exactness of
     # the *selection* cannot remove.
-    blk_k = min(k if (use_fused and not rerank) else shortlist_mult * k, maxlen)
+    # Extraction width is the rerank-on speed/recall dial (round-4 stage
+    # profile: the fused kernel's per-slot extraction cost scales with
+    # blk_k, and the mult·k width is ~4 ms of the rerank-on query at the
+    # bench shape). "narrow" extracts k per (list, slot) even under
+    # rerank — measured 151k → 177k q/s for recall@10 0.9706 → 0.9577 at
+    # the bench point (within-(list, slot) boundary misses the rerank
+    # can then no longer rescue) — config ann_extract.
+    narrow = str(extract).lower() == "narrow"
+    blk_k = min(
+        k if (use_fused and (not rerank or narrow)) else shortlist_mult * k,
+        maxlen,
+    )
     if nprobe * blk_k < k:
         raise ValueError(
             f"k={k} exceeds the bucketed candidate pool nprobe*maxlen="
@@ -1053,9 +1064,25 @@ def _bucketed_core(
     wl = jnp.take_along_axis(cand_list, posR, axis=1)  # (q, R)
     wp = jnp.take_along_axis(cand_pos, posR, axis=1)
     ids_R = ids_p[wl, wp]  # (q, R); -1 for padded-row candidates
-    rows_R = lists_p[wl, wp].astype(accum_dtype)  # (q, R, d)
-    diff = rows_R - queries.astype(accum_dtype)[:, None, :]
-    exact_d = jnp.sum(diff * diff, axis=2)  # (q, R) — direct, exact f32
+    # (Round-4 negative result: rescoring from the bf16 residual
+    # reconstruction c + r̃ — dropping the raw f32 lists from the graph —
+    # measured BOTH slower (141 vs 151k q/s: two gathers + extra
+    # elementwise beat one f32 row gather, which is cheap) and lower
+    # recall (0.9653 vs 0.9706). The f32 row gather stays.)
+    if _debug_stage == "rerank_norescore":
+        # Profiling cut: R-selection + id resolution live, the (q, R, d)
+        # row gather + exact rescore dropped — isolates the rescore's
+        # IN-GRAPH cost (standalone it measures ~0.02 ms).
+        exact_d = jnp.where(ids_R < 0, jnp.inf, -negR)
+    else:
+        # Flat single-level row gather: the 2-level [wl, wp] batched
+        # gather lowers poorly inside the full query graph (measured
+        # ~2.9 ms in-graph vs 0.02 ms standalone); flattening to one
+        # row-index into the (nlist·maxlen, d) view gives XLA the simple
+        # leading-axis row-gather emitter.
+        rows_R = lists_p.reshape(-1, d)[wl * maxlen + wp].astype(accum_dtype)
+        diff = rows_R - queries.astype(accum_dtype)[:, None, :]
+        exact_d = jnp.sum(diff * diff, axis=2)  # (q, R) — direct, exact f32
     exact_d = jnp.where((ids_R < 0) | jnp.isinf(-negR), jnp.inf, exact_d)
     neg, pos = jax.lax.top_k(-exact_d, k)
     win_ids = jnp.where(jnp.isinf(neg), -1, jnp.take_along_axis(ids_R, pos, axis=1))
@@ -1103,7 +1130,8 @@ def _residual_index_data(lists, centroids, compute_dtype, chunk: int = 64):
 def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
                   slack: float = 1.5, shortlist_mult: int = 2,
                   rerank: bool = True, fused: str = "auto",
-                  rerank_width: int = 0, _debug_stage=None):
+                  rerank_width: int = 0, extract: str = "wide",
+                  _debug_stage=None):
     """Build the jitted IVF query executor.
 
     Two TPU execution strategies, both avoiding the GPU-idiomatic per-query
@@ -1268,7 +1296,8 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             list_block=16, shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=centroids, fused=fused,
-            rerank_width=rerank_width, _debug_stage=_debug_stage,
+            rerank_width=rerank_width, extract=extract,
+            _debug_stage=_debug_stage,
         )
 
     @jax.jit
@@ -1337,6 +1366,7 @@ def _ivf_query_fn_sharded(
     k: int, nprobe: int, cd: str, ad: str, mesh: Mesh, slack: float = 1.5,
     shortlist_mult: int = 2,
     rerank: bool = True, fused: str = "auto", rerank_width: int = 0,
+    extract: str = "wide",
 ):
     """Sharded IVF query: inverted lists sharded over the ``data`` mesh
     axis (BASELINE.json config #5's multi-host shape — a 10M×768 database
@@ -1387,7 +1417,7 @@ def _ivf_query_fn_sharded(
             resid_norms, n_valid, k, nprobe, C, compute_dtype, accum_dtype,
             shortlist_mult=shortlist_mult, rerank=rerank,
             lists_lo=lists_lo, centroids=cent_local, fused=fused,
-            rerank_width=rerank_width,
+            rerank_width=rerank_width, extract=extract,
         )
         # Merge the per-device top-k: O(q·k·devices) over ICI.
         cat_d = jax.lax.all_gather(dists, DATA_AXIS, axis=1, tiled=True)
@@ -1684,6 +1714,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     rerank=bool(config.get("ann_rerank")),
                     fused=str(config.get("ann_fused_scan")),
                     rerank_width=int(config.get("ann_rerank_width")),
+                    extract=str(config.get("ann_extract")),
                 )
             else:
                 fn = _ivf_query_fn(
@@ -1693,6 +1724,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
                     rerank=bool(config.get("ann_rerank")),
                     fused=str(config.get("ann_fused_scan")),
                     rerank_width=int(config.get("ann_rerank_width")),
+                    extract=str(config.get("ann_extract")),
                 )
             cent, lists, ids_dev, mask = self._ensure_dev_index()
             cd = jnp.dtype(config.get("compute_dtype"))

@@ -996,6 +996,20 @@ class DataPlaneDaemon:
     def stop(self) -> None:
         self._stop.set()
         if self._sock is not None:
+            # Wake a blocked accept(): on Linux, close() alone does not
+            # reliably interrupt a thread parked in accept() — every stop
+            # then eats the full join timeout (measured: exactly 5 s per
+            # daemon teardown across the whole test suite). A self-connect
+            # pokes the acceptor, which re-checks _stop and exits.
+            try:
+                host = (
+                    "127.0.0.1"
+                    if self._host in ("0.0.0.0", "::", "")
+                    else self._host
+                )
+                socket.create_connection((host, self._port), timeout=0.5).close()
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -144,6 +145,7 @@ class SimDataFrame:
         speculative: Optional[Sequence[int]] = None,
         max_attempts: int = 4,
         env_plan: Optional[Dict[int, Dict[str, str]]] = None,
+        concurrency: Optional[int] = None,
     ):
         self._parts = [
             p if isinstance(p, pa.Table) else pa.Table.from_batches([p])
@@ -161,6 +163,16 @@ class SimDataFrame:
         # DIFFERENT hosts: e.g. a per-executor SRML_DAEMON_ADDRESS that
         # routes the task to its host-local daemon).
         self._env_plan = env_plan or {}
+        # Partition tasks run CONCURRENTLY like Spark's scheduler (each
+        # still its own OS process); retries stay sequential within a
+        # partition. concurrency=1 restores strictly ordered commits —
+        # the mode the float-data bitwise-determinism tests need, since
+        # concurrent commit arrival reorders f32 folds exactly as real
+        # Spark would.
+        self._concurrency = (
+            concurrency if concurrency is not None
+            else min(4, max(1, len(self._parts)))
+        )
         self._mapped: Optional[Callable] = None
 
     # -- the DataFrame surface the wrappers use ---------------------------
@@ -177,6 +189,7 @@ class SimDataFrame:
             self._speculative,
             self._max_attempts,
             self._env_plan,
+            self._concurrency,
         )
         return out
 
@@ -211,6 +224,7 @@ class SimDataFrame:
         out = SimDataFrame(
             self._parts, self.sparkSession, self._fail_plan,
             self._speculative, self._max_attempts, self._env_plan,
+            self._concurrency,
         )
         out._mapped = fn
         return out
@@ -225,27 +239,53 @@ class SimDataFrame:
 
     def _run_tasks(self) -> List[SimRow]:
         ctx = _task_mp_context()
+        results: List[Optional[List[SimRow]]] = [None] * len(self._parts)
+        errors: List[BaseException] = []
+        gate = threading.Semaphore(self._concurrency)
+
+        def run_partition(pid: int, part: pa.Table) -> None:
+            with gate:
+                try:
+                    batches = part.to_batches(
+                        max_chunksize=max(1, part.num_rows // 2 or 1)
+                    )
+                    plan = self._fail_plan.get(pid, [])
+                    result = None
+                    for attempt in range(self._max_attempts):
+                        fail_after = plan[attempt] if attempt < len(plan) else None
+                        result = self._one_attempt(
+                            ctx, pid, attempt, batches, fail_after
+                        )
+                        if result is not None:
+                            break
+                    if result is None:
+                        raise RuntimeError(
+                            f"partition {pid} failed {self._max_attempts} "
+                            "attempts (Spark would abort the job here)"
+                        )
+                    results[pid] = result
+                    if pid in self._speculative:
+                        # a speculative duplicate finishing AFTER the
+                        # original — its output is discarded (Spark keeps
+                        # the first winner), but its daemon traffic
+                        # happens for real
+                        self._one_attempt(ctx, pid, attempt + 1, batches, None)
+                except BaseException as e:  # noqa: BLE001 — surface on main
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=run_partition, args=(pid, part))
+            for pid, part in enumerate(self._parts)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
         rows: List[SimRow] = []
-        for pid, part in enumerate(self._parts):
-            batches = part.to_batches(max_chunksize=max(1, part.num_rows // 2 or 1))
-            plan = self._fail_plan.get(pid, [])
-            result = None
-            for attempt in range(self._max_attempts):
-                fail_after = plan[attempt] if attempt < len(plan) else None
-                result = self._one_attempt(ctx, pid, attempt, batches, fail_after)
-                if result is not None:
-                    break
-            if result is None:
-                raise RuntimeError(
-                    f"partition {pid} failed {self._max_attempts} attempts "
-                    "(Spark would abort the job here)"
-                )
-            rows.extend(result)
-            if pid in self._speculative:
-                # a speculative duplicate finishing AFTER the original —
-                # its output is discarded (Spark keeps the first winner),
-                # but its daemon traffic happens for real
-                self._one_attempt(ctx, pid, attempt + 1, batches, None)
+        for result in results:
+            rows.extend(result or [])
         return rows
 
     def _one_attempt(self, ctx, pid, attempt, batches, fail_after):

@@ -123,7 +123,10 @@ def test_spark_kmeans_iterative_fit_deterministic_and_good(rng, mesh8):
     x = x[perm]
 
     def run():
-        df = simdf_from_numpy(x, n_partitions=3)
+        # concurrency=1: run-to-run BITWISE equality of float sums needs
+        # ordered commits; concurrent arrival reorders f32 folds exactly
+        # as real Spark would (determinism there is up to commit order).
+        df = simdf_from_numpy(x, n_partitions=3, concurrency=1)
         m = SparkKMeans().setK(k).setMaxIter(10).setSeed(5).fit(df)
         assert df.sparkSession.driver_rows_materialized <= 4096  # seed probe only
         return m
@@ -144,9 +147,12 @@ def test_spark_kmeans_retry_mid_pass(rng, mesh8):
     x = np.concatenate(
         [centers_true[i] + rng.normal(size=(120, d)) * 0.2 for i in range(k)]
     ).astype(np.float32)
-    clean = simdf_from_numpy(x, n_partitions=3)
+    # concurrency=1: bitwise clean-vs-flaky comparison on float sums
+    # needs ordered commits (see the determinism test above).
+    clean = simdf_from_numpy(x, n_partitions=3, concurrency=1)
     m_clean = SparkKMeans().setK(k).setMaxIter(6).setSeed(1).fit(clean)
-    flaky = simdf_from_numpy(x, n_partitions=3, fail_plan={0: [1]})
+    flaky = simdf_from_numpy(x, n_partitions=3, fail_plan={0: [1]},
+                             concurrency=1)
     m_flaky = SparkKMeans().setK(k).setMaxIter(6).setSeed(1).fit(flaky)
     np.testing.assert_array_equal(m_clean.centers, m_flaky.centers)
 
