@@ -49,6 +49,37 @@ BATCH_ROWS = int(os.environ.get("SRML_BENCH_BATCH_ROWS", 1 << 18))  # 1.1 GB bf1
 N_BATCHES = int(os.environ.get("SRML_BENCH_BATCHES", 384))
 
 
+def _f32_parity_check() -> None:
+    """Full-precision parity on THIS backend (round-4 advisor): the shipped
+    TPU defaults auto-resolve to bfloat16/Pallas, so the float32 parity the
+    CPU suite validates must also be exercised where the default flips.
+    Runs the PCA fit path with compute_dtype=float32 on a small shape and
+    asserts against the numpy float64 oracle (PCASuite.scala:80-87's
+    sign-invariant tolerance philosophy). Raises on mismatch — a failed
+    parity check fails the recorded bench run."""
+    import jax
+    import numpy as np
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.pca import fit_pca
+
+    n, d, k = 8192, 256, 8
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal((n, d)) * rng.gamma(2.0, 1.0, d)).astype(np.float32)
+    with config.option("compute_dtype", "float32"):
+        sol = fit_pca(x, k=k, mean_center=True)
+    pc = np.asarray(jax.device_get(sol.pc))
+    xc = x.astype(np.float64) - x.mean(axis=0, dtype=np.float64)
+    cov = xc.T @ xc / (n - 1)
+    w, v = np.linalg.eigh(cov)
+    ref = v[:, ::-1][:, :k]
+    # Sign-invariant subspace agreement, column by column.
+    dots = np.abs(np.sum(pc.astype(np.float64) * ref, axis=0))
+    if not np.all(dots > 1 - 1e-3):  # not assert: python -O must not skip it
+        raise RuntimeError(f"f32 parity failed on {jax.default_backend()}: "
+                           f"|cos| = {dots}")
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -57,6 +88,8 @@ def main() -> None:
     from spark_rapids_ml_tpu.ops import gram as gram_ops
     from spark_rapids_ml_tpu.ops.eigh import pca_from_gram_randomized
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    _f32_parity_check()
 
     # Since round 4 these ARE the shipped TPU-auto defaults; pinned here so
     # the recorded number stays tied to this exact profile even if defaults

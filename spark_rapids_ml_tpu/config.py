@@ -55,6 +55,28 @@ _AUTO_RESOLVERS: Dict[str, Callable[[], Any]] = {
     "compute_dtype": lambda: "bfloat16" if _backend_is_tpu() else "float32",
 }
 
+# One visible breadcrumb per process when an "auto" key flips to the TPU
+# profile (round-4 advisor): default model precision on TPU diverges from
+# the float32 path CPU CI validates, and an upgrading user should see that
+# happened in the logs rather than discover it in the numerics. Set
+# SRML_TPU_COMPUTE_DTYPE=float32 for full-precision parity runs (bench.py
+# runs exactly that parity check on the real chip every round).
+_auto_announced: set = set()
+
+
+def _announce_auto(key: str, value: Any) -> None:
+    if key in _auto_announced:
+        return
+    _auto_announced.add(key)
+    if (key, value) in (("compute_dtype", "bfloat16"), ("use_pallas", True)):
+        from spark_rapids_ml_tpu.utils.logging import get_logger
+
+        get_logger("config").info(
+            "config %r auto-resolved to %r (TPU backend detected; the "
+            "measured TPU profile). Set SRML_TPU_%s explicitly for the "
+            "portable float32/XLA behavior.", key, value, key.upper(),
+        )
+
 
 _DEFAULTS: Dict[str, Any] = {
     # Master switch, analogous to spark.rapids.sql.enabled: when False all
@@ -148,7 +170,8 @@ def get(key: str) -> Any:
     """Get a runtime config value ("auto" keys resolve per backend)."""
     value = get_raw(key)
     if value == "auto" and key in _AUTO_RESOLVERS:
-        return _AUTO_RESOLVERS[key]()
+        value = _AUTO_RESOLVERS[key]()
+        _announce_auto(key, value)
     return value
 
 

@@ -139,6 +139,7 @@ def _fit_fn(
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
                 out_specs=(P(), P(), P()),
+                check_vma=False,  # pallas_call out_shapes carry no vma annotation
             )
         count, colsum, g = stats(x, mask)
         if not fuse_finalize:

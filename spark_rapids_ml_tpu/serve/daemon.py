@@ -76,6 +76,12 @@ _IVF_DEVICE_BUILD_MAX_BYTES = int(
 #: array frames per its request's ``arrays`` spec — see _drain_payload.)
 _PAYLOAD_OPS = ("feed", "seed", "transform", "kneighbors")
 
+#: Cap on a request's declared raw-array frame count (_recv_arrays_aligned):
+#: the widest legitimate op is a multinomial merge_state (7 state leaves) or
+#: an ensure_model payload (~5 arrays); 16 leaves headroom without letting a
+#: hostile spec queue hundreds of 2 GB frames.
+_MAX_ARRAY_SPECS = 16
+
 
 def _recv_arrays_aligned(conn, req: Dict[str, Any]) -> Dict[str, np.ndarray]:
     """Receive a request's raw array frames with framing-safe parsing:
@@ -85,11 +91,72 @@ def _recv_arrays_aligned(conn, req: Dict[str, Any]) -> Dict[str, np.ndarray]:
     connection stays usable, instead of leaving unread frames that desync
     every subsequent request's length header."""
     specs = req.get("arrays") or []
+    # Bound what one request can make the daemon buffer BEFORE draining
+    # (round-4 advisor): the spec list is client-controlled, and without a
+    # cap a single feed_raw/merge_state request could declare many
+    # MAX_FRAME-sized frames and hold them all in memory at once (the
+    # Arrow feed path holds at most one). The legitimate ops carry a
+    # handful of arrays whose summed bytes fit one Arrow feed's budget.
+    import math
+
+    specs = list(specs)
+    over = None
+    sizes = []
+    if len(specs) > _MAX_ARRAY_SPECS:
+        over = (
+            f"request declares {len(specs)} array frames; the protocol ops "
+            f"need at most {_MAX_ARRAY_SPECS}"
+        )
+    else:
+        declared = 0
+        for spec in specs:
+            # Python-int arithmetic (no np.prod): hostile 2^33-scale dims
+            # must not silently wrap an int64 product back under the cap.
+            try:
+                shape = [int(s) for s in spec["shape"]]
+                if any(s < 0 for s in shape):
+                    raise ValueError(f"negative dim in shape {shape}")
+                nbytes = np.dtype(spec["dtype"]).itemsize * math.prod(shape)
+            except (KeyError, TypeError, ValueError) as e:
+                # Defer to the drain-then-error path: raising BEFORE the
+                # declared frames are read would desync the framing for the
+                # very from-scratch clients feed_raw invites.
+                over = f"bad array spec: {e}"
+                break
+            sizes.append(nbytes)
+            declared += nbytes
+        if over is None and declared > protocol.MAX_FRAME:
+            over = (
+                f"request declares {declared} summed array bytes > "
+                f"MAX_FRAME {protocol.MAX_FRAME}; split the batch"
+            )
+    if over is not None:
+        # Drain-then-error with ONE frame in memory at a time (discarding
+        # as we go): framing stays aligned for the error response without
+        # ever holding the declared frames simultaneously — the buffering
+        # bound this cap exists to enforce (round-4 advisor).
+        for _ in specs:
+            if protocol.recv_frame(conn) is None:
+                break
+        raise protocol.ProtocolError(over)
     frames = []
-    for _ in specs:
+    for i in range(len(specs)):
         frame = protocol.recv_frame(conn)
         if frame is None:
             raise protocol.ProtocolError("connection closed mid-array")
+        if len(frame) != sizes[i]:
+            # The declared sizes are what the caps above validated; a frame
+            # that disagrees re-opens the buffering bound (declare tiny,
+            # send 2 GB × 16) — discard it and drain the rest aligned.
+            got, want = len(frame), sizes[i]
+            del frame
+            for _ in range(i + 1, len(specs)):
+                if protocol.recv_frame(conn) is None:
+                    break
+            raise protocol.ProtocolError(
+                f"array frame {i} carries {got} bytes; its spec declared "
+                f"{want}"
+            )
         frames.append(frame)
     out: Dict[str, np.ndarray] = {}
     for spec, frame in zip(specs, frames):
@@ -265,9 +332,22 @@ class _Job:
         was computed against a stale iterate and must not pollute this
         pass's statistics."""
         if pass_id is not None and int(pass_id) != self.iteration:
+            if int(pass_id) > self.iteration:
+                # The DAEMON is behind the task: either this daemon joined
+                # an in-flight iterative fit (a task was rescheduled onto a
+                # daemon that never saw the job — it cannot catch up
+                # mid-fit) or it missed the driver's set_iterate.
+                hint = (
+                    " — this daemon is behind the fit (it never saw the "
+                    "earlier passes). Keep executor→daemon routing sticky "
+                    "across retries: a daemon cannot join an iterative fit "
+                    "mid-flight."
+                )
+            else:
+                hint = " (zombie task of an already-stepped pass)"
             raise ValueError(
                 f"stale pass_id {pass_id} (job is on pass {self.iteration}); "
-                "feed rejected"
+                f"feed rejected{hint}"
             )
 
     def seed_centers(self, x: np.ndarray) -> None:
@@ -1308,7 +1388,8 @@ class DataPlaneDaemon:
                 )
         with self._jobs_lock:
             job = self._jobs.get(name)
-            if job is None:
+            created = job is None
+            if created:
                 job = _Job(req_algo, x.shape[1], self._mesh, req.get("params"),
                            clock=self._clock)
                 self._jobs[name] = job
@@ -1323,13 +1404,32 @@ class DataPlaneDaemon:
                     f"feed carried n_classes={req_classes}"
                 )
         part = req.get("partition")
-        job.fold(
-            x,
-            y,
-            partition=None if part is None else int(part),
-            attempt=int(_opt(req, "attempt", 0)),
-            pass_id=req.get("pass_id"),
-        )
+        try:
+            job.fold(
+                x,
+                y,
+                partition=None if part is None else int(part),
+                attempt=int(_opt(req, "attempt", 0)),
+                pass_id=req.get("pass_id"),
+            )
+        except ValueError:
+            if created:
+                # A job whose very FIRST fold was rejected (mid-fit pass_id
+                # on a daemon that never saw the job, label validation …)
+                # must not stay parked under the name until TTL — every
+                # Spark retry of that task would create-then-fail again
+                # against the orphan's pass-0 state (round-4 advisor).
+                with self._jobs_lock:
+                    if self._jobs.get(name) is job:
+                        with job.lock:
+                            if (
+                                job.rows == 0
+                                and not job.staged
+                                and not job.committed
+                            ):
+                                job.dropped = True
+                                del self._jobs[name]
+            raise
         protocol.send_json(conn, {"ok": True, "rows": job.rows})
 
     def _op_seed(self, conn, req: Dict[str, Any]) -> None:
@@ -1388,8 +1488,12 @@ class DataPlaneDaemon:
                 current = self._jobs.get(name)
                 if current is None:
                     self._jobs[name] = job
-                    protocol.send_json(conn, {"ok": True, "rows": rows})
-                    return
+            if current is None:
+                # Response sent AFTER releasing _jobs_lock: a client with a
+                # full TCP buffer here must stall only ITS connection, not
+                # every job lookup daemon-wide (round-4 advisor).
+                protocol.send_json(conn, {"ok": True, "rows": rows})
+                return
             # Raced a concurrent creation: discard our unpublished copy
             # and fold into the published job instead (arrays land once).
             job = current

@@ -198,6 +198,96 @@ def test_stale_pass_feed_rejected(daemon, rng):
         c.commit("km", partition=0, pass_id=1)
 
 
+def test_first_feed_stale_pass_unregisters_job(daemon, rng):
+    """A partition rescheduled mid-fit onto a daemon that never saw the
+    job must not leave an orphan pass-0 job parked under the name: the
+    rejected first fold unregisters it, and the error names the routing
+    fix instead of the bare stale-pass message (round-4 advisor)."""
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    with _client(daemon) as c:
+        with pytest.raises(RuntimeError, match="sticky"):
+            c.feed("fresh", x, algo="pca", partition=0, pass_id=3)
+        # The orphan job did NOT stay registered...
+        with pytest.raises(RuntimeError, match="no such job"):
+            c.status("fresh")
+        # ...so a corrected fit can reuse the name from pass 0.
+        c.feed("fresh", x, algo="pca", partition=0, pass_id=0)
+        c.commit("fresh", partition=0, pass_id=0)
+        assert c.status("fresh")["rows"] == 64
+
+
+def test_array_spec_count_capped_framing_survives(daemon, rng):
+    """A request declaring more raw-array frames than any protocol op
+    needs is rejected (bounding what one request can make the daemon
+    buffer, round-4 advisor) — and the connection stays usable."""
+    x = rng.normal(size=(4, 4)).astype(np.float32)
+    with _client(daemon) as c:
+        arrays = {f"a{i}": x for i in range(17)}
+        with pytest.raises(RuntimeError, match="array frames"):
+            c._send_arrays_op(
+                {"op": "feed_raw", "job": "caps", "algo": "pca"}, arrays
+            )
+        assert c.ping()  # framing aligned after drain-then-error
+
+
+def test_array_declared_bytes_capped_framing_survives(daemon):
+    """Declared summed bytes are validated against MAX_FRAME BEFORE the
+    frames are buffered; undersized actual frames are drained one at a
+    time and the connection stays aligned."""
+    with _client(daemon) as c:
+        sock = c._conn()
+        huge = {
+            "op": "feed_raw", "job": "caps2", "algo": "pca",
+            "v": protocol.PROTOCOL_VERSION,
+            "arrays": [
+                {"name": "x", "dtype": "float32",
+                 "shape": [1 << 20, 1 << 10]},  # 4 GB declared
+            ],
+        }
+        protocol.send_json(sock, huge)
+        protocol.send_frame(sock, b"tiny")  # what's actually sent
+        resp = protocol.recv_json(sock)
+        assert resp is not None and not resp["ok"]
+        assert "MAX_FRAME" in resp["error"]
+        assert c.ping()
+
+
+def test_array_frame_size_must_match_spec(daemon):
+    """A frame that disagrees with its declared spec size is rejected
+    (declare-tiny/send-huge would bypass the buffering cap) and the
+    framing stays aligned."""
+    with _client(daemon) as c:
+        sock = c._conn()
+        protocol.send_json(sock, {
+            "op": "feed_raw", "job": "caps3", "algo": "pca",
+            "v": protocol.PROTOCOL_VERSION,
+            "arrays": [{"name": "x", "dtype": "float32", "shape": [2, 2]}],
+        })
+        protocol.send_frame(sock, b"\x00" * 64)  # declared 16 bytes
+        resp = protocol.recv_json(sock)
+        assert resp is not None and not resp["ok"]
+        assert "declared" in resp["error"]
+        assert c.ping()
+
+
+def test_bad_array_spec_drains_before_error(daemon):
+    """A malformed dtype in the spec (easy for from-scratch feed_raw
+    clients) errors only AFTER the declared frames are drained, keeping
+    the connection framing aligned."""
+    with _client(daemon) as c:
+        sock = c._conn()
+        protocol.send_json(sock, {
+            "op": "feed_raw", "job": "caps4", "algo": "pca",
+            "v": protocol.PROTOCOL_VERSION,
+            "arrays": [{"name": "x", "dtype": "flaot32", "shape": [2, 2]}],
+        })
+        protocol.send_frame(sock, b"\x00" * 16)
+        resp = protocol.recv_json(sock)
+        assert resp is not None and not resp["ok"]
+        assert "bad array spec" in resp["error"]
+        assert c.ping()
+
+
 def test_seeded_kmeans_deterministic_across_feed_orders(daemon, rng, mesh8):
     """Driver-side seeding makes the fit independent of partition arrival
     order — the reproducibility gap of first-batch-wins seeding."""
