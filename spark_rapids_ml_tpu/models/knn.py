@@ -124,6 +124,37 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
 KNN_METRICS = ("euclidean", "sqeuclidean", "cosine", "inner_product")
 
 
+def merge_topk(
+    dists, ids, k: int, descending: bool = False
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side top-k merge of per-shard kneighbors results — the
+    daemon-level twin of the O(q·k·devices) ``all_gather`` merges inside
+    ``_exact_knn_fn`` / ``_ivf_query_fn_sharded``, for shards that live in
+    DIFFERENT processes/hosts (one entry per daemon serving a slice of the
+    database). Exactness: as long as each shard returns its local
+    top-min(k, shard_rows), the union of candidates contains the global
+    top-k, so the merge is exact given exact shard answers (the reference's
+    any-number-of-executors reduce property, RapidsRowMatrix.scala:139).
+
+    ``dists``/``ids``: sequences of (q, k_i) arrays (k_i may differ — a
+    shard smaller than k contributes all its rows). ``descending`` for
+    similarity metrics (inner_product). Invalid entries (id −1, distance
+    +inf ascending / −inf descending) sort last; ties break toward the
+    smaller row id."""
+    D = np.concatenate([np.asarray(d, np.float64) for d in dists], axis=1)
+    I = np.concatenate([np.asarray(i, np.int64) for i in ids], axis=1)
+    if D.shape[1] < k:
+        raise ValueError(
+            f"merged candidate pool {D.shape[1]} < k = {k}; every shard "
+            "must return min(k, its rows) candidates"
+        )
+    key = -D if descending else D
+    # Row-wise lexsort: last key is primary (distance), id breaks ties;
+    # a shard's not-found tail (±inf) keys sort past every real candidate.
+    order = np.lexsort((I, key), axis=-1)[:, :k]
+    return np.take_along_axis(D, order, axis=1), np.take_along_axis(I, order, axis=1)
+
+
 def _normalized_rows(
     x: np.ndarray, zero_slot: int = 0, eps: float = 1e-12
 ) -> np.ndarray:
@@ -410,6 +441,7 @@ def build_ivf_flat(
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     train_rows: int = 2_000_000,
+    centroids: Optional[np.ndarray] = None,
 ) -> IVFFlatIndex:
     """Train the coarse quantizer and bucket the database into padded lists.
 
@@ -420,27 +452,46 @@ def build_ivf_flat(
     saturates at a few hundred points per list, and training on the full
     database would force it through HBM 10+ times for nothing (the
     assignment pass below still covers every row, in chunks).
+
+    ``centroids``: a pretrained (nlist, d) quantizer — the shard-consistent
+    build for an index spanning daemons (every daemon buckets ITS rows
+    against the SAME centroids, so a query's probe set selects the same
+    lists everywhere and the cross-daemon top-k union is the single-index
+    candidate set). The provided quantizer is FROZEN: capacity balancing
+    may still spill rows to their next-nearest list, but never recenters —
+    recentering would diverge the shards' quantizers.
     """
     from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
 
     x = np.asarray(x)
-    if train_rows < nlist:
-        raise ValueError(
-            f"train_rows = {train_rows} must be >= nlist = {nlist} "
-            f"(the quantizer needs at least one training row per list)"
-        )
-    if x.shape[0] > train_rows:
-        # shuffle=False: Floyd's O(train_rows) sampling — the default
-        # shuffles a full O(n) permutation, ~800 MB at 100M rows, for an
-        # ordering k-means training doesn't care about.
-        pick = np.random.default_rng(seed).choice(
-            x.shape[0], train_rows, replace=False, shuffle=False
-        )
-        sample = x[pick]
+    frozen = centroids is not None
+    if frozen:
+        centroids = np.asarray(centroids, np.float32)
+        if centroids.shape != (nlist, x.shape[1]):
+            raise ValueError(
+                f"pretrained centroids shape {centroids.shape} != "
+                f"({nlist}, {x.shape[1]})"
+            )
     else:
-        sample = x
-    sol = fit_kmeans(sample, k=nlist, max_iter=10, seed=seed, init="random", mesh=mesh)
-    centroids = sol.centers
+        if train_rows < nlist:
+            raise ValueError(
+                f"train_rows = {train_rows} must be >= nlist = {nlist} "
+                f"(the quantizer needs at least one training row per list)"
+            )
+        if x.shape[0] > train_rows:
+            # shuffle=False: Floyd's O(train_rows) sampling — the default
+            # shuffles a full O(n) permutation, ~800 MB at 100M rows, for an
+            # ordering k-means training doesn't care about.
+            pick = np.random.default_rng(seed).choice(
+                x.shape[0], train_rows, replace=False, shuffle=False
+            )
+            sample = x[pick]
+        else:
+            sample = x
+        sol = fit_kmeans(
+            sample, k=nlist, max_iter=10, seed=seed, init="random", mesh=mesh
+        )
+        centroids = sol.centers
     # Device-side assignment (the n·nlist·d FLOPs belong on the MXU — at
     # 1M×768×1024 the host-numpy version is minutes of CPU); only the
     # (n,) argmin comes back. The scatter into padded lists stays on host.
@@ -502,11 +553,18 @@ def build_ivf_flat(
             nonlocal cdev
             cdev = _recenter(assign_np, cdev)
 
-        assign = _balanced_refine(
-            lambda: _chunked(_cand_chunk, T), _recenter_cb, nlist, cap
-        )
+        if frozen:
+            # No recentering (the quantizer is shared across shards):
+            # capacity-spill against the fixed preference order only.
+            assign = _balance_assignments(
+                np.asarray(_chunked(_cand_chunk, T)), nlist, cap
+            )
+        else:
+            assign = _balanced_refine(
+                lambda: _chunked(_cand_chunk, T), _recenter_cb, nlist, cap
+            )
+            centroids = np.asarray(jax.device_get(cdev), dtype=centroids.dtype)
         counts = np.bincount(assign, minlength=nlist)
-        centroids = np.asarray(jax.device_get(cdev), dtype=centroids.dtype)
     maxlen = max(int(counts.max()), 1)
     d = x.shape[1]
     lists = np.zeros((nlist, maxlen, d), dtype=x.dtype)
@@ -533,6 +591,7 @@ def build_ivf_flat_device(
     nlist: int,
     seed: int = 0,
     train_rows: int = 2_000_000,
+    centroids=None,
 ) -> IVFFlatIndex:
     """Device-side IVF-Flat build for data already resident on device.
 
@@ -551,28 +610,40 @@ def build_ivf_flat_device(
 
     x = jnp.asarray(x, jnp.float32)
     n, d = x.shape
-    n_train = min(n, train_rows)
-    if n_train < nlist:
-        raise ValueError(
-            f"effective train rows = {n_train} must be >= nlist = {nlist} "
-            f"(the quantizer needs at least one training row per list)"
-        )
     key = jax.random.key(seed)
     k_samp, k_init, k_shuf = jax.random.split(key, 3)
-    sample = (
-        x[jax.random.choice(k_samp, n, (n_train,), replace=False)]
-        if n > train_rows
-        else x
-    )
-    centers0 = sample[jax.random.choice(k_init, n_train, (nlist,), replace=False)]
-    mesh = make_mesh(data=1, model=1, devices=list(x.devices())[:1])
-    fn = _lloyd_fn(
-        mesh, nlist, 10, 1e-4, config.get("compute_dtype"),
-        config.get("accum_dtype"),
-        use_pallas=bool(config.get("use_pallas")),
-    )
-    centroids, _, _ = fn(sample, jnp.ones((n_train,), jnp.float32), centers0)
-    centroids = centroids.astype(jnp.float32)
+    frozen = centroids is not None
+    if frozen:
+        # Pretrained shard-consistent quantizer (see build_ivf_flat):
+        # bucket against it, never retrain/recenter.
+        centroids = jnp.asarray(centroids, jnp.float32)
+        if centroids.shape != (nlist, d):
+            raise ValueError(
+                f"pretrained centroids shape {centroids.shape} != ({nlist}, {d})"
+            )
+    else:
+        n_train = min(n, train_rows)
+        if n_train < nlist:
+            raise ValueError(
+                f"effective train rows = {n_train} must be >= nlist = {nlist} "
+                f"(the quantizer needs at least one training row per list)"
+            )
+        sample = (
+            x[jax.random.choice(k_samp, n, (n_train,), replace=False)]
+            if n > train_rows
+            else x
+        )
+        centers0 = sample[
+            jax.random.choice(k_init, n_train, (nlist,), replace=False)
+        ]
+        mesh = make_mesh(data=1, model=1, devices=list(x.devices())[:1])
+        fn = _lloyd_fn(
+            mesh, nlist, 10, 1e-4, config.get("compute_dtype"),
+            config.get("accum_dtype"),
+            use_pallas=bool(config.get("use_pallas")),
+        )
+        centroids, _, _ = fn(sample, jnp.ones((n_train,), jnp.float32), centers0)
+        centroids = centroids.astype(jnp.float32)
 
     T = min(_IVF_SPILL_CANDIDATES, nlist)
 
@@ -644,9 +715,15 @@ def build_ivf_flat_device(
             nonlocal centroids
             centroids = _recenter(jnp.asarray(assign_np, jnp.int32), centroids)
 
-        assign_np = _balanced_refine(
-            lambda: _chunked(_cand_chunk, centroids), _recenter_cb, nlist, cap
-        )
+        if frozen:  # shared quantizer: capacity-spill only, no recenter
+            assign_np = _balance_assignments(
+                np.asarray(_chunked(_cand_chunk, centroids)), nlist, cap
+            )
+        else:
+            assign_np = _balanced_refine(
+                lambda: _chunked(_cand_chunk, centroids), _recenter_cb,
+                nlist, cap,
+            )
         assign = jnp.asarray(assign_np, jnp.int32)
         counts = jnp.zeros((nlist,), jnp.int32).at[assign].add(1)
         maxlen = max(int(jax.device_get(counts.max())), 1)

@@ -245,12 +245,19 @@ class DataPlaneClient:
         return bool(resp["dropped"])
 
     def finalize(
-        self, job: str, params: Dict[str, Any], drop: bool = True
+        self, job: str, params: Dict[str, Any], drop: bool = True,
+        arrays: Optional[Dict[str, np.ndarray]] = None,
     ) -> Tuple[Dict[str, np.ndarray], int]:
-        """Finalize a job; returns (result arrays, total rows)."""
-        resp, sock = self._roundtrip(
-            {"op": "finalize", "job": job, "params": params, "drop": drop}
-        )
+        """Finalize a job; returns (result arrays, total rows). ``arrays``
+        (optional, additive to protocol v1) sends raw array frames with
+        the request — the sharded KNN build ships the shared quantizer
+        this way (docs/protocol.md)."""
+        req = {"op": "finalize", "job": job, "params": params, "drop": drop}
+        if arrays:
+            resp = self._send_arrays_op(req, arrays)
+            sock = self._conn()  # same cached connection the op used
+        else:
+            resp, sock = self._roundtrip(req)
         return protocol.recv_arrays(sock, resp), int(resp["rows"])
 
     # -- cross-daemon merge (multi-host data plane) -------------------------
@@ -380,11 +387,21 @@ class DataPlaneClient:
         nprobe: Optional[int] = None,
         seed: int = 0,
         metric: str = "euclidean",
+        row_id_base: Optional[Dict[Any, int]] = None,
+        centroids: Optional[np.ndarray] = None,
+        return_centroids: bool = False,
     ) -> Dict[str, np.ndarray]:
         """Build the index from a knn job's accumulated rows ON the daemon
         and register it as ``register_as`` for :meth:`kneighbors` serving.
         Returns only O(1) stats ({"n_rows", "n_cols"[, "nlist",
-        "maxlen"]}) — the index itself never crosses the wire."""
+        "maxlen"]}) — the index itself never crosses the wire.
+
+        Sharded (cross-daemon) builds: ``row_id_base`` maps each partition
+        this daemon committed to its global row base (served ids become
+        global partition-major positions); ``centroids`` ships a shared
+        pretrained quantizer; ``return_centroids`` asks the build to hand
+        its trained quantizer back (the driver forwards it to the peers).
+        """
         params: Dict[str, Any] = {
             "mode": mode, "register_as": register_as, "seed": seed,
             "metric": metric,
@@ -393,7 +410,15 @@ class DataPlaneClient:
             params["nlist"] = nlist
         if nprobe is not None:
             params["nprobe"] = nprobe
-        arrays, _ = self.finalize(job, params)
+        if row_id_base is not None:
+            params["row_id_base"] = {str(p): int(b) for p, b in row_id_base.items()}
+        if return_centroids:
+            params["return_centroids"] = True
+        arrays, _ = self.finalize(
+            job, params,
+            arrays=None if centroids is None
+            else {"centroids": np.asarray(centroids, np.float32)},
+        )
         return arrays
 
     def kneighbors(

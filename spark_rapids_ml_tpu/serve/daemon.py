@@ -747,12 +747,32 @@ class _Job:
             self.touched = self._clock()  # exit stamp (see fold)
             return info
 
-    def build_knn_model(self, params: Dict[str, Any]):
+    def build_knn_model(
+        self, params: Dict[str, Any],
+        extra_arrays: Optional[Dict[str, np.ndarray]] = None,
+    ):
         """Build the KNN/ANN model from the accumulated rows and consume
-        the job. Returns (core model, info arrays); the daemon registers
-        the model for `kneighbors` serving — the ~dataset-sized index
-        never crosses to the driver (BASELINE config #5: 10M×768 would
-        OOM it, the round-2 full-collect gap)."""
+        the job. Returns (core model, info arrays, global-id map); the
+        daemon registers the model for `kneighbors` serving — the
+        ~dataset-sized index never crosses to the driver (BASELINE config
+        #5: 10M×768 would OOM it, the round-2 full-collect gap).
+
+        Cross-daemon sharded build (the index SPANNING daemons —
+        BASELINE config #5's pod-scale path):
+
+        * ``params["row_id_base"]``: {partition: global row base} — this
+          daemon holds only SOME partitions of the DataFrame; the id map
+          translates its local (partition-major) row positions to the
+          global partition-major ids every shard of the index reports, so
+          a cross-daemon top-k merge needs no translation.
+        * ``extra_arrays["centroids"]``: the shared pretrained quantizer
+          (trained by the first daemon's build, O(nlist·d) on the wire) —
+          every daemon buckets against identical centroids, making the
+          union of per-daemon probes equal the single-index candidate set.
+        * ``params["return_centroids"]``: ship the quantizer back in the
+          info arrays (what the driver forwards to the peer builds).
+        """
+        extra_arrays = extra_arrays or {}
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
@@ -762,6 +782,30 @@ class _Job:
                 blocks.extend(self.part_rows[pid])
             if not blocks:
                 raise ValueError("finalize before any feed: no rows")
+            id_base = params.get("row_id_base") or None
+            id_map = None
+            if id_base is not None:
+                if self.state:
+                    raise ValueError(
+                        "row_id_base needs fully partitioned feeds (direct "
+                        "unpartitioned rows have no global position)"
+                    )
+                pieces = []
+                for pid in sorted(self.part_rows):
+                    n_p = sum(b.shape[0] for b in self.part_rows[pid])
+                    base = id_base.get(str(pid), id_base.get(pid))
+                    if base is None:
+                        raise ValueError(
+                            f"row_id_base missing partition {pid} "
+                            f"(this daemon committed it)"
+                        )
+                    pieces.append(
+                        np.arange(base, base + n_p, dtype=np.int64)
+                    )
+                id_map = (
+                    np.concatenate(pieces) if pieces
+                    else np.zeros(0, np.int64)
+                )
             rows = np.concatenate(blocks)
             mode = str(params.get("mode", "exact"))
             metric = str(params.get("metric") or "euclidean")
@@ -791,6 +835,9 @@ class _Job:
                     rows = _normalized_rows(rows, zero_slot=0)
                 nlist = int(params["nlist"])
                 seed = int(params.get("seed") or 0)
+                cent_in = extra_arrays.get("centroids")
+                if cent_in is not None:
+                    cent_in = np.asarray(cent_in, np.float32)
                 # Build-path choice (docs/ann-capacity.md): the device
                 # build materializes the FULL (n, d) matrix on one chip —
                 # fast, but capped by single-chip HBM. Past the cap
@@ -803,11 +850,12 @@ class _Job:
                 device_ok = rows.nbytes <= _IVF_DEVICE_BUILD_MAX_BYTES
                 if build == "device" or (build == "auto" and device_ok):
                     index = build_ivf_flat_device(
-                        jnp.asarray(rows), nlist=nlist, seed=seed
+                        jnp.asarray(rows), nlist=nlist, seed=seed,
+                        centroids=cent_in,
                     )
                 elif build in ("host", "auto"):
                     index = build_ivf_flat(rows, nlist=nlist, seed=seed,
-                                           mesh=self.mesh)
+                                           mesh=self.mesh, centroids=cent_in)
                 else:
                     raise ValueError(
                         f"unknown build {build!r} (auto|device|host)"
@@ -828,6 +876,10 @@ class _Job:
                 info["sharded"] = np.asarray(
                     [1 if model._shard_mesh is not None else 0], np.int64
                 )
+                if params.get("return_centroids"):
+                    info["centroids"] = np.asarray(
+                        jax.device_get(index.centroids), np.float32
+                    )
             elif mode == "exact":
                 from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
 
@@ -836,7 +888,7 @@ class _Job:
             else:
                 raise ValueError(f"unknown knn mode {mode!r} (exact|ivf)")
             self.dropped = True  # rows are consumed by the built index
-            return model, info
+            return model, info, id_map
 
     def finalize(self, params: Dict[str, Any], drop: bool = False) -> Dict[str, np.ndarray]:
         with self.lock:
@@ -971,23 +1023,29 @@ class _ServedModel:
             self.model._set(**known)
         self.lock = threading.Lock()
         self.touched = self._clock()
+        self.id_map = None
         # Re-creatable registration (client holds the arrays): plain TTL.
         self.ttl_scale = 1.0
 
     @classmethod
-    def from_model(cls, algo: str, model, clock=time.monotonic) -> "_ServedModel":
+    def from_model(
+        cls, algo: str, model, clock=time.monotonic, id_map=None
+    ) -> "_ServedModel":
         """Wrap an already-built core model (daemon-built KNN index) —
         bypasses the arrays/params reconstruction path. NOT re-creatable
         by clients (the source rows were consumed by the build), so the
         reaper holds it 8× longer than ordinary registrations before
         reclaiming the dataset-sized memory; owners should drop_model
-        explicitly when done."""
+        explicitly when done. ``id_map``: local row position → global
+        partition-major row id, for an index shard that holds only some
+        partitions (cross-daemon sharded serve)."""
         obj = cls.__new__(cls)
         obj._clock = clock
         obj.algo = algo
         obj.model = model
         obj.lock = threading.Lock()
         obj.touched = clock()
+        obj.id_map = None if id_map is None else np.asarray(id_map, np.int64)
         obj.ttl_scale = 8.0
         return obj
 
@@ -1005,7 +1063,14 @@ class _ServedModel:
                 raise ValueError(
                     f"model algo {self.algo!r} does not serve kneighbors"
                 )
-            return self.model.kneighbors(queries, k)
+            dists, idx = self.model.kneighbors(queries, k)
+            if self.id_map is not None:
+                idx = np.asarray(idx)
+                # −1 = "fewer than k found" padding stays −1.
+                idx = np.where(
+                    idx >= 0, self.id_map[np.maximum(idx, 0)], -1
+                )
+            return dists, idx
 
 
 class DataPlaneDaemon:
@@ -1197,7 +1262,7 @@ class DataPlaneDaemon:
             if op in _PAYLOAD_OPS:
                 protocol.recv_frame(conn)
             elif op in ("ensure_model", "merge_state", "set_iterate",
-                        "feed_raw"):
+                        "feed_raw", "finalize"):
                 for _ in req.get("arrays") or []:
                     protocol.recv_frame(conn)
 
@@ -1590,6 +1655,11 @@ class DataPlaneDaemon:
         )
 
     def _op_finalize(self, conn, req: Dict[str, Any]) -> None:
+        # Optional raw array frames (additive to the v1 finalize: absent
+        # "arrays" spec = the original JSON-only request): the sharded KNN
+        # build receives the shared quantizer this way. Drained FIRST so
+        # any later rejection leaves the framing aligned.
+        extra = _recv_arrays_aligned(conn, req) if req.get("arrays") else {}
         job = self._get_job(req)
         params = _opt(req, "params", {})
         if job.algo == "knn":
@@ -1605,7 +1675,7 @@ class DataPlaneDaemon:
                         f"model name {name!r} is already registered; "
                         "pick a fresh register_as"
                     )
-            model, info = job.build_knn_model(params)
+            model, info, id_map = job.build_knn_model(params, extra)
             algo = "ann" if params.get("mode") == "ivf" else "knn"
             with self._models_lock:
                 if name in self._models:  # raced registration: first wins
@@ -1613,8 +1683,9 @@ class DataPlaneDaemon:
                         f"model name {name!r} is already registered; "
                         "pick a fresh register_as"
                     )
-                self._models[name] = _ServedModel.from_model(algo, model,
-                                                             clock=self._clock)
+                self._models[name] = _ServedModel.from_model(
+                    algo, model, clock=self._clock, id_map=id_map
+                )
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
             protocol.send_arrays(

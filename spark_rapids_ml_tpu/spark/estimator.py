@@ -348,7 +348,18 @@ class _SparkAdapter:
         accumulation job; finalize BUILDS the index on the daemon's
         devices and registers it for kneighbors serving. The dataset (and
         the index, which is the same size) never reaches the driver —
-        BASELINE config #5 (10M×768 ≈ 31 GB) would OOM it."""
+        BASELINE config #5 (10M×768 ≈ 31 GB) would OOM it.
+
+        Multi-daemon feeds build a SHARDED index (the pod-scale ANN path,
+        BASELINE config #5 on v5e-64): each daemon builds and serves the
+        shard holding ITS committed partitions, ids translated to global
+        partition-major positions daemon-side, and ``kneighbors`` fans the
+        query batch to every shard and merges top-k (models/knn.merge_topk
+        — the daemon-level twin of the device merges). IVF shards bucket
+        against ONE shared quantizer: the first daemon's build trains it
+        and the driver forwards the (nlist, d) centroids — O(nlist·d) on
+        the wire, never the data — so the union of per-shard probes equals
+        the single-index candidate set."""
         core = self._core
         spark = getattr(df, "sparkSession", None)
         host, port, token = daemon_session.resolve(spark)
@@ -379,65 +390,110 @@ class _SparkAdapter:
             raise ValueError("cannot fit on an empty DataFrame")
         with DataPlaneClient(host, port, token=token) as pc0:
             primary_id = pc0.server_id() or f"{host}:{port}"
-        # KNN state is the dataset itself — it cannot merge across daemons
-        # the way O(d²) partials do; the build must see every row, so all
-        # executors must route to the ONE daemon that builds and serves
-        # the index (shard_index spreads it over that daemon's mesh).
-        stray = sorted(addr_of[d] for d, n in per_daemon.items()
-                       if d != primary_id and n > 0)
-        if stray:
-            # Free the dataset-sized jobs everywhere BEFORE failing — a
-            # knn job holds the raw rows, and leaking them until TTL on
-            # every daemon could OOM the corrected refit.
-            for addr in list(addr_of.values()) + [f"{host}:{port}"]:
+        fed = {d: n for d, n in per_daemon.items() if n > 0}
+
+        def _cleanup(drop_jobs=True, drop_models=()):
+            # Free dataset-sized state BEFORE failing: a knn job/shard
+            # holds the raw rows, and leaking them until TTL on every
+            # daemon could OOM the corrected refit.
+            for did in fed:
                 try:
-                    ah, ap = daemon_session._parse_addr(addr)
+                    ah, ap = daemon_session._parse_addr(addr_of[did])
                     with DataPlaneClient(ah, ap, token=token) as dc:
-                        dc.drop(job)
+                        if drop_jobs:
+                            dc.drop(job)
+                        for m in drop_models:
+                            dc.drop_model(m)
                 except Exception:
                     pass
+
+        multi = len(fed) > 1
+        if multi and any(":" in d for d in list(fed) + [primary_id]):
+            _cleanup()
             raise RuntimeError(
-                f"knn fit fed {len(stray)} daemon(s) other than the "
-                f"driver-resolved {host}:{port} ({', '.join(stray)}): the "
-                "index build would silently miss their rows. Unset the "
-                "executor-local SRML_DAEMON_ADDRESS override (or point "
-                "spark.srml.daemon.address at the one daemon) for knn fits."
+                "knn fit fed multiple daemons but at least one does not "
+                "self-report an instance id — it predates the sharded "
+                "index serve. Upgrade every daemon, or route all "
+                "executors to one daemon."
             )
+        # Global ids are partition-major positions of the fitted DataFrame
+        # (the single-daemon convention); each daemon's shard translates
+        # its local positions through this base map.
+        part_rows: dict = {}
+        for r in acks:
+            if int(r["rows"]) > 0:
+                part_rows[int(r["partition"])] = int(r["rows"])
+        id_base, cum = {}, 0
+        for pid in sorted(part_rows):
+            id_base[pid] = cum
+            cum += part_rows[pid]
         name = f"knnidx-{job}"
-        with DataPlaneClient(host, port, token=token) as client:
-            try:
+        # Primary first (deterministic quantizer owner), then peers by id.
+        daemon_ids = sorted(fed, key=lambda d: (d != primary_id, d))
+
+        def _finalize_shard(did, centroids=None, first=False):
+            ah, ap = daemon_session._parse_addr(addr_of[did])
+            with DataPlaneClient(ah, ap, token=token) as client:
                 if ivf:
                     info = client.finalize_knn(
                         job, register_as=name, mode="ivf",
                         nlist=core.getNlist(), nprobe=core.getNprobe(),
                         seed=core.getSeed(), metric=metric,
+                        row_id_base=id_base if multi else None,
+                        centroids=centroids,
+                        return_centroids=multi and first,
                     )
                 else:
                     info = client.finalize_knn(
-                        job, register_as=name, mode="exact", metric=metric
+                        job, register_as=name, mode="exact", metric=metric,
+                        row_id_base=id_base if multi else None,
                     )
-            except Exception:
-                try:
-                    client.drop(job)
-                except Exception:
-                    pass
-                raise
-        if int(info["n_rows"][0]) != total:
-            # Free the short registration before failing: queries against
-            # it would answer from a silently-partial database.
-            try:
-                with DataPlaneClient(host, port, token=token) as client:
-                    client.drop_model(name)
-            except Exception:
-                pass
+            n_shard = int(info["n_rows"][0])
+            if n_shard != fed[did]:
+                raise _split_brain(
+                    f"knn shard build on {addr_of[did]}", fed[did], n_shard,
+                    ", ".join(f"{addr_of[d]}={n}"
+                              for d, n in sorted(fed.items())),
+                )
+            return info, (addr_of[did], n_shard)
+
+        shards = []
+        try:
+            # The first build is the quantizer owner (ivf) — it must run
+            # before the peers; the peers' dataset-sized builds are then
+            # independent and run CONCURRENTLY (fit wall-clock = first +
+            # max of the rest, not the sum over daemons).
+            first_info, first_shard = _finalize_shard(daemon_ids[0], first=True)
+            shards.append(first_shard)
+            rest = daemon_ids[1:]
+            if rest:
+                from concurrent.futures import ThreadPoolExecutor
+
+                cent = first_info["centroids"] if ivf else None
+                with ThreadPoolExecutor(max_workers=min(len(rest), 16)) as ex:
+                    futs = [ex.submit(_finalize_shard, did, cent)
+                            for did in rest]
+                    shards.extend(f.result()[1] for f in futs)
+        except Exception:
+            _cleanup(drop_models=[name])
+            raise
+        if sum(n for _, n in shards) != total:
+            _cleanup(drop_jobs=False, drop_models=[name])
             raise _split_brain(
-                "knn index build", total, int(info["n_rows"][0]),
-                ", ".join(f"{addr_of[d]}={n}"
-                          for d, n in sorted(per_daemon.items())),
+                "knn index build", total, sum(n for _, n in shards),
+                ", ".join(f"{a}={n}" for a, n in shards),
             )
+        if multi:
+            home_h, home_p = host, port
+        else:
+            # The index may have been built on an executor-override daemon
+            # (not the driver-resolved one): the handle must query and
+            # release where the index actually LIVES.
+            home_h, home_p = daemon_session._parse_addr(shards[0][0])
         return _DaemonKNNModel(
-            core, host, port, token, name,
-            n_rows=int(info["n_rows"][0]), input_col=input_col,
+            core, home_h, home_p, token, name,
+            n_rows=total, input_col=input_col,
+            shards=shards if multi else None,
         )
 
     # -- distributed fit ---------------------------------------------------
@@ -971,33 +1027,76 @@ _KNN_OUTPUTS = (
 class _DaemonKNNTask:
     """Executor-side query feeder: each batch's query rows go to the
     daemon's ``kneighbors`` op; neighbor distance/index columns come
-    back. The database-sized index stays daemon-resident."""
+    back. The database-sized index stays daemon-resident.
 
-    def __init__(self, host, port, token, name, input_col, k):
+    Sharded index (``shards``: [(addr, shard_rows)]): the batch fans out
+    to EVERY shard daemon and the task merges the per-shard top-k
+    host-side (models/knn.merge_topk) — O(q·k·shards) merged per batch,
+    independent of database size."""
+
+    def __init__(self, host, port, token, name, input_col, k,
+                 shards=None, descending=False):
         self.host, self.port, self.token = host, port, token
         self._name = name
         self._input_col = input_col
         self._k = k
+        self._shards = shards
+        self._descending = descending
+
+    def _query_shards(self, table, clients):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from spark_rapids_ml_tpu.models.knn import merge_topk
+
+        def one(entry):
+            (addr, n_shard), c = entry
+            return c.kneighbors(
+                self._name, table,
+                k=min(self._k, n_shard), input_col=self._input_col,
+            )
+
+        # Concurrent fan-out: the per-shard searches are independent, so
+        # per-batch latency is the SLOWEST shard, not the sum (each shard
+        # has its own client/socket — no connection sharing across threads).
+        with ThreadPoolExecutor(max_workers=min(len(clients), 16)) as ex:
+            results = list(ex.map(one, clients))
+        per_d = [d for d, _ in results]
+        per_i = [i for _, i in results]
+        return merge_topk(per_d, per_i, self._k, descending=self._descending)
 
     def __call__(self, batches):
+        import contextlib
+
         import pyarrow as pa
 
         from spark_rapids_ml_tpu.serve.client import DataPlaneClient
         from spark_rapids_ml_tpu.spark import daemon_session as ds
 
-        h, p = ds.executor_daemon_address(self.host, self.port)
-        with DataPlaneClient(h, p, token=self.token) as c:
+        with contextlib.ExitStack() as stack:
+            if self._shards:
+                clients = [
+                    (s, stack.enter_context(DataPlaneClient(
+                        *ds._parse_addr(s[0]), token=self.token)))
+                    for s in self._shards
+                ]
+            else:
+                h, p = ds.executor_daemon_address(self.host, self.port)
+                clients = [
+                    ((f"{h}:{p}", None), stack.enter_context(
+                        DataPlaneClient(h, p, token=self.token)))
+                ]
             for batch in batches:
                 table = pa.Table.from_batches([batch])
                 if table.num_rows == 0:
                     yield from _append_outputs(table, {}, _KNN_OUTPUTS).to_batches()
                     continue
-                dists, idx = c.kneighbors(
-                    self._name,
-                    table.select([self._input_col]),
-                    k=self._k,
-                    input_col=self._input_col,
-                )
+                q = table.select([self._input_col])
+                if self._shards:
+                    dists, idx = self._query_shards(q, clients)
+                else:
+                    dists, idx = clients[0][1].kneighbors(
+                        self._name, q, k=self._k, input_col=self._input_col
+                    )
                 out = {"distances": dists, "indices": idx}
                 yield from _append_outputs(table, out, _KNN_OUTPUTS).to_batches()
 
@@ -1012,12 +1111,16 @@ class _DaemonKNNModel:
     instead. Use the core (non-Spark) API for an in-memory, persistable
     index."""
 
-    def __init__(self, core, host, port, token, name, n_rows, input_col):
+    def __init__(self, core, host, port, token, name, n_rows, input_col,
+                 shards=None):
         self._core = core  # the estimator: param surface (k, featuresCol…)
         self._host, self._port, self._token = host, port, token
         self._name = name
         self._n_rows = n_rows
         self._input_col = input_col
+        # [(addr, shard_rows)] when the index spans daemons (each daemon
+        # serves the shard of ITS committed partitions); None = one daemon.
+        self._shards = shards
 
     def __getattr__(self, name):
         return getattr(self._core, name)
@@ -1030,10 +1133,24 @@ class _DaemonKNNModel:
     def numRows(self) -> int:
         return self._n_rows
 
+    @property
+    def shards(self):
+        """[(daemon address, rows served there)] for a cross-daemon
+        sharded index; None when one daemon serves the whole database."""
+        return None if self._shards is None else list(self._shards)
+
+    def _descending(self) -> bool:
+        return (
+            self._core.hasParam("metric")
+            and self._core.getOrDefault("metric") == "inner_product"
+        )
+
     def kneighbors(self, queries, k=None):
         """Driver-side convenience for ndarray queries: (distances (q, k),
         indices (q, k)); indices are global partition-major row positions
-        of the fitted DataFrame."""
+        of the fitted DataFrame. A sharded index fans the batch to every
+        shard daemon and merges top-k (exact given exact shard answers —
+        models/knn.merge_topk)."""
         from spark_rapids_ml_tpu.serve.client import DataPlaneClient
 
         if _is_spark_df(queries):
@@ -1041,12 +1158,33 @@ class _DaemonKNNModel:
                 "pass a DataFrame to transform() for distributed queries; "
                 "kneighbors takes an (q, d) ndarray"
             )
-        with DataPlaneClient(self._host, self._port, token=self._token) as c:
-            return c.kneighbors(
-                self._name, np.asarray(queries),
-                k=self._core.getOrDefault("k") if k is None else k,
-                input_col=self._input_col,
-            )
+        k = self._core.getOrDefault("k") if k is None else k
+        queries = np.asarray(queries)
+        if self._shards is None:
+            with DataPlaneClient(self._host, self._port,
+                                 token=self._token) as c:
+                return c.kneighbors(
+                    self._name, queries, k=k, input_col=self._input_col
+                )
+        from concurrent.futures import ThreadPoolExecutor
+
+        from spark_rapids_ml_tpu.models.knn import merge_topk
+
+        def one(shard):
+            addr, n_shard = shard
+            h, p = daemon_session._parse_addr(addr)
+            with DataPlaneClient(h, p, token=self._token) as c:
+                return c.kneighbors(
+                    self._name, queries, k=min(k, n_shard),
+                    input_col=self._input_col,
+                )
+
+        with ThreadPoolExecutor(max_workers=min(len(self._shards), 16)) as ex:
+            results = list(ex.map(one, self._shards))
+        return merge_topk(
+            [d for d, _ in results], [i for _, i in results], k,
+            descending=self._descending(),
+        )
 
     def transform(self, dataset):
         """Distributed query: appends knn_distances (list<double>) and
@@ -1065,6 +1203,7 @@ class _DaemonKNNModel:
         fn = _DaemonKNNTask(
             self._host, self._port, self._token, self._name,
             self._input_col, self._core.getOrDefault("k"),
+            shards=self._shards, descending=self._descending(),
         )
         return dataset.mapInArrow(
             fn, _derive_output_schema(dataset, _KNN_OUTPUTS)
@@ -1072,15 +1211,23 @@ class _DaemonKNNModel:
 
     def release(self) -> bool:
         """Free the daemon-resident index now (it is dataset-sized and
-        otherwise held until the daemon's extended KNN TTL). The handle
-        is unusable afterwards."""
+        otherwise held until the daemon's extended KNN TTL; a sharded
+        index frees every shard). The handle is unusable afterwards."""
         from spark_rapids_ml_tpu.serve.client import DataPlaneClient
 
-        try:
-            with DataPlaneClient(self._host, self._port, token=self._token) as c:
-                return c.drop_model(self._name)
-        except OSError:
-            return False  # daemon already gone — nothing to free
+        addrs = (
+            [f"{self._host}:{self._port}"] if self._shards is None
+            else [a for a, _ in self._shards]
+        )
+        any_dropped = False
+        for addr in addrs:
+            try:
+                h, p = daemon_session._parse_addr(addr)
+                with DataPlaneClient(h, p, token=self._token) as c:
+                    any_dropped = c.drop_model(self._name) or any_dropped
+            except OSError:
+                continue  # daemon already gone — nothing to free there
+        return any_dropped
 
     def write(self):
         raise NotImplementedError(

@@ -432,6 +432,58 @@ def test_daemon_ivf_build_shards_over_full_mesh(daemon, rng, mesh8):
     np.testing.assert_allclose(np.sort(dists, 1), np.sort(od, 1), atol=1e-3)
 
 
+def test_cross_daemon_sharded_ivf_protocol(rng, mesh8):
+    """The sharded-index finalize extensions at the protocol level (no
+    Spark layer): two daemons each hold some partitions; daemon A's
+    build trains and returns the quantizer, B buckets against the same
+    frozen centroids; row_id_base globalizes ids; the caller merges
+    per-shard kneighbors. Probe-all + rerank ⇒ the merged answer is the
+    exact brute-force top-k."""
+    from spark_rapids_ml_tpu.models.knn import merge_topk
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+    kc, d, k = 6, 10, 4
+    centers = rng.normal(size=(kc, d)) * 10
+    x = np.concatenate(
+        [c + rng.normal(size=(50, d)) for c in centers]
+    ).astype(np.float32)
+    x = x[rng.permutation(len(x))]
+    q = x[:24]
+    parts = np.array_split(x, 4)
+    base = {i: int(sum(len(p) for p in parts[:i])) for i in range(4)}
+    with DataPlaneDaemon(mesh=mesh8) as da, DataPlaneDaemon(mesh=mesh8) as db:
+        ca, cb = DataPlaneClient(*da.address), DataPlaneClient(*db.address)
+        for pid, c in ((0, ca), (1, ca), (2, cb), (3, cb)):
+            c.feed("j", parts[pid], algo="knn", partition=pid)
+            c.commit("j", partition=pid)
+        info_a = ca.finalize_knn(
+            "j", register_as="sharded", mode="ivf", nlist=kc, nprobe=kc,
+            row_id_base={0: base[0], 1: base[1]}, return_centroids=True,
+        )
+        assert int(info_a["n_rows"][0]) == len(parts[0]) + len(parts[1])
+        cent = info_a["centroids"]
+        assert cent.shape == (kc, d)
+        info_b = cb.finalize_knn(
+            "j", register_as="sharded", mode="ivf", nlist=kc, nprobe=kc,
+            row_id_base={2: base[2], 3: base[3]}, centroids=cent,
+        )
+        assert int(info_b["n_rows"][0]) == len(parts[2]) + len(parts[3])
+        # both shards bucket against bitwise-identical centroids
+        np.testing.assert_array_equal(
+            np.asarray(da._models["sharded"].model.index.centroids), cent
+        )
+        np.testing.assert_array_equal(
+            np.asarray(db._models["sharded"].model.index.centroids), cent
+        )
+        d_a, i_a = ca.kneighbors("sharded", q, k=k)
+        d_b, i_b = cb.kneighbors("sharded", q, k=k)
+        ca.close(), cb.close()
+    dists, idx = merge_topk([d_a, d_b], [i_a, i_b], k)
+    d2 = ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    np.testing.assert_array_equal(np.sort(idx, 1), np.sort(want, 1))
+
+
 def test_daemon_ivf_host_build_path(daemon, rng, monkeypatch):
     """Past the device-build HBM cap, the build runs host-side and the
     sharded placement never lands a full copy on one device. Forced here

@@ -316,17 +316,178 @@ def test_primary_alias_is_not_a_peer(rng, mesh8):
         np.testing.assert_array_equal(m_alias.mean, m_plain.mean)
 
 
-def test_knn_multidaemon_routing_rejected(rng, mesh8, two_daemons):
-    """KNN state is the dataset itself — a split-routed knn fit must fail
-    loudly (the index build would silently miss the peer's rows)."""
+def test_exact_knn_two_daemons_matches_single(rng, mesh8, two_daemons):
+    """The pod-scale ANN path (BASELINE config #5): executors split the
+    feed across two daemons, each builds/serves the shard of its own
+    partitions with globalized ids, and kneighbors fans out + merges
+    top-k. The exact-mode merged answer must equal the single-daemon
+    answer exactly (the union of per-shard top-k contains the global
+    top-k — the any-number-of-executors reduce, RapidsRowMatrix.scala:
+    139, with daemons as the shards)."""
     from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
 
     a, b = two_daemons
+    n, d, k = 500, 10, 7
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    q = x[:40] + 0.01 * rng.normal(size=(40, d))
+
+    single = simdf_from_numpy(
+        x, n_partitions=4,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = SparkNearestNeighbors().setK(k).fit(single)
+    d1, i1 = m_single.kneighbors(q)
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    m_split = SparkNearestNeighbors().setK(k).fit(split)
+    assert split.sparkSession.driver_rows_materialized == 0
+    assert m_split.shards is not None and len(m_split.shards) == 2
+    assert sum(r for _, r in m_split.shards) == n
+    d2_, i2 = m_split.kneighbors(q)
+    np.testing.assert_array_equal(i2, i1)
+    np.testing.assert_allclose(d2_, d1, rtol=0, atol=1e-12)
+
+    # Distributed (mapInArrow) queries fan out per task and match.
+    qdf = simdf_from_numpy(q, n_partitions=2, session=session)
+    rows = m_split.transform(qdf).collect()
+    got = np.asarray([r["knn_indices"] for r in rows])
+    np.testing.assert_array_equal(got, i1)
+    m_split.release()
+    assert m_split.daemon_model_name not in a._models
+    assert m_split.daemon_model_name not in b._models
+    m_single.release()
+
+
+def test_ivf_two_daemons_shared_quantizer(rng, mesh8, two_daemons):
+    """Sharded IVF: the first daemon's build trains the coarse quantizer,
+    peers bucket against the SAME frozen centroids, so the union of
+    per-shard probes is the single-index candidate set. With nprobe =
+    nlist (every list scanned, exact rerank) the merged answer must match
+    the brute-force oracle."""
+    from spark_rapids_ml_tpu.spark.estimator import (
+        SparkApproximateNearestNeighbors,
+    )
+
+    a, b = two_daemons
+    kc, d, k = 8, 12, 5
+    centers = rng.normal(size=(kc, d)) * 10
+    x = np.concatenate(
+        [c + rng.normal(size=(70, d)) for c in centers]
+    ).astype(np.float32)
+    x = x[rng.permutation(len(x))]
+    q = x[:48]
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    model = (
+        SparkApproximateNearestNeighbors()
+        .setK(k).setNlist(kc).setNprobe(kc)  # probe all → exact given rerank
+        .fit(split)
+    )
+    assert model.shards is not None and len(model.shards) == 2
+    dists, idx = model.kneighbors(q)
+    d2 = ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    np.testing.assert_array_equal(np.sort(idx, 1), np.sort(want, 1))
+    np.testing.assert_allclose(
+        dists, np.sqrt(np.take_along_axis(d2, idx.astype(int), 1)), atol=1e-4
+    )
+    # Both daemons hold a shard registered under the same name; both are
+    # bucketed against ONE quantizer (bitwise-identical centroids).
+    cen_a = a._models[model.daemon_model_name].model.index.centroids
+    cen_b = b._models[model.daemon_model_name].model.index.centroids
+    np.testing.assert_array_equal(np.asarray(cen_a), np.asarray(cen_b))
+    model.release()
+
+
+def test_ivf_two_daemons_partial_probe_recall(rng, mesh8, two_daemons):
+    """Sharded IVF at nprobe < nlist (the production operating point):
+    recall against brute force stays at the single-index level on
+    clustered data."""
+    from spark_rapids_ml_tpu.spark.estimator import (
+        SparkApproximateNearestNeighbors,
+    )
+
+    a, b = two_daemons
+    kc, d, k = 12, 16, 5
+    centers = rng.normal(size=(kc, d)) * 12
+    x = np.concatenate(
+        [c + rng.normal(size=(60, d)) for c in centers]
+    ).astype(np.float32)
+    x = x[rng.permutation(len(x))]
+    q = x[:64]
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    model = (
+        SparkApproximateNearestNeighbors()
+        .setK(k).setNlist(kc).setNprobe(4)
+        .fit(split)
+    )
+    _, idx = model.kneighbors(q)
+    d2 = ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    recall = np.mean(
+        [len(set(idx[i]) & set(want[i])) / k for i in range(len(q))]
+    )
+    assert recall > 0.9, recall
+    model.release()
+
+
+def test_knn_single_daemon_via_override_serves_where_built(rng, mesh8,
+                                                           two_daemons):
+    """ALL partitions routed to daemon B by the executor-local override
+    while the driver resolves A: the index lives on B, and the handle
+    must query and release it THERE (not 'no such model' against A)."""
+    from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
+
+    a, b = two_daemons
+    n, d, k = 200, 6, 3
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    session = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+    env_plan = {pid: {"SRML_DAEMON_ADDRESS": _addr(b)} for pid in range(4)}
+    df = simdf_from_numpy(x, n_partitions=4, session=session,
+                          env_plan=env_plan)
+    model = SparkNearestNeighbors().setK(k).fit(df)
+    assert model.shards is None  # one daemon → unsharded serve
+    assert model.daemon_model_name in b._models
+    assert model.daemon_model_name not in a._models
+    dists, idx = model.kneighbors(x[:16])
+    np.testing.assert_array_equal(idx[:, 0], np.arange(16))
+    assert model.release()
+    assert model.daemon_model_name not in b._models
+
+
+def test_knn_shard_build_failure_frees_all_shards(rng, mesh8, two_daemons,
+                                                  monkeypatch):
+    """If one shard's build fails, the fit must free the dataset-sized
+    jobs AND any already-registered shard on every daemon — leaking them
+    until TTL could OOM the corrected refit."""
+    from spark_rapids_ml_tpu.serve.daemon import _Job
+    from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
+
+    a, b = two_daemons
+    orig = _Job.build_knn_model
+    calls = {"n": 0}
+
+    def flaky_build(self, params, extra_arrays=None):
+        calls["n"] += 1
+        if calls["n"] == 2:  # second shard's build dies
+            raise ValueError("injected build failure")
+        return orig(self, params, extra_arrays)
+
+    monkeypatch.setattr(_Job, "build_knn_model", flaky_build)
     session, env_plan = _split_session(a, b)
     df = simdf_from_numpy(rng.normal(size=(200, 6)), n_partitions=4,
                           session=session, env_plan=env_plan)
-    with pytest.raises(RuntimeError, match="knn fit fed"):
+    with pytest.raises(RuntimeError, match="injected build failure"):
         SparkNearestNeighbors().setK(3).fit(df)
+    assert not a._jobs and not b._jobs, "failed fit leaked shard jobs"
+    assert not a._models and not b._models, "failed fit leaked a shard"
 
 
 def test_two_daemon_processes_end_to_end(rng, mesh8):
@@ -396,6 +557,38 @@ def test_two_daemon_processes_end_to_end(rng, mesh8):
                                     env_plan=env_plan)
         km_split = SparkKMeans().setK(k).setMaxIter(6).setSeed(7).fit(ks_split)
         np.testing.assert_array_equal(km_split.centers, km_single.centers)
+
+        # Sharded KNN across processes: each OS-process daemon serves the
+        # shard of its own partitions; fan-out + merge must equal the
+        # single-daemon answer (BASELINE config #5's pod-scale path).
+        from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
+
+        xq = rng.normal(size=(400, 8)).astype(np.float64)
+        qs = xq[:24]
+        nn_single = SparkNearestNeighbors().setK(5).fit(
+            simdf_from_numpy(
+                xq, n_partitions=4,
+                session=SimSparkSession(
+                    {"spark.srml.daemon.address": addr_a}),
+            )
+        )
+        dq1, iq1 = nn_single.kneighbors(qs)
+        nn_sess = SimSparkSession({"spark.srml.daemon.address": addr_a})
+        nn_split = SparkNearestNeighbors().setK(5).fit(
+            simdf_from_numpy(xq, n_partitions=4, session=nn_sess,
+                             env_plan=env_plan)
+        )
+        assert nn_split.shards is not None and len(nn_split.shards) == 2
+        dq2, iq2 = nn_split.kneighbors(qs)
+        np.testing.assert_array_equal(iq2, iq1)
+        # The worker daemons compute in float32 (no x64 there): the same
+        # (q, row) pair's Gram-trick d² can round differently inside a
+        # 400-row vs 200-row shard GEMM, and sqrt near zero amplifies
+        # that to ~1e-3 (self-distance 0 vs √(f32 noise)). Ids above are
+        # the bitwise contract; distances carry the f32 tolerance.
+        np.testing.assert_allclose(dq2, dq1, rtol=1e-5, atol=2e-3)
+        nn_split.release()
+        nn_single.release()
     finally:
         for proc, _ in workers:
             try:
