@@ -93,21 +93,34 @@ def main() -> None:
         x_mm = jax.device_put(x_mm, NamedSharding(mesh, P("data", None)))
         y_mm = jax.device_put(y_mm, NamedSharding(mesh, P("data")))
         mask_mm = jax.device_put(mask_mm, NamedSharding(mesh, P("data")))
-    update = _stream_softmax_stats_fn(mesh, C, "float32")
     mm_step = _stream_multinomial_step_fn(1e-4, True, "float32")
 
-    def run_mm(n):
-        W = jnp.zeros((D, C), jnp.float32)
-        b = jnp.zeros((C,), jnp.float32)
-        for _ in range(n):
-            state = stream_softmax_zero_state(D, C, jnp.float32)
-            gw, gb, hw, hwb, hbb, _, nn = update(state, W, b, x_mm, y_mm, mask_mm)
-            W, b, _ = mm_step(gw, gb, hw, hwb, hbb, nn, W, b)
-        sync(W)
-        return W
+    def mm_timer(update):
+        def run_mm(n):
+            W = jnp.zeros((D, C), jnp.float32)
+            b = jnp.zeros((C,), jnp.float32)
+            for _ in range(n):
+                state = stream_softmax_zero_state(D, C, jnp.float32)
+                gw, gb, hw, hwb, hbb, _, nn = update(
+                    state, W, b, x_mm, y_mm, mask_mm
+                )
+                W, b, _ = mm_step(gw, gb, hw, hwb, hbb, nn, W, b)
+            sync(W)
+            return W
 
-    mm_iters = max(2, ITERS // 2)
-    dt_mm = slope_dt(run_mm, mm_iters, 2 * mm_iters)
+        mm_iters = max(2, ITERS // 2)
+        return slope_dt(run_mm, mm_iters, 2 * mm_iters)
+
+    # Same-run A/B: the shared-tile Pallas curvature kernel (use_pallas
+    # snapshot True — the shipped TPU profile) vs the XLA per-class loop.
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        _stream_softmax_stats_cached,
+    )
+
+    dt_mm = mm_timer(_stream_softmax_stats_fn(mesh, C, "float32"))
+    dt_mm_xla = mm_timer(
+        _stream_softmax_stats_cached(mesh, C, "float32", "bfloat16", False)
+    )
     a100_mm = 110e12 / (2 * C * D * D)
     emit(
         f"logreg_newton_row_iters_per_sec_per_chip_d{D}",
@@ -123,6 +136,8 @@ def main() -> None:
         "row_iters/s/chip",
         (rows_mm / dt_mm / n_chips) / a100_mm,
         classes=C,
+        ab_xla_row_iters_per_sec=round(rows_mm / dt_mm_xla / n_chips, 1),
+        kernel_speedup=round(dt_mm_xla / dt_mm, 4),
     )
 
 

@@ -483,16 +483,19 @@ def _stream_newton_step_fn(reg: float, fit_intercept: bool, ad: str):
 
 
 def _stream_softmax_stats_fn(mesh: Mesh, n_classes: int, ad: str):
-    # compute_dtype is read at build time so it participates in the cache
-    # key (the _newton_fn snapshot pattern): a config flip between fits
-    # must not silently reuse a stale-curvature-dtype closure.
+    # compute_dtype / use_pallas are read at build time so they participate
+    # in the cache key (the _newton_fn snapshot pattern): a config flip
+    # between fits must not silently reuse a stale-curvature-dtype closure.
     return _stream_softmax_stats_cached(
-        mesh, n_classes, ad, jnp.dtype(config.get("compute_dtype")).name
+        mesh, n_classes, ad, jnp.dtype(config.get("compute_dtype")).name,
+        bool(config.get("use_pallas")),
     )
 
 
 @functools.lru_cache(maxsize=32)
-def _stream_softmax_stats_cached(mesh: Mesh, n_classes: int, ad: str, cd: str):
+def _stream_softmax_stats_cached(
+    mesh: Mesh, n_classes: int, ad: str, cd: str, use_pallas: bool = False
+):
     """Jitted donated accumulate of one batch's multinomial statistics at
     fixed (W, b): (state, W, b, x, y, mask) -> state with
     state = (gw (d, C), gb (C), hw (C, d, d), hwb (C, d), hbb (C),
@@ -519,6 +522,31 @@ def _stream_softmax_stats_cached(mesh: Mesh, n_classes: int, ad: str, cd: str):
         else accum
     )
 
+    from spark_rapids_ml_tpu.ops.pallas_kernels import (
+        SOFTMAX_CURV_BLOCK_N,
+        SOFTMAX_CURV_VMEM_BUDGET,
+        softmax_curv_block_c,
+        softmax_curvature_pallas,
+    )
+
+    def _curv_kernel_ok(n: int, d: int) -> bool:
+        """Shared-tile Pallas curvature: TPU backend + f32 accumulate +
+        block-divisible shapes (the n check runs per traced shape — the
+        streaming path's power-of-two row buckets satisfy it from the
+        block size up, smaller buckets take the XLA loop, which is fine
+        at that size) + even ONE class's (d, d) accumulator inside the
+        VMEM budget (past that the XLA loop handles d, not a trace-time
+        raise)."""
+        from spark_rapids_ml_tpu.ops.gram import _pallas_backend_ok
+
+        return (
+            _pallas_backend_ok(use_pallas)
+            and accum == jnp.float32
+            and n % SOFTMAX_CURV_BLOCK_N == 0
+            and d % 128 == 0
+            and 4 * d * d <= SOFTMAX_CURV_VMEM_BUDGET
+        )
+
     def shard(gw, gb, hw, hwb, hbb, loss, n, W, b, x, y, mask):
         from spark_rapids_ml_tpu.ops.gram import mm_precision
 
@@ -539,26 +567,40 @@ def _stream_softmax_stats_cached(mesh: Mesh, n_classes: int, ad: str, cd: str):
 
             xh = xc.astype(hd)
 
-            def per_class(c):
-                pc = p[:, c] * maskc  # (n,) full-precision probabilities
-                xw = xh * pc.astype(hd)[:, None]
-                return (
-                    jax.lax.dot_general(
-                        xw, xh, (((0,), (0,)), ((), ())),
-                        preferred_element_type=accum,
-                        # Fast-precision is safe here because these blocks
-                        # only set the MM step DIRECTION; the fixed point
-                        # is pinned by the exact full-precision gradient
-                        # above (approximate-Hessian/exact-gradient).
-                        precision=jax.lax.Precision.DEFAULT,
-                    ),
-                    jnp.sum(xw, axis=0, dtype=accum),
-                    jnp.sum(pc),
+            if _curv_kernel_ok(*x.shape):
+                # Shared-tile kernel: each VMEM-resident x tile feeds a
+                # class GROUP's GEMMs, dividing the C× HBM re-read of x —
+                # the cost that capped this pass at 0.85× (see
+                # ops/pallas_kernels.softmax_curvature_pallas).
+                pm = (p * maskc[:, None]).astype(jnp.float32)
+                bhw, bhwb = softmax_curvature_pallas(
+                    xh, pm, block_c=softmax_curv_block_c(x.shape[1], C)
                 )
+                bhbb = jnp.sum(pm, axis=0).astype(accum)
+            else:
 
-            # Sequential over classes: a batched einsum would materialize
-            # an (C, n, d) intermediate; C GEMMs stream x from VMEM/HBM.
-            bhw, bhwb, bhbb = jax.lax.map(per_class, jnp.arange(C))
+                def per_class(c):
+                    pc = p[:, c] * maskc  # (n,) full-precision probabilities
+                    xw = xh * pc.astype(hd)[:, None]
+                    return (
+                        jax.lax.dot_general(
+                            xw, xh, (((0,), (0,)), ((), ())),
+                            preferred_element_type=accum,
+                            # Fast-precision is safe here because these
+                            # blocks only set the MM step DIRECTION; the
+                            # fixed point is pinned by the exact
+                            # full-precision gradient above
+                            # (approximate-Hessian/exact-gradient).
+                            precision=jax.lax.Precision.DEFAULT,
+                        ),
+                        jnp.sum(xw, axis=0, dtype=accum),
+                        jnp.sum(pc),
+                    )
+
+                # Sequential over classes: a batched einsum would
+                # materialize an (C, n, d) intermediate; C GEMMs stream x
+                # from VMEM/HBM.
+                bhw, bhwb, bhbb = jax.lax.map(per_class, jnp.arange(C))
             return (
                 gw + jax.lax.psum(
                     jax.lax.dot_general(xc, r, (((0,), (0,)), ((), ())),
@@ -579,6 +621,7 @@ def _stream_softmax_stats_cached(mesh: Mesh, n_classes: int, ad: str, cd: str):
         in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(),
                   P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(),) * 7,
+        check_vma=False,  # pallas_call out_shapes carry no vma annotation
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -605,14 +648,22 @@ def _stream_multinomial_step_fn(reg: float, fit_intercept: bool, ad: str):
         h_bb = hbb / n  # (C,)
 
         def solve_c(hww_c, hwb_c, hbb_c, gwc, gbc):
+            # h_ww is Xᵀdiag(p)X/n + reg·I — symmetric PD (PSD + the MM
+            # floor; n ≫ d in every streaming fit): ONE Cholesky per class
+            # with both right-hand sides back-substituted together, where
+            # two jnp.linalg.solve calls paid two LU factorizations
+            # (measured 35.9 → ~9 ms for the C=32, d=1024 step).
+            cho = jax.scipy.linalg.cho_factor(hww_c, lower=True)
             if fit_intercept:
-                hinv_hwb = jnp.linalg.solve(hww_c, hwb_c)
-                hinv_gw = jnp.linalg.solve(hww_c, gwc)
+                sol = jax.scipy.linalg.cho_solve(
+                    cho, jnp.stack([hwb_c, gwc], axis=1)
+                )
+                hinv_hwb, hinv_gw = sol[:, 0], sol[:, 1]
                 schur = jnp.maximum(hbb_c - hwb_c @ hinv_hwb, 1e-12)
                 db = (gbc - hwb_c @ hinv_gw) / schur
                 dw = hinv_gw - hinv_hwb * db
                 return dw, db
-            return jnp.linalg.solve(hww_c, gwc), jnp.zeros((), accum)
+            return jax.scipy.linalg.cho_solve(cho, gwc), jnp.zeros((), accum)
 
         dw, db = jax.vmap(solve_c)(h_w, h_wb, h_bb, grad_w.T, grad_b)
         new_W = W - dw.T

@@ -866,6 +866,127 @@ def _linreg_stats_kernel(x_ref, y_ref, m_ref, g_ref, xty_ref, cs_ref, ys_ref):
     )
 
 
+# ---------------------------------------------------------------------------
+# Multinomial MM curvature: the C per-class Xᵀdiag(p_c)X blocks with x
+# streamed through HBM once per class GROUP (shared tile), not once per class
+# ---------------------------------------------------------------------------
+
+
+#: 1024-row blocks: K=1024 per class GEMM ran 262 vs 185 TF/s for K=512 on
+#: the measured config (d=1024, C=32, v5e) — deeper contractions amortize
+#: the per-class accumulator switch.
+SOFTMAX_CURV_BLOCK_N = 1024
+#: VMEM budget for the resident (block_c, d, d) f32 accumulator stack; the
+#: group width adapts to d (softmax_curv_block_c) so the budget, not the
+#: class count, caps residency.
+SOFTMAX_CURV_VMEM_BUDGET = 48 * 2**20
+
+
+def softmax_curv_block_c(d: int, n_classes: int) -> int:
+    """Class-group width: largest POWER OF TWO whose (Cb, d, d) f32
+    accumulator stack fits the VMEM budget (≥1; measured: 8 beats the
+    non-power 12 at d=1024 — Mosaic tiles power-of-two stacks better)."""
+    cap = max(1, min(n_classes, SOFTMAX_CURV_VMEM_BUDGET // (4 * d * d)))
+    return 1 << (cap.bit_length() - 1)
+
+
+def _softmax_curv_kernel(x_ref, p_ref, hw_ref, hwb_ref, *, block_c):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        hw_ref[:] = jnp.zeros_like(hw_ref)
+        hwb_ref[:] = jnp.zeros_like(hwb_ref)
+
+    x = x_ref[:]  # (bn, d) compute dtype — read ONCE for all block_c classes
+    p = p_ref[:]  # (bn, block_c) f32 pre-masked probabilities
+    for c in range(block_c):  # static unroll; accumulators stay VMEM-resident
+        xw = x * p[:, c : c + 1].astype(x.dtype)
+        # Curvature blocks are the MM preconditioner, not the answer (the
+        # exact gradient pins the fixed point — models/logistic_regression
+        # .py): fast DEFAULT precision, f32 accumulate.
+        hw_ref[c] += jax.lax.dot_general(
+            xw, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
+        )
+        # The intercept border Xᵀp_c rides the same tile on the VPU.
+        hwb_ref[c : c + 1, :] += jnp.sum(
+            xw.astype(jnp.float32), axis=0, keepdims=True
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "block_c", "interpret"))
+def softmax_curvature_pallas(
+    x: jax.Array,
+    p: jax.Array,
+    block_n: int = SOFTMAX_CURV_BLOCK_N,
+    block_c: int = 8,
+    interpret: bool = False,
+):
+    """Per-class curvature hw[c] = Xᵀdiag(p_c)X and border hwb[c] = Xᵀp_c
+    for every class, with x read from HBM once per class GROUP.
+
+    The XLA lowering of the per-class loop
+    (models/logistic_regression._stream_softmax_stats) re-reads the (n, d)
+    operand for every one of the C classes — at C=32, d=1024 bf16 that
+    traffic caps the multinomial MM pass at ~0.85× the A100 convention
+    (benchmarks/README.md). Here each VMEM-resident x tile feeds block_c
+    class GEMMs before the next tile loads, dividing x traffic by block_c
+    (the one-HBM-pass partition-kernel idiom of ``linreg_stats_pallas`` /
+    the reference's dgemmCov, rapidsml_jni.cu:109-127, extended over a
+    class axis). One ``pallas_call`` per class group — the group's p
+    columns arrive as their own (n, block_c) operand, whose full last dim
+    keeps every block shape legal under Mosaic's lane tiling for ANY
+    block_c.
+
+    x: (n, d) compute dtype (bfloat16 = the intended speed mode);
+    p: (n, C) f32 — softmax probabilities ALREADY masked (p · row_mask).
+    The last group may be narrower than block_c.
+    Returns (hw (C, d, d) f32, hwb (C, d) f32).
+    """
+    n, d = x.shape
+    n_classes = p.shape[1]
+    bn = min(block_n, n)
+    if n % bn:
+        raise ValueError(f"n={n} not divisible by block_n={bn}")
+    bc = min(block_c, n_classes)
+    if bc * d * d * 4 > SOFTMAX_CURV_VMEM_BUDGET:
+        raise ValueError(
+            f"block_c={bc}, d={d}: accumulator stack exceeds the VMEM budget"
+        )
+    pf = jnp.asarray(p, jnp.float32)
+    hw_parts, hwb_parts = [], []
+    for g0 in range(0, n_classes, bc):
+        gc = min(bc, n_classes - g0)
+        hw_g, hwb_g = pl.pallas_call(
+            functools.partial(_softmax_curv_kernel, block_c=gc),
+            grid=(n // bn,),
+            in_specs=[
+                pl.BlockSpec((bn, d), lambda i: (i, 0)),
+                pl.BlockSpec((bn, gc), lambda i: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((gc, d, d), lambda i: (0, 0, 0)),
+                pl.BlockSpec((gc, d), lambda i: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((gc, d, d), jnp.float32),
+                jax.ShapeDtypeStruct((gc, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+                vmem_limit_bytes=100 * 2**20,
+            )
+            if not interpret
+            else None,
+            interpret=interpret,
+        )(x, jax.lax.slice_in_dim(pf, g0, g0 + gc, axis=1))
+        hw_parts.append(hw_g)
+        hwb_parts.append(hwb_g)
+    if len(hw_parts) == 1:
+        return hw_parts[0], hwb_parts[0]
+    return jnp.concatenate(hw_parts), jnp.concatenate(hwb_parts)
+
+
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
 def linreg_stats_pallas(
     x: jax.Array,

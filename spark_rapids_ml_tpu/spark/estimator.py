@@ -459,20 +459,31 @@ class _SparkAdapter:
 
         shards = []
         try:
-            # The first build is the quantizer owner (ivf) — it must run
-            # before the peers; the peers' dataset-sized builds are then
-            # independent and run CONCURRENTLY (fit wall-clock = first +
-            # max of the rest, not the sum over daemons).
-            first_info, first_shard = _finalize_shard(daemon_ids[0], first=True)
-            shards.append(first_shard)
-            rest = daemon_ids[1:]
-            if rest:
-                from concurrent.futures import ThreadPoolExecutor
+            from concurrent.futures import ThreadPoolExecutor
 
-                cent = first_info["centroids"] if ivf else None
+            if ivf and multi:
+                # The first build is the quantizer owner — it must run
+                # before the peers; the peers' dataset-sized builds are
+                # then independent and run CONCURRENTLY (fit wall-clock =
+                # first + max of the rest, not the sum over daemons).
+                first_info, first_shard = _finalize_shard(
+                    daemon_ids[0], first=True
+                )
+                shards.append(first_shard)
+                cent = first_info["centroids"]
+                rest = daemon_ids[1:]
                 with ThreadPoolExecutor(max_workers=min(len(rest), 16)) as ex:
                     futs = [ex.submit(_finalize_shard, did, cent)
                             for did in rest]
+                    shards.extend(f.result()[1] for f in futs)
+            else:
+                # Exact mode (or one daemon): no cross-shard dependency —
+                # every build runs concurrently.
+                with ThreadPoolExecutor(
+                    max_workers=min(len(daemon_ids), 16)
+                ) as ex:
+                    futs = [ex.submit(_finalize_shard, did)
+                            for did in daemon_ids]
                     shards.extend(f.result()[1] for f in futs)
         except Exception:
             _cleanup(drop_models=[name])
@@ -1024,6 +1035,28 @@ _KNN_OUTPUTS = (
 )
 
 
+def _fanout_kneighbors(ex, shard_clients, name, queries, k, input_col,
+                       descending):
+    """Query every shard daemon concurrently and merge top-k — the ONE
+    implementation both the executor task and the driver handle use.
+    ``ex``: a ThreadPoolExecutor (caller-owned, reusable across batches);
+    ``shard_clients``: [((addr, shard_rows), client)] with one client per
+    shard (no socket sharing across threads). Per-batch latency is the
+    slowest shard, not the sum."""
+    from spark_rapids_ml_tpu.models.knn import merge_topk
+
+    def one(entry):
+        (_addr, n_shard), c = entry
+        return c.kneighbors(name, queries, k=min(k, n_shard),
+                            input_col=input_col)
+
+    results = list(ex.map(one, shard_clients))
+    return merge_topk(
+        [d for d, _ in results], [i for _, i in results], k,
+        descending=descending,
+    )
+
+
 class _DaemonKNNTask:
     """Executor-side query feeder: each batch's query rows go to the
     daemon's ``kneighbors`` op; neighbor distance/index columns come
@@ -1043,29 +1076,9 @@ class _DaemonKNNTask:
         self._shards = shards
         self._descending = descending
 
-    def _query_shards(self, table, clients):
-        from concurrent.futures import ThreadPoolExecutor
-
-        from spark_rapids_ml_tpu.models.knn import merge_topk
-
-        def one(entry):
-            (addr, n_shard), c = entry
-            return c.kneighbors(
-                self._name, table,
-                k=min(self._k, n_shard), input_col=self._input_col,
-            )
-
-        # Concurrent fan-out: the per-shard searches are independent, so
-        # per-batch latency is the SLOWEST shard, not the sum (each shard
-        # has its own client/socket — no connection sharing across threads).
-        with ThreadPoolExecutor(max_workers=min(len(clients), 16)) as ex:
-            results = list(ex.map(one, clients))
-        per_d = [d for d, _ in results]
-        per_i = [i for _, i in results]
-        return merge_topk(per_d, per_i, self._k, descending=self._descending)
-
     def __call__(self, batches):
         import contextlib
+        from concurrent.futures import ThreadPoolExecutor
 
         import pyarrow as pa
 
@@ -1079,6 +1092,11 @@ class _DaemonKNNTask:
                         *ds._parse_addr(s[0]), token=self.token)))
                     for s in self._shards
                 ]
+                # One pool for the task's lifetime (threads reused across
+                # batches, like the clients above).
+                ex = stack.enter_context(
+                    ThreadPoolExecutor(max_workers=min(len(clients), 16))
+                )
             else:
                 h, p = ds.executor_daemon_address(self.host, self.port)
                 clients = [
@@ -1092,7 +1110,10 @@ class _DaemonKNNTask:
                     continue
                 q = table.select([self._input_col])
                 if self._shards:
-                    dists, idx = self._query_shards(q, clients)
+                    dists, idx = _fanout_kneighbors(
+                        ex, clients, self._name, q, self._k,
+                        self._input_col, self._descending,
+                    )
                 else:
                     dists, idx = clients[0][1].kneighbors(
                         self._name, q, k=self._k, input_col=self._input_col
@@ -1166,25 +1187,22 @@ class _DaemonKNNModel:
                 return c.kneighbors(
                     self._name, queries, k=k, input_col=self._input_col
                 )
+        import contextlib
         from concurrent.futures import ThreadPoolExecutor
 
-        from spark_rapids_ml_tpu.models.knn import merge_topk
-
-        def one(shard):
-            addr, n_shard = shard
-            h, p = daemon_session._parse_addr(addr)
-            with DataPlaneClient(h, p, token=self._token) as c:
-                return c.kneighbors(
-                    self._name, queries, k=min(k, n_shard),
-                    input_col=self._input_col,
-                )
-
-        with ThreadPoolExecutor(max_workers=min(len(self._shards), 16)) as ex:
-            results = list(ex.map(one, self._shards))
-        return merge_topk(
-            [d for d, _ in results], [i for _, i in results], k,
-            descending=self._descending(),
-        )
+        with contextlib.ExitStack() as stack:
+            clients = [
+                (s, stack.enter_context(DataPlaneClient(
+                    *daemon_session._parse_addr(s[0]), token=self._token)))
+                for s in self._shards
+            ]
+            ex = stack.enter_context(
+                ThreadPoolExecutor(max_workers=min(len(clients), 16))
+            )
+            return _fanout_kneighbors(
+                ex, clients, self._name, queries, k, self._input_col,
+                self._descending(),
+            )
 
     def transform(self, dataset):
         """Distributed query: appends knn_distances (list<double>) and
