@@ -114,7 +114,7 @@ def main() -> None:
     reps = int(os.environ.get("SRML_BENCH_REPS", 8))
 
     def measure(rerank: bool, slack: float = SLACK, nprobe: int = NPROBE,
-                rerank_width: int = 0, extract: str = "wide"):
+                rerank_width: int = 0, extract: str = "auto"):
         """(q/s, recall@10) at one operating point — BOTH points are
         emitted every run (r2 review: the default config ships
         rerank=on, the headline ran rerank=off; report both always)."""
@@ -177,6 +177,7 @@ def main() -> None:
                 slack=float(kv.get("slack", SLACK)),
                 nprobe=int(kv.get("nprobe", NPROBE)),
                 rerank_width=int(kv.get("rw", 0)),
+                extract=kv.get("extract", "auto"),
             )
             emit(
                 "ivfflat_ab_" + spec.replace("=", "").replace(",", "_"),

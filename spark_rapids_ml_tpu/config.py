@@ -142,12 +142,13 @@ _DEFAULTS: Dict[str, Any] = {
     # width; benchmarks/README.md).
     "ann_rerank_width": _env("ANN_RERANK_WIDTH", 0, int),
     # Fused-kernel per-(list, slot) extraction width under rerank:
-    # "wide" (default) extracts shortlist_mult·k so the exact rerank can
-    # rescue within-(list, slot) bf16 boundary misses; "narrow" extracts
-    # k — the extraction cost scales with the width, measured 151k → 177k
-    # q/s for recall@10 0.9706 → 0.9577 at the bench point (rerank-off
-    # configs always extract k; benchmarks/README.md round-4 frontier).
-    "ann_extract": _env("ANN_EXTRACT", "wide", str),
+    # "auto" (default) = ceil(1.2·k) — the round-5 measured frontier
+    # point (177k q/s @ recall@10 0.9700 vs "wide"'s 153k @ 0.9706 at
+    # the bench shape: the rerank's R = 2k selection caps what wider
+    # extraction can feed it). "wide" = shortlist_mult·k, "narrow" = k
+    # (183k @ 0.9577), an integer = width in rows. Rerank-off configs
+    # always extract k; benchmarks/README.md round-5 frontier.
+    "ann_extract": _env("ANN_EXTRACT", "auto", str),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

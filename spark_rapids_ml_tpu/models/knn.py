@@ -965,16 +965,35 @@ def _bucketed_core(
     # the *selection* cannot remove.
     # Extraction width is the rerank-on speed/recall dial (round-4 stage
     # profile: the fused kernel's per-slot extraction cost scales with
-    # blk_k, and the mult·k width is ~4 ms of the rerank-on query at the
-    # bench shape). "narrow" extracts k per (list, slot) even under
-    # rerank — measured 151k → 177k q/s for recall@10 0.9706 → 0.9577 at
-    # the bench point (within-(list, slot) boundary misses the rerank
-    # can then no longer rescue) — config ann_extract.
-    narrow = str(extract).lower() == "narrow"
-    blk_k = min(
-        k if (use_fused and (not rerank or narrow)) else shortlist_mult * k,
-        maxlen,
-    )
+    # blk_k). Round-5 same-run sweep at the bench point (k=10, exact-GT
+    # recall@10 beside each): extract 10 ("narrow") 183k @ 0.9577; 12 →
+    # 177k @ 0.9700; 14 → 169k @ 0.9706; 20 ("wide" = mult·k) → 153k @
+    # 0.9706 — the rerank's R = 2k selection caps what extra extraction
+    # can feed it, so ~1.2k captures the full rescue at +16% q/s.
+    # "auto" (default) = ceil(1.2·k) under fused rerank; an integer sets
+    # the width in rows; "narrow"/"wide" = k / mult·k — config
+    # ann_extract. The XLA (non-fused) scan always extracts mult·k: its
+    # APPROXIMATE per-slot selection needs the slack exactness removes.
+    ext = str(extract).lower()
+    ext_rows = int(ext) if ext.isascii() and ext.isdigit() else None
+    if ext_rows is None and ext not in ("auto", "wide", "narrow"):
+        raise ValueError(
+            f"ann_extract={extract!r}: expected 'auto', 'wide', 'narrow' "
+            "or an integer row width"
+        )
+    if use_fused:
+        if not rerank:
+            blk_k = min(k, maxlen)  # exact selection answers directly
+        elif ext_rows is not None:
+            blk_k = min(max(ext_rows, k), maxlen)
+        elif ext == "narrow":
+            blk_k = min(k, maxlen)
+        elif ext == "wide":
+            blk_k = min(shortlist_mult * k, maxlen)
+        else:  # auto: ceil(1.2·k), the measured rerank frontier point
+            blk_k = min(-(-12 * k // 10), maxlen)
+    else:
+        blk_k = min(shortlist_mult * k, maxlen)
     if nprobe * blk_k < k:
         raise ValueError(
             f"k={k} exceeds the bucketed candidate pool nprobe*maxlen="
@@ -1140,7 +1159,9 @@ def _bucketed_core(
     negR = -negd_R
     wl = jnp.take_along_axis(cand_list, posR, axis=1)  # (q, R)
     wp = jnp.take_along_axis(cand_pos, posR, axis=1)
-    ids_R = ids_p[wl, wp]  # (q, R); -1 for padded-row candidates
+    # Flat single-level id gather (same lesson as the row gather below:
+    # the 2-level [wl, wp] form lowers poorly in-graph).
+    ids_R = ids_p.reshape(-1)[wl * maxlen + wp]  # (q, R); -1 = padded row
     # (Round-4 negative result: rescoring from the bf16 residual
     # reconstruction c + r̃ — dropping the raw f32 lists from the graph —
     # measured BOTH slower (141 vs 151k q/s: two gathers + extra
