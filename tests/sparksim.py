@@ -250,18 +250,23 @@ class SimDataFrame:
                         max_chunksize=max(1, part.num_rows // 2 or 1)
                     )
                     plan = self._fail_plan.get(pid, [])
-                    result = None
+                    result, last_err = None, None
                     for attempt in range(self._max_attempts):
                         fail_after = plan[attempt] if attempt < len(plan) else None
-                        result = self._one_attempt(
+                        result, last_err = self._one_attempt(
                             ctx, pid, attempt, batches, fail_after
                         )
                         if result is not None:
                             break
                     if result is None:
+                        # Spark's job-abort message carries the most recent
+                        # task failure — the operator must see WHY (e.g. a
+                        # peer daemon rejecting unseeded kmeans feeds), not
+                        # just that attempts ran out.
                         raise RuntimeError(
                             f"partition {pid} failed {self._max_attempts} "
-                            "attempts (Spark would abort the job here)"
+                            "attempts (Spark would abort the job here); "
+                            f"most recent failure: {last_err}"
                         )
                     results[pid] = result
                     if pid in self._speculative:
@@ -308,13 +313,13 @@ class SimDataFrame:
         finally:
             proc.join(timeout=30)
         if status != "ok":
-            return None
+            return None, payload  # payload = repr of the task's exception
         out = []
         for d in payload:
             n = len(next(iter(d.values()))) if d else 0
             for i in range(n):
                 out.append(SimRow({k: v[i] for k, v in d.items()}))
-        return out
+        return out, None
 
 
 def simdf_from_numpy(

