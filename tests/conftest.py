@@ -11,12 +11,24 @@ test exercises the float32 TPU-native mode with wider tolerance.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image pre-sets a TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "True")
+# Per-SESSION persistent compilation cache, inherited by every spawned
+# worker process (daemon workers, multiproc ranks, forkserver tasks): the
+# 2-OS-process tests compile identical programs in both workers — a shared
+# cache turns the twin's compile into a disk hit. Ephemeral dir: a fresh
+# ``pytest`` run measures honest first-compile cost once, not stale state.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="srml-jax-cache-"
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 # Package dtype defaults for parity testing (overridden per-test via
 # config.option for float32-mode tests).
 os.environ.setdefault("SRML_TPU_ACCUM_DTYPE", "float64")

@@ -193,7 +193,7 @@ def test_kmeans_iterative_job_matches_stream_fit(daemon, rng, mesh8):
     perm = rng.permutation(len(pts))
     pts = pts[perm]
     parts = np.array_split(pts, 4)
-    k, seed, passes = 4, 7, 8
+    k, seed, passes = 4, 7, 5
 
     with _client(daemon) as c:
         for it in range(passes):
@@ -232,7 +232,7 @@ def test_logreg_iterative_job_matches_stream_fit(daemon, rng, mesh8):
     x = rng.normal(size=(1200, 10)).astype(np.float32)
     y = (x @ w_true + 0.2 > 0).astype(np.float32)
     parts = [(x[i : i + 300], y[i : i + 300]) for i in range(0, 1200, 300)]
-    reg, passes = 1e-3, 12
+    reg, passes = 1e-3, 6
 
     with _client(daemon) as c:
         for it in range(passes):

@@ -150,10 +150,10 @@ def test_spark_kmeans_retry_mid_pass(rng, mesh8):
     # concurrency=1: bitwise clean-vs-flaky comparison on float sums
     # needs ordered commits (see the determinism test above).
     clean = simdf_from_numpy(x, n_partitions=3, concurrency=1)
-    m_clean = SparkKMeans().setK(k).setMaxIter(6).setSeed(1).fit(clean)
+    m_clean = SparkKMeans().setK(k).setMaxIter(4).setSeed(1).fit(clean)
     flaky = simdf_from_numpy(x, n_partitions=3, fail_plan={0: [1]},
                              concurrency=1)
-    m_flaky = SparkKMeans().setK(k).setMaxIter(6).setSeed(1).fit(flaky)
+    m_flaky = SparkKMeans().setK(k).setMaxIter(4).setSeed(1).fit(flaky)
     np.testing.assert_array_equal(m_clean.centers, m_flaky.centers)
 
 
@@ -413,7 +413,7 @@ def test_spark_logreg_multiclass_fit_and_transform(rng, mesh8):
     y = np.argmax(x @ w, axis=1).astype(np.float64)
     df = simdf_from_numpy(x, n_partitions=3, label=y)
     model = (
-        SparkLogisticRegression().setRegParam(1e-2).setMaxIter(15).fit(df)
+        SparkLogisticRegression().setRegParam(1e-2).setMaxIter(8).fit(df)
     )
     assert df.sparkSession.driver_rows_materialized == 0
     assert model.coefficients.shape == (C, d)
@@ -423,7 +423,7 @@ def test_spark_logreg_multiclass_fit_and_transform(rng, mesh8):
         return iter([(x[i : i + 200], y[i : i + 200]) for i in range(0, n, 200)])
 
     ref = fit_multinomial_stream(
-        src, d, C, reg=1e-2, max_iter=15, tol=1e-6, mesh=mesh8
+        src, d, C, reg=1e-2, max_iter=8, tol=1e-6, mesh=mesh8
     )
     np.testing.assert_allclose(model.coefficients, ref.coefficients, atol=1e-6)
     rows = model.transform(df).collect()

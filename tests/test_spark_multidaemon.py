@@ -199,7 +199,7 @@ def test_multinomial_logreg_two_daemons_matches_single(rng, mesh8,
         x, n_partitions=4, label=y,
         session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
     )
-    m_single = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(12).fit(
+    m_single = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(8).fit(
         single
     )
     assert np.asarray(m_single.coefficients).shape == (C, d)
@@ -207,7 +207,7 @@ def test_multinomial_logreg_two_daemons_matches_single(rng, mesh8,
     session, env_plan = _split_session(a, b)
     split = simdf_from_numpy(x, n_partitions=4, label=y, session=session,
                              env_plan=env_plan)
-    m_split = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(12).fit(
+    m_split = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(8).fit(
         split
     )
     np.testing.assert_allclose(
@@ -562,6 +562,7 @@ def test_two_daemon_processes_end_to_end(rng, mesh8):
     single-pass (PCA) and an iterative (KMeans) algorithm."""
     workers = []
     try:
+        procs = []
         for _ in range(2):
             env = {
                 k: v for k, v in os.environ.items()
@@ -572,12 +573,15 @@ def test_two_daemon_processes_end_to_end(rng, mesh8):
             env["PYTHONPATH"] = os.pathsep.join(
                 p for p in (repo_root, env.get("PYTHONPATH")) if p
             )
-            proc = subprocess.Popen(
+            # Spawn BOTH workers before reading either READY line: the
+            # two ~4 s jax imports overlap instead of serializing.
+            procs.append(subprocess.Popen(
                 [sys.executable, os.path.join(os.path.dirname(__file__),
                                               "daemon_worker.py")],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 cwd=repo_root, env=env, text=True,
-            )
+            ))
+        for proc in procs:
             line = proc.stdout.readline().strip()
             assert line.startswith("READY "), line
             workers.append((proc, int(line.split()[1])))
