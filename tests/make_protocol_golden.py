@@ -374,6 +374,48 @@ def multihost_transcript_frames() -> tuple[list, list]:
     _req({"v": V, "op": "drop", "job": "g-mkm"})
     expect.append(("json", {"ok": True, "dropped": True}))
 
+    # 15+. Sharded KNN build (additive, round 5 — docs/protocol.md
+    # "Sharded index across daemons"): two shard jobs on this one daemon
+    # stand in for two daemons. Each holds one partition; finalize
+    # translates local→global ids via row_id_base; shard A returns its
+    # trained quantizer (return_centroids), shard B buckets against
+    # transcript-FIXED centroids (the live flow forwards A's returned
+    # quantizer, but a recorded byte stream must carry fixed bytes — the
+    # framing is what is frozen, not the float values).
+    for pid, job in ((0, "g-shA"), (1, "g-shB")):
+        part = (x[:4] if pid == 0 else x[4:]).astype(np.float32)
+        spec, bufs = _raw_spec({"x": part})
+        _req({"v": V, "op": "feed_raw", "job": job, "algo": "knn",
+              "n_cols": 3, "params": {}, "partition": pid, "attempt": 0,
+              "pass_id": None, "arrays": spec}, bufs)
+        expect.append(("json", {"ok": True}))
+        _req({"v": V, "op": "commit", "job": job, "partition": pid,
+              "attempt": 0, "pass_id": None})
+        expect.append(("json", {"ok": True, "rows": 4}))
+    _req({"v": V, "op": "finalize", "job": "g-shA",
+          "params": {"mode": "ivf", "register_as": "g-idxA", "nlist": 2,
+                     "nprobe": 2, "seed": 0, "metric": "euclidean",
+                     "row_id_base": {"0": 0}, "return_centroids": True},
+          "drop": True})
+    expect.append(("arrays", {"ok": True, "rows": 4, "model": "g-idxA"}))
+    cent = np.asarray([[0.5, 0.0, -0.5], [-0.5, 0.5, 0.0]], np.float32)
+    spec, bufs = _raw_spec({"centroids": cent})
+    _req({"v": V, "op": "finalize", "job": "g-shB",
+          "params": {"mode": "ivf", "register_as": "g-idxB", "nlist": 2,
+                     "nprobe": 2, "seed": 0, "metric": "euclidean",
+                     "row_id_base": {"1": 4}},
+          "drop": True, "arrays": spec}, bufs)
+    expect.append(("arrays", {"ok": True, "rows": 4, "model": "g-idxB"}))
+    # Query each shard: a caller merges per-shard top-k; ids are GLOBAL.
+    for model in ("g-idxA", "g-idxB"):
+        _req({"v": V, "op": "kneighbors", "model": model, "k": 2,
+              "input_col": "features", "n_cols": None},
+             [("arrow", _ipc_bytes(x[:2]))])
+        expect.append(("arrays", {"ok": True, "rows": 2}))
+    for model in ("g-idxA", "g-idxB"):
+        _req({"v": V, "op": "drop_model", "model": model})
+        expect.append(("json", {"ok": True, "dropped": True}))
+
     return frames, expect
 
 

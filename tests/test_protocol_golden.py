@@ -264,7 +264,8 @@ def test_replay_multihost_transcript(daemon):
     finally:
         sock.close()
 
-    export, pca_raw, pca_raw2, linreg, iterate = results
+    (export, pca_raw, pca_raw2, linreg, iterate,
+     shard_a, shard_b, knn_a, knn_b) = results
     assert export, "export_state returned no state arrays"
     np.testing.assert_allclose(pca_raw["pc"], pca_raw2["pc"], atol=1e-12)
     np.testing.assert_allclose(
@@ -272,6 +273,17 @@ def test_replay_multihost_transcript(daemon):
     )
     np.testing.assert_allclose(float(linreg["intercept"][0]), 0.5, atol=1e-6)
     assert iterate["centers"].shape == (2, 3)
+    # Sharded-KNN extensions: shard A hands back its trained quantizer;
+    # both shards answer in the GLOBAL partition-major id space (A holds
+    # rows 0-3, B rows 4-7), so a caller-side top-k merge needs no
+    # translation; the queried rows ARE shard A's first two rows.
+    assert shard_a["centroids"].shape == (2, 3)
+    assert int(shard_b["n_rows"][0]) == 4
+    ids_a = np.asarray(knn_a["indices"])
+    ids_b = np.asarray(knn_b["indices"])
+    assert set(ids_a.ravel()) <= set(range(0, 4))
+    assert set(ids_b.ravel()) <= set(range(4, 8))
+    assert ids_a[:, 0].tolist() == [0, 1]  # self-hits, globally numbered
 
 
 def test_multihost_generator_matches_committed_fixture():
