@@ -502,6 +502,45 @@ def test_ivf_two_daemons_partial_probe_recall(rng, mesh8, two_daemons):
     model.release()
 
 
+def test_exact_knn_three_daemons_matches_single(rng, mesh8):
+    """N>2 shards: quantizer-less exact mode with a THREE-way fan-out —
+    covers the concurrent peer builds and the 3-way merge (the 2-daemon
+    tests can't distinguish per-peer from all-peers logic)."""
+    from spark_rapids_ml_tpu.spark.estimator import SparkNearestNeighbors
+
+    with DataPlaneDaemon(ttl=600.0) as a, DataPlaneDaemon(ttl=600.0) as b, \
+            DataPlaneDaemon(ttl=600.0) as c:
+        n, d, k = 450, 8, 6
+        x = rng.normal(size=(n, d)).astype(np.float64)
+        # Perturbed queries (not exact rows): a zero self-distance's f64
+        # Gram-trick cancellation noise would dominate the tolerance.
+        q = x[:30] + 0.01 * rng.normal(size=(30, d))
+        single = simdf_from_numpy(
+            x, n_partitions=6,
+            session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+        )
+        m_single = SparkNearestNeighbors().setK(k).fit(single)
+        d1, i1 = m_single.kneighbors(q)
+
+        session = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+        env_plan = {
+            2: {"SRML_DAEMON_ADDRESS": _addr(b)},
+            3: {"SRML_DAEMON_ADDRESS": _addr(b)},
+            4: {"SRML_DAEMON_ADDRESS": _addr(c)},
+            5: {"SRML_DAEMON_ADDRESS": _addr(c)},
+        }
+        split = simdf_from_numpy(x, n_partitions=6, session=session,
+                                 env_plan=env_plan)
+        m_split = SparkNearestNeighbors().setK(k).fit(split)
+        assert m_split.shards is not None and len(m_split.shards) == 3
+        assert sum(r for _, r in m_split.shards) == n
+        d2_, i2 = m_split.kneighbors(q)
+        np.testing.assert_array_equal(i2, i1)
+        np.testing.assert_allclose(d2_, d1, rtol=0, atol=1e-12)
+        m_split.release()
+        m_single.release()
+
+
 def test_knn_single_daemon_via_override_serves_where_built(rng, mesh8,
                                                            two_daemons):
     """ALL partitions routed to daemon B by the executor-local override
