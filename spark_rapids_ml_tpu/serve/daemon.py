@@ -523,9 +523,10 @@ class _Job:
                 raise KeyError("job was finalized/dropped")
             if self.algo == "knn":
                 raise ValueError(
-                    "knn job state is the dataset itself — route every "
-                    "executor to ONE daemon for knn fits (the index builds "
-                    "and serves there); see docs/protocol.md"
+                    "knn job state is the dataset itself and does not "
+                    "merge across daemons — multi-daemon knn fits instead "
+                    "BUILD A SHARD per daemon (finalize with row_id_base; "
+                    "docs/protocol.md 'Sharded index across daemons')"
                 )
             self.touched = self._clock()
             leaves = jax.tree_util.tree_leaves(self.state)

@@ -23,8 +23,10 @@ RapidsPCA.scala:128-161), falling back to a collect-based path for old
 PySpark.
 
 pyspark is optional: import of this module never requires it; calling
-``fit``/``transform`` with a Spark DataFrame does. Algorithms without a
-daemon protocol (KNN — the model IS the dataset) use an Arrow collect.
+``fit``/``transform`` with a Spark DataFrame does. KNN/ANN fits stream
+rows to the daemon(s) like everything else; with multiple daemons the
+index is built and served as PER-DAEMON SHARDS with fan-out/merge
+queries (``_fit_knn``) — nothing ever collects to the driver.
 """
 
 from __future__ import annotations
