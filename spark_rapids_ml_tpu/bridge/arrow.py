@@ -32,6 +32,7 @@ except ImportError:  # pragma: no cover - pyarrow is expected in this image
     pa = None
 
 from spark_rapids_ml_tpu.bridge import native as _native
+from spark_rapids_ml_tpu.utils import faults
 
 _FLOAT_TYPES = ("float", "double", "halffloat")
 
@@ -48,6 +49,7 @@ def list_column_to_matrix(col, n_cols: Optional[int] = None) -> np.ndarray:
     nulls and an unsliced contiguous child buffer.
     """
     _require_pa()
+    faults.checkpoint("bridge.to_matrix")
     if isinstance(col, pa.ChunkedArray):
         if col.num_chunks == 1:
             return _array_to_matrix(col.chunk(0), n_cols)
@@ -119,6 +121,7 @@ def matrix_to_list_column(mat: np.ndarray):
     needs no offsets buffer at all — strictly less work than the reference.
     """
     _require_pa()
+    faults.checkpoint("bridge.to_ipc")
     mat = np.ascontiguousarray(mat)
     n, d = mat.shape
     flat = pa.array(mat.reshape(-1))

@@ -149,6 +149,15 @@ _DEFAULTS: Dict[str, Any] = {
     # (183k @ 0.9577), an integer = width in rows. Rerank-off configs
     # always extract k; benchmarks/README.md round-5 frontier.
     "ann_extract": _env("ANN_EXTRACT", "auto", str),
+    # Data-plane daemon backpressure watermarks (serve/daemon.py; 0 =
+    # unlimited). Past either, the daemon answers heavy ops with `busy` +
+    # a retry_after_s hint (graceful degradation) instead of accepting
+    # work it will thrash on; pressure-relieving ops always pass.
+    "daemon_max_connections": _env("DAEMON_MAX_CONNECTIONS", 0, int),
+    "daemon_max_staged_bytes": _env("DAEMON_MAX_STAGED_BYTES", 0, int),
+    # The retry hint (seconds) a shed client is told to wait; clients
+    # jitter around it so a shed fleet doesn't return as one wave.
+    "daemon_retry_after_s": _env("DAEMON_RETRY_AFTER_S", 1.0, float),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

@@ -54,6 +54,7 @@ from spark_rapids_ml_tpu.ops.distances import sq_euclidean
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
+from spark_rapids_ml_tpu.parallel.compat import shard_map
 
 
 class KMeansSolution(NamedTuple):
@@ -275,7 +276,7 @@ def _lloyd_fn(
         final_cost = jax.lax.psum(jnp.sum(min_d2 * maskc), DATA_AXIS)
         return centers, final_cost, n_iter
 
-    f = jax.shard_map(
+    f = shard_map(
         lloyd_shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
@@ -378,7 +379,7 @@ def _stream_step_fn(mesh: Mesh, k: int, cd: str, ad: str):
             cost + jax.lax.psum(bcost, DATA_AXIS),
         )
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(DATA_AXIS, None), P(DATA_AXIS)),

@@ -54,6 +54,7 @@ from spark_rapids_ml_tpu.ops.pallas_kernels import (
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding
 from spark_rapids_ml_tpu.utils.profiling import trace_span
+from spark_rapids_ml_tpu.parallel.compat import shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -108,7 +109,7 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
         final_idx = jnp.take_along_axis(cand_i, pos, axis=1)
         return -neg2, final_idx
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -1523,7 +1524,7 @@ def _ivf_query_fn_sharded(
         neg, pos = jax.lax.top_k(-cat_d, k)
         return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(

@@ -49,6 +49,7 @@ from spark_rapids_ml_tpu.ops.linalg import solve_spd
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
+from spark_rapids_ml_tpu.parallel.compat import shard_map
 
 
 class LinearRegressionTrainingSummary(NamedTuple):
@@ -130,7 +131,7 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str, use_pallas: Optional[bool]
             jax.lax.psum(v, DATA_AXIS) for v in (xtx, xty, sx, sy, syy, n)
         )
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),

@@ -47,6 +47,7 @@ from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
+from spark_rapids_ml_tpu.parallel.compat import shard_map
 
 
 class LogisticTrainingSummary(NamedTuple):
@@ -299,7 +300,7 @@ def _newton_fn_cached(
         )
         return w, b, n_iter, loss_of(w, b)
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
@@ -437,7 +438,7 @@ def _stream_grad_hess_fn(mesh: Mesh, ad: str):
                 n + jax.lax.psum(bn, DATA_AXIS),
             )
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(),
@@ -615,7 +616,7 @@ def _stream_softmax_stats_cached(
                 n + jax.lax.psum(bn, DATA_AXIS),
             )
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(),

@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
+from spark_rapids_ml_tpu.parallel.compat import shard_map
 
 
 @functools.lru_cache(maxsize=32)
@@ -57,7 +58,7 @@ def _moments_fn(mesh: Mesh, ad: str):
             s2 = jax.lax.psum(jnp.sum(jnp.square(xc), axis=0), DATA_AXIS)
             return n, s1, s2
 
-    f = jax.shard_map(
+    f = shard_map(
         shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_rapids_ml_tpu import config
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from spark_rapids_ml_tpu.parallel.compat import shard_map
 
 Stats = Tuple[jax.Array, jax.Array, jax.Array]  # (count, colsum, gram)
 
@@ -140,7 +141,7 @@ def sharded_stats(mesh: Mesh, compute_dtype=None, accum_dtype=None):
 
     One compiled SPMD program: per-shard fused stats + psum over ``data``.
     """
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(
             _stats_shard, compute_dtype=compute_dtype, accum_dtype=accum_dtype
         ),
@@ -177,7 +178,7 @@ def _stats_shard_2d(x, mask, compute_dtype, accum_dtype):
 
 def sharded_stats_2d(mesh: Mesh, compute_dtype=None, accum_dtype=None):
     """fn(x_2dsharded, mask) -> (count repl, colsum repl, gram model-sharded)."""
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(
             _stats_shard_2d, compute_dtype=compute_dtype, accum_dtype=accum_dtype
         ),
@@ -242,7 +243,7 @@ def sharded_stats_ring(mesh: Mesh, compute_dtype=None, accum_dtype=None):
     """fn(x_2dsharded, mask) -> (count repl, colsum repl, gram model-sharded),
     computed with the ppermute ring instead of all_gather."""
     n_model = mesh.shape[MODEL_AXIS]
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(
             _stats_shard_ring,
             compute_dtype=compute_dtype,
@@ -284,7 +285,7 @@ def _streaming_update_cached(mesh: Mesh, compute_dtype, accum_dtype, use_pallas:
         c, s, g = _stats_shard(x, mask, compute_dtype, accum_dtype, use_pallas)
         return count + c, colsum + s, gram + g
 
-    f = jax.shard_map(
+    f = shard_map(
         shard_update,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(DATA_AXIS, None), P(DATA_AXIS)),
@@ -375,7 +376,7 @@ def _streaming_update_rows_cached(
         g = jax.lax.psum(g, DATA_AXIS)
         return count + c, colsum + cs, gram + g
 
-    f = jax.shard_map(
+    f = shard_map(
         shard_update,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(DATA_AXIS, None), P()),

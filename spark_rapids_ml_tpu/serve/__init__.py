@@ -12,7 +12,7 @@ each batch into the on-device sharded accumulator — so the cluster-side
 reference's JVM ``RDD.reduce`` played (RapidsRowMatrix.scala:139).
 """
 
-from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+from spark_rapids_ml_tpu.serve.client import DaemonBusy, DataPlaneClient
 from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
 
-__all__ = ["DataPlaneClient", "DataPlaneDaemon"]
+__all__ = ["DaemonBusy", "DataPlaneClient", "DataPlaneDaemon"]

@@ -4,16 +4,48 @@ A task opens one connection, feeds its partition as one or more Arrow IPC
 frames, and closes; the driver (or any one caller) finalizes. Socket-level
 work only — no JAX on the executor side, mirroring how the reference keeps
 executors JVM-only and the math behind the JNI boundary.
+
+Self-healing: every op runs inside a reconnect loop. A connection-level
+failure (``ConnectionError``, ``ProtocolError``, socket timeout, any
+``OSError``) drops the cached socket, backs off with decorrelated jitter
+(utils/retry.py — pure exponential backoff would synchronize a fleet of
+executors into a thundering herd on daemon restart), reconnects, and
+replays the op. Replay is exactly-once: ``feed``/``feed_raw`` carry a
+client-generated ``feed_id`` and ``step`` a ``step_id`` that the daemon
+dedupes, ``commit``/``seed`` are idempotent by design, and reads are
+pure. A per-op deadline (``op_deadline_s``) bounds the TOTAL time spent
+healing one op, separately from the per-socket-syscall ``timeout``. A
+``busy`` response (daemon over its backpressure watermark) is honored by
+waiting the daemon's ``retry_after_s`` hint (jittered) without burning a
+reconnect attempt. ``finalize`` with ``drop=True`` is the one op replay
+cannot make idempotent — see "Client retry obligations" in
+docs/protocol.md.
 """
 
 from __future__ import annotations
 
+import random
 import socket
+import time
+import uuid
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils.logging import get_logger
+from spark_rapids_ml_tpu.utils.retry import decorrelated_jitter
+
+logger = get_logger("serve.client")
+
+
+class DaemonBusy(RuntimeError):
+    """Daemon shed the op under load; retry after ``retry_after_s``."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 class DataPlaneClient:
@@ -23,27 +55,69 @@ class DataPlaneClient:
         port: int,
         timeout: float = 120.0,
         token: Optional[str] = None,
+        op_deadline_s: Optional[float] = None,
+        max_op_attempts: int = 5,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        max_busy_wait_s: float = 60.0,
     ):
+        """``timeout`` bounds one socket syscall; ``op_deadline_s`` bounds
+        one whole op including every reconnect/replay/busy-wait (None =
+        attempts alone bound it); ``max_op_attempts`` counts connection
+        failures per op; ``max_busy_wait_s`` caps cumulative busy-shed
+        waiting per op when no deadline is set."""
         self._addr = (host, int(port))
         self._timeout = timeout
         self._token = token
         self._sock: Optional[socket.socket] = None
+        self._op_deadline = op_deadline_s
+        self._max_attempts = max(1, int(max_op_attempts))
+        self._backoff_base = backoff_base_s
+        self._backoff_max = backoff_max_s
+        self._max_busy_wait = max_busy_wait_s
+        self._rng = random.Random()
+        # Feed/step idempotency nonce: replayed ops carry the same id, so
+        # the daemon can discard a duplicate whose first ack was lost.
+        self._nonce = uuid.uuid4().hex[:12]
+        self._seq = 0
+        #: Healing counters (reconnects, replays, busy_waits) — cheap
+        #: observability for chaos tests and ops dashboards.
+        self.stats: Dict[str, int] = {
+            "reconnects": 0, "replays": 0, "busy_waits": 0,
+        }
 
     # -- connection --------------------------------------------------------
 
-    def _conn(self) -> socket.socket:
+    def _conn(self, deadline: Optional[float] = None) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection(self._addr, timeout=self._timeout)
+            faults.checkpoint("client.connect")
+            # The connect syscall honors the op deadline too: a
+            # blackholed host (SYNs dropped — the partition case the
+            # healing targets) must cost the remaining budget, not the
+            # full socket timeout per reconnect attempt.
+            timeout = self._timeout
+            if deadline is not None:
+                timeout = min(timeout, max(deadline - time.monotonic(), 0.01))
+            s = socket.create_connection(self._addr, timeout=timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
         return self._sock
 
-    def close(self) -> None:
+    def _reset(self) -> None:
+        """Drop the cached socket: after a connection-level error it may
+        be desynced mid-frame — reusing it fails confusingly."""
         if self._sock is not None:
             try:
                 self._sock.close()
-            finally:
-                self._sock = None
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        # Same as _reset (one behavior, not two): a socket that errors on
+        # close inside a `with` block must not mask the exception the
+        # block is already unwinding with.
+        self._reset()
 
     def __enter__(self):
         return self
@@ -51,20 +125,138 @@ class DataPlaneClient:
     def __exit__(self, *exc):
         self.close()
 
-    def _roundtrip(self, req: Dict[str, Any], payload: Optional[bytes] = None):
-        sock = self._conn()
+    def _op_id(self) -> str:
+        self._seq += 1
+        return f"{self._nonce}-{self._seq}"
+
+    def _attempt(
+        self,
+        req: Dict[str, Any],
+        payload: Optional[bytes],
+        arrays: Optional[Dict[str, np.ndarray]],
+        want_arrays: bool,
+        deadline: Optional[float] = None,
+        sent: Optional[Dict[str, bool]] = None,
+    ):
+        """One request/response exchange on the cached connection; reads
+        any response array frames INSIDE the attempt so a drop mid-response
+        replays the whole op instead of desyncing. ``sent`` (out-param) is
+        flipped once request bytes may have reached the wire — the line
+        between a retry that merely reconnects and one that REPLAYS."""
+        faults.checkpoint("client.op")
+        sock = self._conn(deadline=deadline)
+        if deadline is not None:
+            # The op deadline must bound BLOCKED syscalls too, not just
+            # the gaps between attempts: clamp this attempt's socket
+            # timeout to the remaining budget (floor 10 ms so an
+            # already-expired deadline fails fast instead of raising an
+            # invalid-timeout error).
+            sock.settimeout(
+                min(self._timeout, max(deadline - time.monotonic(), 0.01))
+            )
         req = {"v": protocol.PROTOCOL_VERSION, **req}
         if self._token is not None:
             req = {**req, "token": self._token}
-        protocol.send_json(sock, req)
-        if payload is not None:
-            protocol.send_frame(sock, payload)
+        if sent is not None:
+            sent["flag"] = True
+        if arrays is not None:
+            protocol.send_arrays(
+                sock, {k: np.asarray(v) for k, v in arrays.items()}, req
+            )
+        else:
+            protocol.send_json(sock, req)
+            if payload is not None:
+                protocol.send_frame(sock, payload)
         resp = protocol.recv_json(sock)
         if resp is None:
             raise ConnectionError("daemon closed the connection")
         if not resp.get("ok", False):
+            if resp.get("busy"):
+                raise DaemonBusy(
+                    f"daemon busy: {resp.get('error')}",
+                    float(resp.get("retry_after_s", 1.0)),
+                )
             raise RuntimeError(f"daemon error: {resp.get('error')}")
-        return resp, sock
+        outs = protocol.recv_arrays(sock, resp) if want_arrays else None
+        return resp, outs
+
+    def _op(
+        self,
+        req: Dict[str, Any],
+        payload: Optional[bytes] = None,
+        arrays: Optional[Dict[str, np.ndarray]] = None,
+        want_arrays: bool = False,
+    ):
+        """Run one op through the self-healing loop (module docstring)."""
+        start = time.monotonic()
+        deadline = None if self._op_deadline is None else start + self._op_deadline
+        attempt = 0
+        busy_waited = 0.0
+        delay = self._backoff_base
+        while True:
+            sent = {"flag": False}
+            try:
+                return self._attempt(req, payload, arrays, want_arrays,
+                                     deadline=deadline, sent=sent)
+            except protocol.FrameTooLarge:
+                # Sender-side MAX_FRAME rejection: deterministic — the
+                # payload will never fit, replaying cannot help. The JSON
+                # header already went out though, so the connection is
+                # mid-request: drop it (retry obligation #1) so the next
+                # op doesn't have its header eaten as this op's payload.
+                self._reset()
+                raise
+            except DaemonBusy as e:
+                # Only the LOAD said no — but holding our connection open
+                # through the wait would keep a connection-count watermark
+                # pinned above its threshold forever (every shed client
+                # parked, none draining). Release the slot, wait the hint
+                # with jitter, reconnect on retry.
+                self._reset()
+                wait = e.retry_after_s * (0.5 + self._rng.random())
+                now = time.monotonic()
+                if deadline is not None:
+                    if now + wait > deadline:
+                        raise
+                elif busy_waited + wait > self._max_busy_wait:
+                    raise
+                self.stats["busy_waits"] += 1
+                busy_waited += wait
+                logger.info(
+                    "daemon busy (%s); retrying op %r in %.2fs",
+                    self._addr, req.get("op"), wait,
+                )
+                time.sleep(wait)
+            except (protocol.ProtocolError, OSError) as e:
+                # Includes ConnectionError and socket timeouts. The cached
+                # socket may be mid-frame — always drop it, even on the
+                # final raise, so the NEXT op reconnects cleanly.
+                self._reset()
+                attempt += 1
+                if attempt >= self._max_attempts:
+                    raise
+                delay = decorrelated_jitter(
+                    delay, self._backoff_base, self._backoff_max, self._rng
+                )
+                if deadline is not None and time.monotonic() + delay > deadline:
+                    raise
+                self.stats["reconnects"] += 1
+                if sent["flag"]:
+                    # Only a request that may have reached the wire is a
+                    # REPLAY; a failed connect or pre-send fault is just a
+                    # reconnect.
+                    self.stats["replays"] += 1
+                logger.warning(
+                    "connection failure on op %r to %s (attempt %d/%d, "
+                    "reconnect in %.2fs): %s",
+                    req.get("op"), self._addr, attempt, self._max_attempts,
+                    delay, e,
+                )
+                time.sleep(delay)
+
+    def _roundtrip(self, req: Dict[str, Any], payload: Optional[bytes] = None):
+        resp, _ = self._op(req, payload=payload)
+        return resp, self._sock
 
     # -- ops ---------------------------------------------------------------
 
@@ -81,6 +273,15 @@ class DataPlaneClient:
                 f"v{protocol.PROTOCOL_VERSION}"
             )
         return bool(resp["ok"])
+
+    def health(self) -> Dict[str, Any]:
+        """Daemon health snapshot (additive op): ``queue_depth`` (active
+        connections), ``staged_bytes`` (uncommitted stage memory),
+        ``active_jobs``, ``served_models``, ``uptime_s``, and ``busy``
+        (True when the daemon is over a backpressure watermark and is
+        shedding heavy ops; ``retry_after_s`` carries its hint)."""
+        resp, _ = self._roundtrip({"op": "health"})
+        return {k: v for k, v in resp.items() if k != "ok"}
 
     def server_id(self) -> Optional[str]:
         """The daemon's self-reported instance id (from ping). Address
@@ -150,6 +351,9 @@ class DataPlaneClient:
                 "partition": partition,
                 "attempt": attempt,
                 "pass_id": pass_id,
+                # Replay dedupe: a reconnect replays this exact feed; the
+                # daemon folds a given feed_id at most once per stage.
+                "feed_id": self._op_id(),
             },
             payload=self._to_ipc(data, input_col, label_col),
         )
@@ -184,6 +388,7 @@ class DataPlaneClient:
                 "partition": partition,
                 "attempt": attempt,
                 "pass_id": pass_id,
+                "feed_id": self._op_id(),
             },
             arrays,
         )
@@ -232,8 +437,14 @@ class DataPlaneClient:
     def step(self, job: str, params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Pass boundary for iterative jobs (kmeans/logreg): apply the
         Lloyd/Newton update over the pass's accumulated statistics and
-        return convergence info ({"iteration", "moved2"|"delta", ...})."""
-        resp, _ = self._roundtrip({"op": "step", "job": job, "params": params or {}})
+        return convergence info ({"iteration", "moved2"|"delta", ...}).
+        Carries a ``step_id`` so a replay whose first ack was lost gets
+        the cached result of the step it already applied instead of
+        double-advancing the iterate."""
+        resp, _ = self._roundtrip(
+            {"op": "step", "job": job, "params": params or {},
+             "step_id": self._op_id()}
+        )
         return {k: v for k, v in resp.items() if k != "ok"}
 
     def status(self, job: str) -> Dict[str, Any]:
@@ -251,37 +462,33 @@ class DataPlaneClient:
         """Finalize a job; returns (result arrays, total rows). ``arrays``
         (optional, additive to protocol v1) sends raw array frames with
         the request — the sharded KNN build ships the shared quantizer
-        this way (docs/protocol.md)."""
-        req = {"op": "finalize", "job": job, "params": params, "drop": drop}
-        if arrays:
-            resp = self._send_arrays_op(req, arrays)
-            sock = self._conn()  # same cached connection the op used
-        else:
-            resp, sock = self._roundtrip(req)
-        return protocol.recv_arrays(sock, resp), int(resp["rows"])
+        this way (docs/protocol.md).
+
+        Replay-safe split (retry obligation #4): the wire request always
+        carries ``drop: false`` so a reconnect replay after a lost
+        response re-reads the same model instead of hitting ``no such
+        job``; ``drop=True`` then issues the explicit idempotent ``drop``
+        op once the arrays are safely in hand. (KNN finalizes consume the
+        job either way — their response loss still needs a refit.)"""
+        req = {"op": "finalize", "job": job, "params": params, "drop": False}
+        resp, outs = self._op(req, arrays=arrays or None, want_arrays=True)
+        if drop:
+            self.drop(job)
+        return outs, int(resp["rows"])
 
     # -- cross-daemon merge (multi-host data plane) -------------------------
 
     def _send_arrays_op(self, req: Dict[str, Any], arrays: Dict[str, np.ndarray]):
         """Request carrying raw array frames (ensure_model framing)."""
-        sock = self._conn()
-        req = {"v": protocol.PROTOCOL_VERSION, **req}
-        if self._token is not None:
-            req["token"] = self._token
-        protocol.send_arrays(sock, {k: np.asarray(v) for k, v in arrays.items()}, req)
-        resp = protocol.recv_json(sock)
-        if resp is None:
-            raise ConnectionError("daemon closed the connection")
-        if not resp.get("ok", False):
-            raise RuntimeError(f"daemon error: {resp.get('error')}")
+        resp, _ = self._op(req, arrays=arrays)
         return resp
 
     def export_state(self, job: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
         """Snapshot a job's committed O(d²) partials for a cross-daemon
         merge. Returns (state arrays keyed s0..sN in jax tree order,
         meta with rows/pass_rows/iteration/algo/n_cols). Read-only."""
-        resp, sock = self._roundtrip({"op": "export_state", "job": job})
-        arrays = protocol.recv_arrays(sock, resp)
+        resp, arrays = self._op({"op": "export_state", "job": job},
+                                want_arrays=True)
         meta = {k: v for k, v in resp.items() if k not in ("ok", "arrays")}
         return arrays, meta
 
@@ -306,6 +513,9 @@ class DataPlaneClient:
                 "n_cols": n_cols,
                 "params": params or {},
                 "rows": int(rows),
+                # Replay dedupe: merges fold immediately; a reconnect
+                # replay with the same id must not double-apply partials.
+                "merge_id": self._op_id(),
             },
             arrays,
         )
@@ -314,8 +524,8 @@ class DataPlaneClient:
     def get_iterate(self, job: str) -> Tuple[Dict[str, np.ndarray], int]:
         """(iterate arrays, iteration) of an iterative job — kmeans
         {"centers"}; logreg {"w", "b"}."""
-        resp, sock = self._roundtrip({"op": "get_iterate", "job": job})
-        arrays = protocol.recv_arrays(sock, resp)
+        resp, arrays = self._op({"op": "get_iterate", "job": job},
+                                want_arrays=True)
         return arrays, int(resp["iteration"])
 
     def set_iterate(
@@ -363,7 +573,7 @@ class DataPlaneClient:
         ``data``: Arrow Table/RecordBatch or (n, d) ndarray. Returns the
         role-keyed output arrays (the model's ``_serve_outputs`` roles,
         e.g. {"output": ...} for PCA, {"prediction": ...} for KMeans)."""
-        resp, sock = self._roundtrip(
+        _, arrays = self._op(
             {
                 "op": "transform",
                 "model": name,
@@ -371,8 +581,9 @@ class DataPlaneClient:
                 "n_cols": n_cols,
             },
             payload=self._to_ipc(data, input_col, "label"),
+            want_arrays=True,
         )
-        return protocol.recv_arrays(sock, resp)
+        return arrays
 
     def drop_model(self, name: str) -> bool:
         resp, _ = self._roundtrip({"op": "drop_model", "model": name})
@@ -431,7 +642,7 @@ class DataPlaneClient:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Query a daemon-registered index: returns (distances (q, k),
         indices (q, k)) with global partition-major row ids."""
-        resp, sock = self._roundtrip(
+        _, arrays = self._op(
             {
                 "op": "kneighbors",
                 "model": model,
@@ -440,8 +651,8 @@ class DataPlaneClient:
                 "n_cols": n_cols,
             },
             payload=self._to_ipc(queries, input_col, "label"),
+            want_arrays=True,
         )
-        arrays = protocol.recv_arrays(sock, resp)
         return arrays["distances"], arrays["indices"]
 
     # -- conveniences ------------------------------------------------------

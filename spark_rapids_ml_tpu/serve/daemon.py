@@ -4,7 +4,11 @@ Threading model: one acceptor thread + one thread per connection (Spark
 task). Concurrent feeds to the same job serialize on the job's lock around
 the device fold — the accumulate is associative, so arrival order doesn't
 matter (the property the reference's ``RDD.reduce`` relied on,
-RapidsRowMatrix.scala:139). Feeds to different jobs interleave freely.
+RapidsRowMatrix.scala:139). Feeds to different jobs interleave on the
+host side (Arrow decode, validation, staging bookkeeping); the DEVICE
+dispatch itself single-files through a process-wide ``_DEVICE_LOCK`` —
+one process owns the host's chips, concurrent sharded programs on one
+device set buy nothing and can deadlock the CPU backend outright.
 
 Jobs: "pca" folds (count, Σx, XᵀX); "linreg" folds (XᵀX, Xᵀy, Σx, Σy,
 Σy², n). ``finalize`` runs the algorithm's shared finalize (eigensolve /
@@ -49,6 +53,7 @@ import socket
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -58,6 +63,7 @@ from spark_rapids_ml_tpu.ops import gram as gram_ops
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import row_sharding
 from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.utils import faults
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.daemon")
@@ -75,6 +81,27 @@ _IVF_DEVICE_BUILD_MAX_BYTES = int(
 #: connection framing aligned. (``ensure_model`` instead carries raw
 #: array frames per its request's ``arrays`` spec — see _drain_payload.)
 _PAYLOAD_OPS = ("feed", "seed", "transform", "kneighbors")
+
+#: Ops shed with `busy` + retry_after_s when the daemon is over a
+#: backpressure watermark: the ones that ADD load (new rows, new state,
+#: device compute). Pressure-relieving ops (commit, finalize, drop) and
+#: O(1) control ops (ping, health, status, step) always pass.
+_SHEDDABLE_OPS = (
+    "feed", "feed_raw", "seed", "transform", "kneighbors", "merge_state",
+    "ensure_model",
+)
+
+#: Process-wide device-execution lock. One process owns the host's chips
+#: (the daemon's deployment unit); concurrent sharded dispatches from
+#: multiple connection threads buy no throughput — the device set is one
+#: resource — and on the CPU backend they can DEADLOCK outright (jax
+#: 0.4.x host-platform device threads: two in-process daemons folding
+#: concurrently wedge inside their jitted updates at 0% CPU, observed
+#: under the chaos/multidaemon suites). Every device-touching section
+#: (fold/step/merge/finalize/build/serve) takes this lock INNERMOST —
+#: after any job/model lock, never before one — so lock order stays
+#: acyclic.
+_DEVICE_LOCK = threading.Lock()
 
 #: Cap on a request's declared raw-array frame count (_recv_arrays_aligned):
 #: the widest legitimate op is a multinomial merge_state (7 state leaves) or
@@ -165,6 +192,64 @@ def _recv_arrays_aligned(conn, req: Dict[str, Any]) -> Dict[str, np.ndarray]:
     return out
 
 
+class _Stage:
+    """One (partition, attempt) staged accumulation: the state, its row
+    count, an estimate of the bytes it holds (staged-byte accounting for
+    the backpressure watermark), and the feed_ids already folded into it
+    (exactly-once REPLAY: a self-healing client that lost an ack resends
+    the same feed_id, which must not double-count)."""
+
+    __slots__ = ("state", "rows", "nbytes", "seen")
+
+    def __init__(self, state, rows: int = 0, nbytes: int = 0):
+        self.state = state
+        self.rows = rows
+        self.nbytes = nbytes
+        self.seen: set = set()
+
+
+def _state_nbytes(state) -> int:
+    """Rough device-buffer footprint of a job/stage state tree."""
+    try:
+        return int(
+            sum(getattr(leaf, "nbytes", 0)
+                for leaf in jax.tree_util.tree_leaves(state))
+        )
+    except Exception:  # pragma: no cover - defensive; accounting only
+        return 0
+
+
+#: Bound on remembered unpartitioned feed_ids / merge_ids per job (those
+#: ops fold immediately, so dedupe needs a memory; stages carry their own
+#: sets and die with the stage). FIFO eviction — a replay arrives right
+#: after its original, never 4096 ops later.
+_MAX_SEEN_FEED_IDS = 4096
+
+
+class _FifoSet:
+    """Bounded membership memory for replay dedupe: `in` + add-with-FIFO-
+    eviction. One implementation for feed_ids and merge_ids so the
+    eviction policy cannot drift between them."""
+
+    __slots__ = ("_set", "_order", "_cap")
+
+    def __init__(self, cap: int = _MAX_SEEN_FEED_IDS):
+        self._set: set = set()
+        self._order: deque = deque()
+        self._cap = cap
+
+    def __contains__(self, item: str) -> bool:
+        return item in self._set
+
+    def add(self, item: str) -> None:
+        if item in self._set:
+            return
+        self._set.add(item)
+        self._order.append(item)
+        if len(self._order) > self._cap:
+            self._set.discard(self._order.popleft())
+
+
 def _opt(req: Dict[str, Any], key: str, default):
     """Optional request field: docs/protocol.md promises that omitted and
     JSON null are equivalent, so a present-but-null field takes the
@@ -203,10 +288,24 @@ class _Job:
         # (partition, attempt) so CONCURRENT attempts of one partition
         # (Spark speculation runs a duplicate alongside the original)
         # accumulate independently instead of wiping each other — the
-        # first to commit wins, the rest are discarded. Values:
-        # (staged state, staged rows); committed: partition → rows.
-        self.staged: Dict[tuple, Any] = {}
+        # first to commit wins, the rest are discarded. Values: _Stage;
+        # committed: partition → rows.
+        self.staged: Dict[tuple, _Stage] = {}
         self.committed: Dict[int, int] = {}
+        # Total bytes currently held by uncommitted stages (the `health`
+        # op's staged_bytes and the backpressure watermark's input).
+        self.staged_bytes = 0
+        # Replay dedupe for UNPARTITIONED feeds (they fold immediately):
+        # bounded FIFO memory. Staged feeds dedupe inside their _Stage.
+        # Same memory shape for merge_state replays (merge_remote folds
+        # immediately too — a replayed merge must not double-apply).
+        self._seen_feed_ids = _FifoSet()
+        self._seen_merge_ids = _FifoSet()
+        # Step idempotency: a replayed step (ack lost mid-connection)
+        # carrying the step_id of the ALREADY-APPLIED step gets the
+        # cached info back instead of double-advancing the iterate.
+        self._last_step_id: Optional[str] = None
+        self._last_step_info: Optional[Dict[str, Any]] = None
         self._accum = jnp.dtype(config.get("accum_dtype"))
         if algo == "pca":
             self.state = gram_ops.init_stats(n_cols)
@@ -368,9 +467,42 @@ class _Job:
                 raise KeyError("job was finalized/dropped")
             if self.centers is not None:
                 return  # idempotent: a retried seed keeps the first init
-            c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
-            self.centers = jnp.asarray(c0, self._accum)
+            with _DEVICE_LOCK:
+                c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
+                self.centers = jnp.asarray(c0, self._accum)
             self.touched = self._clock()  # exit stamp (init can be slow)
+
+    def _is_replay(self, feed_id: Optional[str], stage: Optional[_Stage]) -> bool:
+        """Feed-level replay dedupe (call under the job lock): True when
+        this feed_id already folded — a self-healing client resent an op
+        whose first ack was lost. Stage-scoped for partitioned feeds,
+        job-scoped (bounded FIFO) for direct feeds. Read-only: the id is
+        recorded by :meth:`_mark_folded` only AFTER the fold succeeds —
+        recording it up front would poison the id when the fold raises,
+        making the replay a silent ack-without-fold."""
+        if feed_id is None:
+            return False
+        feed_id = str(feed_id)
+        if stage is not None:
+            return feed_id in stage.seen
+        return feed_id in self._seen_feed_ids
+
+    def _mark_folded(self, feed_id: Optional[str], stage: Optional[_Stage]) -> None:
+        """Record a successfully folded feed_id (under the job lock)."""
+        if feed_id is None:
+            return
+        feed_id = str(feed_id)
+        if stage is not None:
+            stage.seen.add(feed_id)
+            return
+        self._seen_feed_ids.add(feed_id)
+
+    def _drop_stage(self, key: tuple) -> Optional[_Stage]:
+        """Remove one stage, keeping the staged-bytes account balanced."""
+        stage = self.staged.pop(key, None)
+        if stage is not None:
+            self.staged_bytes -= stage.nbytes
+        return stage
 
     def fold(
         self,
@@ -379,6 +511,7 @@ class _Job:
         partition: Optional[int] = None,
         attempt: int = 0,
         pass_id: Optional[int] = None,
+        feed_id: Optional[str] = None,
     ) -> None:
         if x.shape[1] != self.n_cols:
             raise ValueError(f"batch width {x.shape[1]} != job n_cols {self.n_cols}")
@@ -396,12 +529,24 @@ class _Job:
                 if partition is not None and partition in self.committed:
                     return
                 if partition is None:
+                    if self._is_replay(feed_id, None):
+                        return
                     self.state.append(block)
                     self.rows += n
                     self.pass_rows += n
+                    self._mark_folded(feed_id, None)
                 else:
-                    blocks, extra = self.staged.get((partition, attempt), ([], 0))
-                    self.staged[(partition, attempt)] = (blocks + [block], extra + n)
+                    stage = self.staged.get((partition, attempt))
+                    if stage is None:
+                        stage = _Stage([], 0, 0)
+                        self.staged[(partition, attempt)] = stage
+                    if self._is_replay(feed_id, stage):
+                        return
+                    stage.state = stage.state + [block]
+                    stage.rows += n
+                    stage.nbytes += block.nbytes
+                    self.staged_bytes += block.nbytes
+                    self._mark_folded(feed_id, stage)
             return
         target = self._bucket(n)
         xb = np.zeros((target,) + x.shape[1:], dtype=x.dtype)
@@ -437,38 +582,62 @@ class _Job:
                 init_fn = (
                     _kmeans_plus_plus if self.init == "k-means++" else _random_init
                 )
-                c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
-                self.centers = jnp.asarray(c0, self._accum)
+                with _DEVICE_LOCK:  # same device section seed_centers locks
+                    c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
+                    self.centers = jnp.asarray(c0, self._accum)
+            stage = None
+            fresh_stage = False
             if partition is None:
-                state, extra_rows = self.state, 0
+                if self._is_replay(feed_id, None):
+                    return
+                state = self.state
             else:
-                prev = self.staged.get((partition, attempt))
-                if prev is not None:
-                    state, extra_rows = prev
+                stage = self.staged.get((partition, attempt))
+                if stage is None:
+                    with _DEVICE_LOCK:
+                        zero = self._zero_state()
+                    # NOT registered in self.staged yet: a fallible device
+                    # update follows, and a phantom empty stage would both
+                    # inflate staged_bytes and let a later commit of this
+                    # (partition, attempt) succeed with 0 rows.
+                    stage = _Stage(zero, 0, _state_nbytes(zero))
+                    fresh_stage = True
+                if self._is_replay(feed_id, stage):
+                    return
+                state = stage.state
+            with _DEVICE_LOCK:
+                xs = jax.device_put(xb, self.x_sharding)
+                ms = jax.device_put(mb, self.v_sharding)
+                if self.algo == "pca":
+                    state = self.update(state, xs, ms)
+                elif self.algo == "kmeans":
+                    state = self.update(state, self.centers, xs, ms)
+                elif self.algo == "logreg":
+                    yb = np.zeros((target,), dtype=np.float32)
+                    yb[:n] = np.asarray(y).reshape(-1)
+                    ys = jax.device_put(yb, self.v_sharding)
+                    state = self.update(state, self.w, self.b, xs, ys, ms)
                 else:
-                    state, extra_rows = self._zero_state(), 0
-            xs = jax.device_put(xb, self.x_sharding)
-            ms = jax.device_put(mb, self.v_sharding)
-            if self.algo == "pca":
-                state = self.update(state, xs, ms)
-            elif self.algo == "kmeans":
-                state = self.update(state, self.centers, xs, ms)
-            elif self.algo == "logreg":
-                yb = np.zeros((target,), dtype=np.float32)
-                yb[:n] = np.asarray(y).reshape(-1)
-                ys = jax.device_put(yb, self.v_sharding)
-                state = self.update(state, self.w, self.b, xs, ys, ms)
-            else:
-                yb = np.zeros((target,), dtype=np.asarray(y).dtype)
-                yb[:n] = np.asarray(y).reshape(-1)
-                ys = jax.device_put(yb, self.v_sharding)
-                state = self.update(state, xs, ys, ms)
+                    yb = np.zeros((target,), dtype=np.asarray(y).dtype)
+                    yb[:n] = np.asarray(y).reshape(-1)
+                    ys = jax.device_put(yb, self.v_sharding)
+                    state = self.update(state, xs, ys, ms)
             if partition is None:
                 self.state = state
                 self.rows += n
                 self.pass_rows += n
             else:
-                self.staged[(partition, attempt)] = (state, extra_rows + n)
+                stage.state = state
+                stage.rows += n
+                if fresh_stage:
+                    # Published only after the update succeeded (see the
+                    # creation comment above).
+                    self.staged[(partition, attempt)] = stage
+                    self.staged_bytes += stage.nbytes
+            # Only now — after the device fold succeeded — is the feed_id
+            # burned; an id recorded before a failing update would turn
+            # the client's replay into a silent ack-without-fold.
+            self._mark_folded(feed_id, stage)
             # Refresh again on exit: the device update above can dominate
             # the op (first-compile can take tens of seconds), and a
             # touched stamp from the op's START would make a busy job look
@@ -488,13 +657,13 @@ class _Job:
             self.touched = self._clock()
             if partition in self.committed:
                 return self.rows
-            staged = self.staged.pop((partition, attempt), None)
+            staged = self._drop_stage((partition, attempt))
             if staged is None:
                 raise ValueError(
                     f"commit for partition {partition} attempt {attempt} "
                     "with no staged feed"
                 )
-            state, n = staged
+            state, n = staged.state, staged.rows
             if self.algo == "knn":
                 # Keyed by partition (not arrival order) so the finalize
                 # concatenation — and therefore the global row ids the
@@ -502,13 +671,14 @@ class _Job:
                 # the concurrent commits interleaved.
                 self.part_rows[partition] = state
             else:
-                self.state = self._merge(self.state, state)
+                with _DEVICE_LOCK:  # the merge is a device program
+                    self.state = self._merge(self.state, state)
             self.committed[partition] = n
             self.rows += n
             self.pass_rows += n
             # losing attempts' stages for this partition free their buffers
             for key in [k for k in self.staged if k[0] == partition]:
-                del self.staged[key]
+                self._drop_stage(key)
             self.touched = self._clock()  # exit stamp (see fold)
             return self.rows
 
@@ -530,9 +700,11 @@ class _Job:
                 )
             self.touched = self._clock()
             leaves = jax.tree_util.tree_leaves(self.state)
-            arrays = {
-                f"s{i}": np.asarray(jax.device_get(a)) for i, a in enumerate(leaves)
-            }
+            with _DEVICE_LOCK:
+                arrays = {
+                    f"s{i}": np.asarray(jax.device_get(a))
+                    for i, a in enumerate(leaves)
+                }
             meta = {
                 "rows": self.rows,
                 "pass_rows": self.pass_rows,
@@ -547,12 +719,18 @@ class _Job:
             self.touched = self._clock()  # exit stamp (device_get can be slow)
             return arrays, meta
 
-    def merge_remote(self, arrays: Dict[str, np.ndarray], rows: int) -> int:
+    def merge_remote(
+        self, arrays: Dict[str, np.ndarray], rows: int,
+        merge_id: Optional[str] = None,
+    ) -> int:
         """Fold another daemon's exported state into this job — the
         associative add that makes the data plane span hosts (the
         ``RDD.reduce`` across executors, RapidsRowMatrix.scala:139, with
         daemons as the leaves). ``rows`` is the contributed committed-row
-        count; it joins both the job total and the current pass."""
+        count; it joins both the job total and the current pass.
+        ``merge_id`` (additive) dedupes a self-healing client's replay:
+        the same id folds at most once — without it, a merge whose ack
+        was lost would double-apply the peer's partials on replay."""
         import jax.numpy as jnp
 
         with self.lock:
@@ -561,6 +739,8 @@ class _Job:
             if self.algo == "knn":
                 raise ValueError("knn jobs cannot merge remote state")
             self.touched = self._clock()
+            if merge_id is not None and str(merge_id) in self._seen_merge_ids:
+                return self.rows
             leaves, treedef = jax.tree_util.tree_flatten(self.state)
             if len(arrays) != len(leaves):
                 raise ValueError(
@@ -569,19 +749,25 @@ class _Job:
                     "daemons?)"
                 )
             merged = []
-            for i, leaf in enumerate(leaves):
-                inc = arrays.get(f"s{i}")
-                if inc is None:
-                    raise ValueError(f"merge_state missing array 's{i}'")
-                if tuple(inc.shape) != tuple(leaf.shape):
-                    raise ValueError(
-                        f"merge_state array s{i} shape {tuple(inc.shape)} != "
-                        f"job state shape {tuple(leaf.shape)}"
-                    )
-                merged.append(leaf + jnp.asarray(inc, leaf.dtype))
+            with _DEVICE_LOCK:
+                for i, leaf in enumerate(leaves):
+                    inc = arrays.get(f"s{i}")
+                    if inc is None:
+                        raise ValueError(f"merge_state missing array 's{i}'")
+                    if tuple(inc.shape) != tuple(leaf.shape):
+                        raise ValueError(
+                            f"merge_state array s{i} shape {tuple(inc.shape)} "
+                            f"!= job state shape {tuple(leaf.shape)}"
+                        )
+                    merged.append(leaf + jnp.asarray(inc, leaf.dtype))
             self.state = jax.tree_util.tree_unflatten(treedef, merged)
             self.rows += int(rows)
             self.pass_rows += int(rows)
+            if merge_id is not None:
+                # Burned only after the merge APPLIED: recording it before
+                # validation would make a replay of a rejected merge a
+                # silent ack-without-apply.
+                self._seen_merge_ids.add(str(merge_id))
             self.touched = self._clock()  # exit stamp
             return self.rows
 
@@ -596,12 +782,16 @@ class _Job:
             if self.algo == "kmeans":
                 if self.centers is None:
                     raise ValueError("kmeans job has no centers yet (seed first)")
-                arrays = {"centers": np.asarray(jax.device_get(self.centers))}
+                with _DEVICE_LOCK:
+                    arrays = {
+                        "centers": np.asarray(jax.device_get(self.centers))
+                    }
             elif self.algo == "logreg":
-                arrays = {
-                    "w": np.asarray(jax.device_get(self.w)),
-                    "b": np.asarray(jax.device_get(self.b)).reshape(-1),
-                }
+                with _DEVICE_LOCK:
+                    arrays = {
+                        "w": np.asarray(jax.device_get(self.w)),
+                        "b": np.asarray(jax.device_get(self.b)).reshape(-1),
+                    }
             else:
                 raise ValueError(
                     f"algo {self.algo!r} is single-pass; it has no iterate"
@@ -626,7 +816,8 @@ class _Job:
                     raise ValueError(
                         f"centers shape {c.shape} != ({self.k}, {self.n_cols})"
                     )
-                self.centers = jnp.asarray(c, self._accum)
+                with _DEVICE_LOCK:
+                    self.centers = jnp.asarray(c, self._accum)
             elif self.algo == "logreg":
                 # Full shape validation at the op boundary (like the
                 # kmeans branch): a mis-shaped iterate installed here
@@ -649,25 +840,32 @@ class _Job:
                         f"intercept length {b.shape[0]} != {want_b} "
                         f"(n_classes={n_classes})"
                     )
-                self.w = jnp.asarray(w, self._accum)
-                self.b = jnp.asarray(
-                    b if n_classes > 2 else b.reshape(()), self._accum
-                )
+                with _DEVICE_LOCK:
+                    self.w = jnp.asarray(w, self._accum)
+                    self.b = jnp.asarray(
+                        b if n_classes > 2 else b.reshape(()), self._accum
+                    )
             else:
                 raise ValueError(
                     f"algo {self.algo!r} is single-pass; set_iterate not applicable"
                 )
-            self.state = self._zero_state()
+            with _DEVICE_LOCK:
+                self.state = self._zero_state()
             self.staged.clear()
+            self.staged_bytes = 0
             self.committed.clear()
             self.iteration = int(iteration)
             self.pass_rows = 0
             self.touched = self._clock()  # exit stamp
 
-    def step(self, params: Dict[str, Any]) -> Dict[str, Any]:
+    def step(
+        self, params: Dict[str, Any], step_id: Optional[str] = None
+    ) -> Dict[str, Any]:
         """Pass boundary for iterative jobs: apply the update at the end of
         one full dataset scan, reset the pass accumulator, and report
-        convergence info for the driver's stop decision."""
+        convergence info for the driver's stop decision. ``step_id``
+        (additive) makes a lost-ack REPLAY safe: the id of the last
+        applied step returns its cached info instead of double-stepping."""
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
@@ -676,10 +874,17 @@ class _Job:
                 raise ValueError(
                     f"algo {self.algo!r} is single-pass; step not applicable"
                 )
+            if (
+                step_id is not None
+                and self._last_step_info is not None
+                and str(step_id) == self._last_step_id
+            ):
+                return dict(self._last_step_info)
             # A new pass re-feeds every partition against the new iterate:
             # clear this pass's staging + committed set (zombie traffic from
             # the finished pass is fenced by pass_id, not by these maps).
             self.staged.clear()
+            self.staged_bytes = 0
             self.committed.clear()
             if self.pass_rows == 0:
                 # A retried/premature step over an empty pass would corrupt
@@ -692,8 +897,11 @@ class _Job:
                 from spark_rapids_ml_tpu.models.kmeans import apply_lloyd_update
 
                 sums, counts, cost = self.state
-                self.centers, moved2 = apply_lloyd_update(sums, counts, self.centers)
-                self.state = self._kmeans_zero_state()
+                with _DEVICE_LOCK:
+                    self.centers, moved2 = apply_lloyd_update(
+                        sums, counts, self.centers
+                    )
+                    self.state = self._kmeans_zero_state()
                 self.iteration += 1
                 info = {
                     "iteration": self.iteration,
@@ -703,7 +911,7 @@ class _Job:
                 }
                 self.pass_rows = 0
                 self.touched = self._clock()  # exit stamp (see fold)
-                return info
+                return self._cache_step(step_id, info)
             reg = float(params.get("reg", 0.0))
             fit_intercept = bool(params.get("fit_intercept", True))
             if getattr(self, "n_classes", 2) > 2:
@@ -714,9 +922,12 @@ class _Job:
 
                 gw, gb, hw, hwb, hbb, lsum, n = self.state
                 mm = _stream_multinomial_step_fn(reg, fit_intercept, self._accum.name)
-                loss = stream_softmax_objective(lsum, n, reg, self.w)
-                self.w, self.b, delta = mm(gw, gb, hw, hwb, hbb, n, self.w, self.b)
-                self.state = self._logreg_zero_state()
+                with _DEVICE_LOCK:
+                    loss = stream_softmax_objective(lsum, n, reg, self.w)
+                    self.w, self.b, delta = mm(
+                        gw, gb, hw, hwb, hbb, n, self.w, self.b
+                    )
+                    self.state = self._logreg_zero_state()
                 self.iteration += 1
                 info = {
                     "iteration": self.iteration,
@@ -726,7 +937,7 @@ class _Job:
                 }
                 self.pass_rows = 0
                 self.touched = self._clock()  # exit stamp (see fold)
-                return info
+                return self._cache_step(step_id, info)
             from spark_rapids_ml_tpu.models.logistic_regression import (
                 _stream_newton_step_fn,
                 stream_objective,
@@ -734,9 +945,12 @@ class _Job:
 
             gw, gb, hww, hwb, hbb, lsum, n = self.state
             newton = _stream_newton_step_fn(reg, fit_intercept, self._accum.name)
-            loss = stream_objective(lsum, n, reg, self.w)
-            self.w, self.b, delta = newton(gw, gb, hww, hwb, hbb, n, self.w, self.b)
-            self.state = self._logreg_zero_state()
+            with _DEVICE_LOCK:
+                loss = stream_objective(lsum, n, reg, self.w)
+                self.w, self.b, delta = newton(
+                    gw, gb, hww, hwb, hbb, n, self.w, self.b
+                )
+                self.state = self._logreg_zero_state()
             self.iteration += 1
             info = {
                 "iteration": self.iteration,
@@ -746,7 +960,15 @@ class _Job:
             }
             self.pass_rows = 0
             self.touched = self._clock()  # exit stamp (see fold)
-            return info
+            return self._cache_step(step_id, info)
+
+    def _cache_step(
+        self, step_id: Optional[str], info: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Record the applied step for lost-ack replay (call under lock)."""
+        self._last_step_id = None if step_id is None else str(step_id)
+        self._last_step_info = dict(info)
+        return info
 
     def build_knn_model(
         self, params: Dict[str, Any],
@@ -849,38 +1071,43 @@ class _Job:
                 # straight onto its own chip.
                 build = str(params.get("build") or "auto")
                 device_ok = rows.nbytes <= _IVF_DEVICE_BUILD_MAX_BYTES
-                if build == "device" or (build == "auto" and device_ok):
-                    index = build_ivf_flat_device(
-                        jnp.asarray(rows), nlist=nlist, seed=seed,
-                        centroids=cent_in,
+                with _DEVICE_LOCK:
+                    if build == "device" or (build == "auto" and device_ok):
+                        index = build_ivf_flat_device(
+                            jnp.asarray(rows), nlist=nlist, seed=seed,
+                            centroids=cent_in,
+                        )
+                    elif build in ("host", "auto"):
+                        index = build_ivf_flat(rows, nlist=nlist, seed=seed,
+                                               mesh=self.mesh,
+                                               centroids=cent_in)
+                    else:
+                        raise ValueError(
+                            f"unknown build {build!r} (auto|device|host)"
+                        )
+                    model = ApproximateNearestNeighborsModel(index=index)
+                    model._set(metric=metric)
+                    model._index_metric = metric
+                    if params.get("nprobe"):
+                        model._set(nprobe=int(params["nprobe"]))
+                    # Databases ≫ one chip's HBM serve from the whole mesh:
+                    # the inverted lists shard over the data axis and
+                    # queries run the sharded bucketed executor with an
+                    # O(q·k·devices) all_gather merge (BASELINE config #5's
+                    # capacity path).
+                    if self.mesh.shape[DATA_AXIS] > 1:
+                        model.shard_index(self.mesh)
+                    info["nlist"] = np.asarray([nlist], np.int64)
+                    info["maxlen"] = np.asarray(
+                        [index.lists.shape[1]], np.int64
                     )
-                elif build in ("host", "auto"):
-                    index = build_ivf_flat(rows, nlist=nlist, seed=seed,
-                                           mesh=self.mesh, centroids=cent_in)
-                else:
-                    raise ValueError(
-                        f"unknown build {build!r} (auto|device|host)"
+                    info["sharded"] = np.asarray(
+                        [1 if model._shard_mesh is not None else 0], np.int64
                     )
-                model = ApproximateNearestNeighborsModel(index=index)
-                model._set(metric=metric)
-                model._index_metric = metric
-                if params.get("nprobe"):
-                    model._set(nprobe=int(params["nprobe"]))
-                # Databases ≫ one chip's HBM serve from the whole mesh:
-                # the inverted lists shard over the data axis and queries
-                # run the sharded bucketed executor with an O(q·k·devices)
-                # all_gather merge (BASELINE config #5's capacity path).
-                if self.mesh.shape[DATA_AXIS] > 1:
-                    model.shard_index(self.mesh)
-                info["nlist"] = np.asarray([nlist], np.int64)
-                info["maxlen"] = np.asarray([index.lists.shape[1]], np.int64)
-                info["sharded"] = np.asarray(
-                    [1 if model._shard_mesh is not None else 0], np.int64
-                )
-                if params.get("return_centroids"):
-                    info["centroids"] = np.asarray(
-                        jax.device_get(index.centroids), np.float32
-                    )
+                    if params.get("return_centroids"):
+                        info["centroids"] = np.asarray(
+                            jax.device_get(index.centroids), np.float32
+                        )
             elif mode == "exact":
                 from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
 
@@ -893,7 +1120,8 @@ class _Job:
 
     def finalize(self, params: Dict[str, Any], drop: bool = False) -> Dict[str, np.ndarray]:
         with self.lock:
-            result = self._finalize_locked(params)
+            with _DEVICE_LOCK:
+                result = self._finalize_locked(params)
             if drop:
                 # set under the same lock acquisition so a straggler feed
                 # blocked on it sees the flag and errors instead of folding
@@ -1053,9 +1281,12 @@ class _ServedModel:
     def transform(self, x: np.ndarray) -> Dict[str, np.ndarray]:
         # Serialize per-model: the jit caches aren't thread-safe to build
         # concurrently; steady-state calls just take the lock briefly.
+        # _DEVICE_LOCK (innermost) single-files the device dispatch with
+        # every other device-touching op in the process.
         with self.lock:
             self.touched = self._clock()
-            return self.model.transform_matrix(x)
+            with _DEVICE_LOCK:
+                return self.model.transform_matrix(x)
 
     def kneighbors(self, queries: np.ndarray, k):
         with self.lock:
@@ -1064,7 +1295,8 @@ class _ServedModel:
                 raise ValueError(
                     f"model algo {self.algo!r} does not serve kneighbors"
                 )
-            dists, idx = self.model.kneighbors(queries, k)
+            with _DEVICE_LOCK:
+                dists, idx = self.model.kneighbors(queries, k)
             if self.id_map is not None:
                 idx = np.asarray(idx)
                 # −1 = "fewer than k found" padding stays −1.
@@ -1091,7 +1323,12 @@ class DataPlaneDaemon:
         token: Optional[str] = None,
         clock=time.monotonic,
         reap_interval: Optional[float] = None,
+        max_connections: Optional[int] = None,
+        max_staged_bytes: Optional[int] = None,
+        retry_after_s: Optional[float] = None,
     ):
+        from spark_rapids_ml_tpu import config
+
         self._host, self._port = host, port
         self._mesh = mesh
         self._ttl = ttl
@@ -1100,6 +1337,28 @@ class DataPlaneDaemon:
         # wall-sleeping (r2 review weak #7); production uses monotonic.
         self._clock = clock
         self._reap_interval = reap_interval
+        # Backpressure watermarks (0/None = unlimited): past either, the
+        # daemon answers heavy ops with `busy` + a retry_after_s hint
+        # instead of accepting work it will thrash on — graceful
+        # degradation beats queueing until the host OOMs or every op
+        # times out at once. Defaults come from config
+        # (SRML_TPU_DAEMON_MAX_CONNECTIONS / _MAX_STAGED_BYTES).
+        self._max_connections = int(
+            config.get("daemon_max_connections")
+            if max_connections is None else max_connections
+        ) or None
+        self._max_staged_bytes = int(
+            config.get("daemon_max_staged_bytes")
+            if max_staged_bytes is None else max_staged_bytes
+        ) or None
+        self._retry_after_s = float(
+            config.get("daemon_retry_after_s")
+            if retry_after_s is None else retry_after_s
+        )
+        self._active_conns = 0
+        self._conn_socks: set = set()
+        self._conns_lock = threading.Lock()
+        self._started = self._clock()
         # Self-reported identity: host:port spellings alias (localhost vs
         # 127.0.0.1 vs FQDN), so the driver keys daemons by this id (from
         # ping) — never by the address string a client happened to use.
@@ -1158,6 +1417,22 @@ class DataPlaneDaemon:
                 pass
             try:
                 self._sock.close()
+            except OSError:
+                pass
+        # A stopped daemon must STOP: shut down live connections too, so
+        # in-flight clients see the death immediately (and heal against
+        # the replacement) instead of talking to a zombie registry.
+        # shutdown() — not close() — reliably unblocks a thread parked in
+        # recv() on the same socket.
+        with self._conns_lock:
+            conns = list(self._conn_socks)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
             except OSError:
                 pass
         if self._accept_thread is not None:
@@ -1235,6 +1510,24 @@ class DataPlaneDaemon:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            self._active_conns += 1
+            self._conn_socks.add(conn)
+        try:
+            faults.checkpoint("daemon.conn")
+            self._serve_conn_inner(conn)
+        except OSError:
+            pass  # injected/real transport failure: the conn is simply gone
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_lock:
+                self._active_conns -= 1
+                self._conn_socks.discard(conn)
+
+    def _serve_conn_inner(self, conn: socket.socket) -> None:
         with conn:
             while True:
                 try:
@@ -1242,10 +1535,22 @@ class DataPlaneDaemon:
                 except protocol.ProtocolError as e:
                     protocol.send_json(conn, {"ok": False, "error": str(e)})
                     return
+                except OSError:
+                    return  # transport died mid-read
                 if req is None:
                     return  # client done
                 try:
                     self._dispatch(conn, req)
+                except (ConnectionError, TimeoutError):
+                    # A transport-level failure (peer died mid-frame,
+                    # injected drop) means the CONNECTION is broken, not
+                    # the request — close it rather than answering on a
+                    # dead or desynced wire. (NOT the whole OSError tree:
+                    # PermissionError — the auth rejection — must reach
+                    # the generic handler below and be ANSWERED.) Job
+                    # state is untouched; the healed client replays on a
+                    # fresh connection.
+                    return
                 except Exception as e:  # surface to the caller, keep serving
                     logger.exception("request failed: %s", req.get("op"))
                     try:
@@ -1284,6 +1589,27 @@ class DataPlaneDaemon:
                 f"protocol version mismatch: server speaks v{protocol.PROTOCOL_VERSION}, "
                 f"request carried v={req.get('v')!r}; see docs/protocol.md"
             )
+        faults.checkpoint("daemon.op")
+        # Backpressure: past a watermark, shed HEAVY ops with a busy +
+        # retry_after_s hint instead of accepting work the host will
+        # thrash on. Ops that RELIEVE pressure (commit folds and frees
+        # stages, finalize/drop free jobs) and O(1) control ops always
+        # pass — shedding them would wedge the very recovery that brings
+        # the daemon back under its watermark.
+        if op in _SHEDDABLE_OPS:
+            reason = self._overloaded()
+            if reason is not None:
+                _drain_payload()
+                protocol.send_json(
+                    conn,
+                    {
+                        "ok": False,
+                        "busy": True,
+                        "retry_after_s": self._retry_after_s,
+                        "error": f"busy: {reason}",
+                    },
+                )
+                return
         if op == "feed":
             self._op_feed(conn, req)
         elif op == "feed_raw":
@@ -1302,7 +1628,7 @@ class DataPlaneDaemon:
             self._op_finalize(conn, req)
         elif op == "step":
             job = self._get_job(req)
-            info = job.step(_opt(req, "params", {}))
+            info = job.step(_opt(req, "params", {}), step_id=req.get("step_id"))
             protocol.send_json(conn, {"ok": True, **info})
         elif op == "status":
             job = self._get_job(req)
@@ -1349,6 +1675,8 @@ class DataPlaneDaemon:
             with self._models_lock:
                 m = self._models.pop(str(req.get("model")), None)
             protocol.send_json(conn, {"ok": True, "dropped": m is not None})
+        elif op == "health":
+            self._op_health(conn)
         elif op == "ping":
             protocol.send_json(
                 conn,
@@ -1357,6 +1685,64 @@ class DataPlaneDaemon:
             )
         else:
             raise ValueError(f"unknown op {op!r}")
+
+    # -- health & backpressure --------------------------------------------
+
+    def _staged_bytes_total(self) -> int:
+        with self._jobs_lock:
+            return sum(j.staged_bytes for j in self._jobs.values())
+
+    def _overloaded(self, staged: Optional[int] = None) -> Optional[str]:
+        """The watermark breach (None = healthy). Reads counters without
+        job locks — a watermark is a load signal, not an invariant.
+        ``staged``: a precomputed staged-bytes total, so callers that
+        also REPORT the number (health) read it once — one _jobs_lock
+        pass, and the reported value is the one the verdict used."""
+        if self._max_connections is not None:
+            with self._conns_lock:
+                n = self._active_conns
+            if n > self._max_connections:
+                return (
+                    f"{n} concurrent connections exceed the watermark "
+                    f"({self._max_connections})"
+                )
+        if self._max_staged_bytes is not None:
+            if staged is None:
+                staged = self._staged_bytes_total()
+            if staged > self._max_staged_bytes:
+                return (
+                    f"{staged} staged bytes exceed the watermark "
+                    f"({self._max_staged_bytes}); commit or drop stages"
+                )
+        return None
+
+    def _op_health(self, conn) -> None:
+        """Additive observability op: load + liveness in O(jobs) time.
+        Never shed — health is how a load balancer decides where to send
+        traffic, and a daemon too busy to say "busy" looks dead."""
+        staged_bytes = self._staged_bytes_total()
+        reason = self._overloaded(staged=staged_bytes)
+        with self._jobs_lock:
+            active_jobs = len(self._jobs)
+        with self._models_lock:
+            served_models = len(self._models)
+        with self._conns_lock:
+            queue_depth = self._active_conns
+        resp = {
+            "ok": True,
+            "v": protocol.PROTOCOL_VERSION,
+            "id": self.instance_id,
+            "queue_depth": queue_depth,
+            "staged_bytes": staged_bytes,
+            "active_jobs": active_jobs,
+            "served_models": served_models,
+            "uptime_s": float(self._clock() - self._started),
+            "busy": reason is not None,
+        }
+        if reason is not None:
+            resp["retry_after_s"] = self._retry_after_s
+            resp["busy_reason"] = reason
+        protocol.send_json(conn, resp)
 
     def _get_job(self, req) -> _Job:
         name = str(req.get("job"))
@@ -1477,6 +1863,7 @@ class DataPlaneDaemon:
                 partition=None if part is None else int(part),
                 attempt=int(_opt(req, "attempt", 0)),
                 pass_id=req.get("pass_id"),
+                feed_id=req.get("feed_id"),
             )
         except ValueError:
             if created:
@@ -1537,6 +1924,7 @@ class DataPlaneDaemon:
         name = str(req["job"])
         req_algo = str(_opt(req, "algo", "pca"))
         contrib = int(_opt(req, "rows", 0))
+        merge_id = req.get("merge_id")
         with self._jobs_lock:
             job = self._jobs.get(name)
         if job is None:
@@ -1549,7 +1937,7 @@ class DataPlaneDaemon:
             # the feed path keeps for rejected first feeds).
             job = _Job(req_algo, int(n_cols), self._mesh, req.get("params"),
                        clock=self._clock)
-            rows = job.merge_remote(arrays, contrib)
+            rows = job.merge_remote(arrays, contrib, merge_id=merge_id)
             with self._jobs_lock:
                 current = self._jobs.get(name)
                 if current is None:
@@ -1568,7 +1956,7 @@ class DataPlaneDaemon:
                 f"job {name!r} is algo {job.algo!r}; merge_state carried "
                 f"{req_algo!r}"
             )
-        rows = job.merge_remote(arrays, contrib)
+        rows = job.merge_remote(arrays, contrib, merge_id=merge_id)
         protocol.send_json(conn, {"ok": True, "rows": rows})
 
     def _op_ensure_model(self, conn, req: Dict[str, Any]) -> None:

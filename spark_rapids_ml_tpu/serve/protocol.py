@@ -26,6 +26,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils import faults
+
 #: Frozen wire-protocol version. Bump ONLY on breaking changes to
 #: existing ops' frames or semantics; new ops are additive under the
 #: same version.
@@ -40,12 +42,31 @@ class ProtocolError(RuntimeError):
     pass
 
 
+class FrameTooLarge(ProtocolError):
+    """Sender-side MAX_FRAME rejection: deterministic (the payload will
+    never fit), so retry loops must surface it instead of replaying."""
+
+
 def send_frame(sock, payload: bytes) -> None:
     if len(payload) > MAX_FRAME:
         # fail fast sender-side instead of shipping GBs the peer will reject
-        raise ProtocolError(
+        raise FrameTooLarge(
             f"frame of {len(payload)} bytes exceeds MAX_FRAME {MAX_FRAME}; "
             "split the batch"
+        )
+    faults.checkpoint("wire.send_frame")
+    cut = faults.truncation("wire.send_frame", len(payload))
+    if cut is not None:
+        # Chaos path: promise the full frame, deliver a prefix, die — the
+        # peer sees exactly what a mid-frame process death produces.
+        sock.sendall(_LEN.pack(len(payload)))
+        sock.sendall(payload[:cut])
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise faults.InjectedDrop(
+            f"injected fault: frame truncated at {cut}/{len(payload)} bytes"
         )
     sock.sendall(_LEN.pack(len(payload)))
     sock.sendall(payload)

@@ -70,6 +70,44 @@ def resolve(spark=None) -> Tuple[str, int, Optional[str]]:
     return (*_local_daemon().address, token)
 
 
+def client_kwargs(spark=None) -> dict:
+    """Resilience tuning for every data-plane client a Spark fit or
+    transform creates — how the Spark layer honors the daemon's
+    backpressure/healing contract (docs/protocol.md "Client retry
+    obligations"). Sources, env first then Spark conf:
+
+    * ``$SRML_DAEMON_TIMEOUT_S`` / ``spark.srml.daemon.timeout_s`` —
+      per-socket-syscall timeout (default 120 s).
+    * ``$SRML_DAEMON_OP_DEADLINE_S`` / ``spark.srml.daemon.op_deadline_s``
+      — per-op healing deadline: total time one op may spend across
+      reconnects, replays, and honored `busy` retry_after_s waits before
+      the failure surfaces to Spark's own task retry.
+    * ``$SRML_DAEMON_OP_ATTEMPTS`` / ``spark.srml.daemon.op_attempts`` —
+      reconnect attempts per op.
+
+    Unset keys are omitted so the client's defaults rule. Executors call
+    this with ``spark=None`` (env only — the executor's env, like the
+    ``$SRML_DAEMON_ADDRESS`` routing rule)."""
+
+    def _get(env_name: str, conf_key: str) -> Optional[str]:
+        v = os.environ.get(env_name)
+        if v is None and spark is not None:
+            v = _spark_conf_get(spark, conf_key)
+        return v
+
+    out: dict = {}
+    t = _get("SRML_DAEMON_TIMEOUT_S", "spark.srml.daemon.timeout_s")
+    if t:
+        out["timeout"] = float(t)
+    d = _get("SRML_DAEMON_OP_DEADLINE_S", "spark.srml.daemon.op_deadline_s")
+    if d:
+        out["op_deadline_s"] = float(d)
+    a = _get("SRML_DAEMON_OP_ATTEMPTS", "spark.srml.daemon.op_attempts")
+    if a:
+        out["max_op_attempts"] = int(a)
+    return out
+
+
 def resolve_all(spark=None) -> list:
     """The full daemon set for fits that must know every peer BEFORE the
     first scan (kmeans: centers are seeded on all daemons up front).

