@@ -119,14 +119,34 @@ def main() -> None:
         g, mean = gram_ops.finalize_gram(count, colsum, g, mean_center=True)
         return pca_from_gram_randomized(g, K)
 
+    from spark_rapids_ml_tpu.utils import metrics
+    from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+    fed_bytes = metrics.counter(
+        "srml_bench_fed_bytes_total",
+        "Row bytes folded through the bench's streaming update",
+    )
+
     def fit(n_batches):
+        # The same phase names fit_pca uses (the reference's NVTX names,
+        # RapidsRowMatrix.scala:62,70): the spans land in
+        # srml_phase_duration_seconds, so the BENCH record below carries
+        # the per-phase breakdown, not just the headline total.
         state = gram_ops.init_stats(D, accum_dtype="float32")
-        for _ in range(n_batches):
-            state = update(state, x, BATCH_ROWS)
-        pc, ev, _ = finalize(*state)
-        return jax.device_get((pc, ev))  # (d, k) + (k,) — tiny
+        with trace_span("compute cov"):
+            for _ in range(n_batches):
+                state = update(state, x, BATCH_ROWS)
+            # Sync before the span closes: jitted updates dispatch async,
+            # and without the block the fold's device time would land in
+            # the NEXT span — the finalize blamed for fold regressions.
+            jax.block_until_ready(state)
+            fed_bytes.inc(n_batches * BATCH_ROWS * D * 2)  # bf16 rows
+        with trace_span("eig finalize"):
+            pc, ev, _ = finalize(*state)
+            return jax.device_get((pc, ev))  # (d, k) + (k,) — tiny
 
     fit(2)  # warmup / compile
+    metrics.reset()  # the recorded snapshot covers ONLY the timed fit
 
     t0 = time.perf_counter()
     pc, ev = fit(N_BATCHES)
@@ -139,10 +159,29 @@ def main() -> None:
         "value": round(rows_per_sec_per_chip, 1),
         "unit": "rows/s/chip",
         "vs_baseline": round(rows_per_sec_per_chip / A100_CUML_ROWS_PER_SEC, 4),
+        "metrics": _metrics_breakdown(metrics.snapshot()),
     }
     if os.environ.get("SRML_BENCH_INGEST", "") in ("1", "true"):
         line.update(_ingest_inclusive(update))
     print(json.dumps(line))
+
+
+def _metrics_breakdown(snap: dict) -> dict:
+    """Registry snapshot → the compact breakdown each BENCH record
+    embeds: per-phase span durations + bytes moved. Perf trajectory
+    records then say WHERE a regression landed (fold vs finalize), not
+    just that the headline moved."""
+    phases = {}
+    for s in snap.get("srml_phase_duration_seconds", {}).get("samples", []):
+        phases[s["labels"].get("phase", "?")] = {
+            "count": s["count"],
+            "sum_s": round(float(s["sum"]), 4),
+        }
+    fed = snap.get("srml_bench_fed_bytes_total", {}).get("samples", [])
+    return {
+        "phases": phases,
+        "fed_bytes": int(fed[0]["value"]) if fed else 0,
+    }
 
 
 def _ingest_inclusive(update):

@@ -100,6 +100,15 @@ _DEFAULTS: Dict[str, Any] = {
     "use_native_bridge": _env("USE_NATIVE_BRIDGE", True, _as_bool),
     # Emit profiler trace annotations (NVTX-range equivalent; SURVEY.md §5).
     "tracing": _env("TRACING", False, _as_bool),
+    # Metrics registry master switch (utils/metrics.py): False turns every
+    # counter/gauge/histogram record into an early return. Exposition and
+    # snapshots only ever run on demand (the daemon `metrics` op).
+    "metrics": _env("METRICS", True, _as_bool),
+    # Run-journal output path (utils/journal.py): JSON-lines of run/phase
+    # events fed by trace_span. None = off (zero overhead: no event dicts,
+    # no I/O). Env key is SRML_RUN_JOURNAL — deployment-facing like
+    # SRML_DAEMON_ADDRESS / SRML_FAULT_PLAN, hence no SRML_TPU_ prefix.
+    "run_journal": os.environ.get("SRML_RUN_JOURNAL") or None,
     # Use Pallas kernels for hot ops (Gram, pairwise distance) on TPU.
     # "auto" (default) = on iff the backend is a real TPU (the per-kernel
     # shape/dtype gates still apply — see _pallas_backend_ok and friends).
@@ -191,6 +200,14 @@ def get_raw(key: str) -> Any:
         if key not in _conf:
             raise KeyError(f"unknown config key: {key!r} (known: {sorted(_conf)})")
         return _conf[key]
+
+
+def peek(key: str) -> Any:
+    """LOCK-FREE read for per-record hot paths (metrics/journal gates):
+    a single dict lookup, atomic under the GIL, no "auto" resolution and
+    no unknown-key check. Callers must pass a key that exists and is
+    never "auto" — anything else belongs on :func:`get`."""
+    return _conf.get(key)
 
 
 def set(key: str, value: Any) -> None:  # noqa: A003 - mirrors SparkConf.set

@@ -722,7 +722,8 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
         """Role-keyed device transform (daemon ``transform`` op surface)."""
         if self.centers is None:
             raise RuntimeError("KMeansModel has no centers (unfitted?)")
-        return {"prediction": self.predict(x)}
+        with trace_span("kmeans transform"):
+            return {"prediction": self.predict(x)}
 
     def _transform(self, dataset):
         if self.centers is None:

@@ -504,8 +504,9 @@ class LinearRegressionModel(Model, _LinearRegressionParams, MLWritable, MLReadab
             raise RuntimeError("model has no coefficients (unfitted?)")
         from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
 
-        y = run_bucketed(self._predictor(), x)
-        return {"prediction": y.astype(np.float64)}
+        with trace_span("linreg transform"):
+            y = run_bucketed(self._predictor(), x)
+            return {"prediction": y.astype(np.float64)}
 
     def _transform(self, dataset):
         if self.coefficients is None:

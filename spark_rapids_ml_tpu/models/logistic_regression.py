@@ -1190,13 +1190,14 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
             raise RuntimeError("model has no coefficients (unfitted?)")
         from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
 
-        raw = run_bucketed(self._raw_scorer(), x).astype(np.float64)
-        proba = self._raw_to_proba(raw)
-        return {
-            "rawPrediction": raw,
-            "probability": proba,
-            "prediction": np.argmax(proba, axis=1).astype(np.float64),
-        }
+        with trace_span("logreg transform"):
+            raw = run_bucketed(self._raw_scorer(), x).astype(np.float64)
+            proba = self._raw_to_proba(raw)
+            return {
+                "rawPrediction": raw,
+                "probability": proba,
+                "prediction": np.argmax(proba, axis=1).astype(np.float64),
+            }
 
     def _transform(self, dataset):
         if self.coefficients is None:

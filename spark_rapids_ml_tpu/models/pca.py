@@ -566,7 +566,8 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
             raise RuntimeError("PCAModel has no principal components (unfitted?)")
         from spark_rapids_ml_tpu.parallel.sharding import run_bucketed
 
-        return {"output": run_bucketed(self._projector(), x)}
+        with trace_span("pca transform"):
+            return {"output": run_bucketed(self._projector(), x)}
 
     def _transform(self, dataset):
         x = as_matrix(dataset, self.getInputCol())

@@ -157,14 +157,15 @@ class StandardScalerModel(Model, _ScalerParams, MLWritable, MLReadable):
     def transform_matrix(self, x: np.ndarray) -> dict:
         """Role-keyed transform of a bare matrix (host elementwise — the
         op is bandwidth-trivial relative to any model GEMM)."""
-        x = np.asarray(x).astype(np.float64)
-        if self.getWithMean():
-            x = x - self.mean[None, :]
-        if self.getWithStd():
-            # MLlib convention: zero-variance features multiply by 0.
-            inv = np.where(self.std > 0, 1.0 / np.where(self.std > 0, self.std, 1.0), 0.0)
-            x = x * inv[None, :]
-        return {"output": x.astype(np.float32)}
+        with trace_span("scaler transform"):
+            x = np.asarray(x).astype(np.float64)
+            if self.getWithMean():
+                x = x - self.mean[None, :]
+            if self.getWithStd():
+                # MLlib convention: zero-variance features multiply by 0.
+                inv = np.where(self.std > 0, 1.0 / np.where(self.std > 0, self.std, 1.0), 0.0)
+                x = x * inv[None, :]
+            return {"output": x.astype(np.float32)}
 
     def _transform(self, dataset):
         x = as_matrix(dataset, self.getInputCol())

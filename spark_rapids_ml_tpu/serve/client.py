@@ -34,10 +34,43 @@ import numpy as np
 
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 from spark_rapids_ml_tpu.utils.retry import decorrelated_jitter
 
 logger = get_logger("serve.client")
+
+#: Client healing telemetry (process-wide registry; per-instance deltas
+#: live in ``DataPlaneClient.stats``). A retry storm, a backoff pile-up,
+#: or a fault-injection campaign is countable here — PR 2 proved the
+#: healing works, these numbers say how often it RUNS.
+_M_RECONNECTS = metrics_mod.counter(
+    "srml_client_reconnects_total",
+    "Connection-level failures healed by reconnecting, by op",
+)
+_M_REPLAYS = metrics_mod.counter(
+    "srml_client_replays_total",
+    "Ops replayed after possibly reaching the wire, by op",
+)
+_M_BACKOFF_SECONDS = metrics_mod.counter(
+    "srml_client_backoff_seconds_total",
+    "Seconds slept in reconnect backoff (decorrelated jitter)",
+)
+_M_BUSY_WAITS = metrics_mod.counter(
+    "srml_client_busy_waits_total", "busy sheds honored with a wait, by op"
+)
+_M_BUSY_WAIT_SECONDS = metrics_mod.counter(
+    "srml_client_busy_wait_seconds_total",
+    "Seconds slept honoring busy retry_after_s hints",
+)
+_M_DEADLINE_EXPIRIES = metrics_mod.counter(
+    "srml_client_deadline_expiries_total",
+    "Ops abandoned because the per-op deadline expired, by op",
+)
+_M_FAULT_TRIPS = metrics_mod.counter(
+    "srml_client_fault_trips_total",
+    "Injected faults (utils/faults.py) observed by the healing loop, by op",
+)
 
 
 class DaemonBusy(RuntimeError):
@@ -217,11 +250,14 @@ class DataPlaneClient:
                 now = time.monotonic()
                 if deadline is not None:
                     if now + wait > deadline:
+                        _M_DEADLINE_EXPIRIES.inc(op=str(req.get("op")))
                         raise
                 elif busy_waited + wait > self._max_busy_wait:
                     raise
                 self.stats["busy_waits"] += 1
                 busy_waited += wait
+                _M_BUSY_WAITS.inc(op=str(req.get("op")))
+                _M_BUSY_WAIT_SECONDS.inc(wait)
                 logger.info(
                     "daemon busy (%s); retrying op %r in %.2fs",
                     self._addr, req.get("op"), wait,
@@ -232,6 +268,11 @@ class DataPlaneClient:
                 # socket may be mid-frame — always drop it, even on the
                 # final raise, so the NEXT op reconnects cleanly.
                 self._reset()
+                if isinstance(e, (faults.InjectedDrop, faults.InjectedRefusal)):
+                    # Chaos accounting: the very faults test_chaos injects
+                    # must be countable (the acceptance check that healing
+                    # telemetry is real, not decorative).
+                    _M_FAULT_TRIPS.inc(op=str(req.get("op")))
                 attempt += 1
                 if attempt >= self._max_attempts:
                     raise
@@ -239,13 +280,17 @@ class DataPlaneClient:
                     delay, self._backoff_base, self._backoff_max, self._rng
                 )
                 if deadline is not None and time.monotonic() + delay > deadline:
+                    _M_DEADLINE_EXPIRIES.inc(op=str(req.get("op")))
                     raise
                 self.stats["reconnects"] += 1
+                _M_RECONNECTS.inc(op=str(req.get("op")))
+                _M_BACKOFF_SECONDS.inc(delay)
                 if sent["flag"]:
                     # Only a request that may have reached the wire is a
                     # REPLAY; a failed connect or pre-send fault is just a
                     # reconnect.
                     self.stats["replays"] += 1
+                    _M_REPLAYS.inc(op=str(req.get("op")))
                 logger.warning(
                     "connection failure on op %r to %s (attempt %d/%d, "
                     "reconnect in %.2fs): %s",
@@ -282,6 +327,18 @@ class DataPlaneClient:
         shedding heavy ops; ``retry_after_s`` carries its hint)."""
         resp, _ = self._roundtrip({"op": "health"})
         return {k: v for k, v in resp.items() if k != "ok"}
+
+    def metrics(self, format: str = "json"):
+        """Daemon metrics (additive op): the daemon process's registry
+        snapshot — per-op request counts + latency histograms (cumulative
+        buckets), rx/tx byte counters, busy sheds, replay hits, phase
+        durations (docs/observability.md). ``format="json"`` (default)
+        returns the snapshot dict; ``"prometheus"`` returns the text
+        exposition (v0.0.4) string."""
+        resp, _ = self._roundtrip({"op": "metrics", "format": format})
+        if format == "prometheus":
+            return str(resp.get("text", ""))
+        return resp.get("metrics", {})
 
     def server_id(self) -> Optional[str]:
         """The daemon's self-reported instance id (from ping). Address

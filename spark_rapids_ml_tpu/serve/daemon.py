@@ -64,9 +64,50 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import row_sharding
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.daemon")
+
+#: Daemon telemetry (docs/observability.md catalogs all of these). The
+#: additive `metrics` wire op exposes the whole registry; `tools.top`
+#: renders it live.
+_M_REQUESTS = metrics_mod.counter(
+    "srml_daemon_requests_total",
+    "Requests dispatched, by op and outcome (ok|error|transport)",
+)
+_M_REQ_SECONDS = metrics_mod.histogram(
+    "srml_daemon_request_seconds", "Request handling latency, by op"
+)
+_M_RX_BYTES = metrics_mod.counter(
+    "srml_daemon_rx_bytes_total",
+    "Payload bytes received (Arrow/raw frames, headers excluded), by op",
+)
+_M_TX_BYTES = metrics_mod.counter(
+    "srml_daemon_tx_bytes_total",
+    "Response array bytes sent (headers excluded), by op",
+)
+_M_BUSY_SHEDS = metrics_mod.counter(
+    "srml_daemon_busy_sheds_total",
+    "Ops shed with busy under a backpressure watermark, by op",
+)
+_M_REPLAY_HITS = metrics_mod.counter(
+    "srml_daemon_replay_hits_total",
+    "Deduplicated replays, by kind (feed|merge|step|committed_partition)",
+)
+_M_CONNS = metrics_mod.gauge(
+    "srml_daemon_active_connections",
+    "Concurrently open connections (at scrape)",
+)
+_M_STAGED = metrics_mod.gauge(
+    "srml_daemon_staged_bytes", "Bytes held by uncommitted stages (at scrape)"
+)
+_M_JOBS = metrics_mod.gauge(
+    "srml_daemon_active_jobs", "Registered accumulation jobs (at scrape)"
+)
+_M_MODELS = metrics_mod.gauge(
+    "srml_daemon_served_models", "Registered served models (at scrape)"
+)
 
 #: Device-build cap for daemon-side IVF (bytes of raw f32 rows): past
 #: this, the full (n, d) matrix would not fit one chip's HBM alongside
@@ -102,6 +143,22 @@ _SHEDDABLE_OPS = (
 #: after any job/model lock, never before one — so lock order stays
 #: acyclic.
 _DEVICE_LOCK = threading.Lock()
+
+#: Every op _dispatch understands — the clamp for metric labels: a
+#: label from the wire would let any client (or fuzzer) mint unbounded
+#: registry series; unknown op strings all land under op="unknown".
+_KNOWN_OPS = frozenset((
+    "ping", "health", "metrics", "status", "feed", "feed_raw", "seed",
+    "commit", "step", "finalize", "drop", "export_state", "merge_state",
+    "get_iterate", "set_iterate", "ensure_model", "transform",
+    "kneighbors", "model_status", "drop_model",
+))
+
+
+def _op_label(op) -> str:
+    op = str(op)
+    return op if op in _KNOWN_OPS else "unknown"
+
 
 #: Cap on a request's declared raw-array frame count (_recv_arrays_aligned):
 #: the widest legitimate op is a multinomial merge_state (7 state leaves) or
@@ -185,11 +242,33 @@ def _recv_arrays_aligned(conn, req: Dict[str, Any]) -> Dict[str, np.ndarray]:
                 f"{want}"
             )
         frames.append(frame)
+    if sizes:
+        _M_RX_BYTES.inc(sum(sizes), op=_op_label(req.get("op")))
     out: Dict[str, np.ndarray] = {}
     for spec, frame in zip(specs, frames):
         arr = np.frombuffer(frame, dtype=np.dtype(spec["dtype"]))
         out[str(spec["name"])] = arr.reshape(spec["shape"]).copy()
     return out
+
+
+def _recv_payload_counted(conn, op: str) -> bytes:
+    """One payload frame + the per-op RX byte accounting — the receive
+    twin of :func:`_send_arrays_counted`, so no payload-carrying op can
+    forget the accounting."""
+    payload = protocol.recv_frame(conn)
+    if payload is None:
+        raise protocol.ProtocolError(f"connection closed before {op} payload")
+    _M_RX_BYTES.inc(len(payload), op=op)
+    return payload
+
+
+def _send_arrays_counted(conn, op: str, arrays, meta) -> None:
+    """protocol.send_arrays + per-op TX byte accounting (array bytes;
+    JSON headers are noise next to the frames that matter here)."""
+    protocol.send_arrays(conn, arrays, meta)
+    _M_TX_BYTES.inc(
+        sum(int(np.asarray(v).nbytes) for v in arrays.values()), op=op
+    )
 
 
 class _Stage:
@@ -483,9 +562,14 @@ class _Job:
         if feed_id is None:
             return False
         feed_id = str(feed_id)
-        if stage is not None:
-            return feed_id in stage.seen
-        return feed_id in self._seen_feed_ids
+        hit = (
+            feed_id in stage.seen
+            if stage is not None
+            else feed_id in self._seen_feed_ids
+        )
+        if hit:
+            _M_REPLAY_HITS.inc(kind="feed")
+        return hit
 
     def _mark_folded(self, feed_id: Optional[str], stage: Optional[_Stage]) -> None:
         """Record a successfully folded feed_id (under the job lock)."""
@@ -527,6 +611,7 @@ class _Job:
                     raise KeyError("job was finalized/dropped; rows not accepted")
                 self.touched = self._clock()
                 if partition is not None and partition in self.committed:
+                    _M_REPLAY_HITS.inc(kind="committed_partition")
                     return
                 if partition is None:
                     if self._is_replay(feed_id, None):
@@ -559,7 +644,9 @@ class _Job:
             self._check_pass(pass_id)
             self.touched = self._clock()
             if partition is not None and partition in self.committed:
-                return  # duplicate of a committed task (retry/speculation)
+                # duplicate of a committed task (retry/speculation)
+                _M_REPLAY_HITS.inc(kind="committed_partition")
+                return
             if self.algo == "kmeans" and self.centers is None:
                 if partition is not None:
                     raise ValueError(
@@ -656,6 +743,7 @@ class _Job:
             self._check_pass(pass_id)
             self.touched = self._clock()
             if partition in self.committed:
+                _M_REPLAY_HITS.inc(kind="committed_partition")
                 return self.rows
             staged = self._drop_stage((partition, attempt))
             if staged is None:
@@ -740,6 +828,7 @@ class _Job:
                 raise ValueError("knn jobs cannot merge remote state")
             self.touched = self._clock()
             if merge_id is not None and str(merge_id) in self._seen_merge_ids:
+                _M_REPLAY_HITS.inc(kind="merge")
                 return self.rows
             leaves, treedef = jax.tree_util.tree_flatten(self.state)
             if len(arrays) != len(leaves):
@@ -879,6 +968,7 @@ class _Job:
                 and self._last_step_info is not None
                 and str(step_id) == self._last_step_id
             ):
+                _M_REPLAY_HITS.inc(kind="step")
                 return dict(self._last_step_info)
             # A new pass re-feeds every partition against the new iterate:
             # clear this pass's staging + committed set (zombie traffic from
@@ -1539,6 +1629,9 @@ class DataPlaneDaemon:
                     return  # transport died mid-read
                 if req is None:
                     return  # client done
+                op = _op_label(req.get("op"))
+                t0 = time.perf_counter()
+                outcome = "ok"
                 try:
                     self._dispatch(conn, req)
                 except (ConnectionError, TimeoutError):
@@ -1550,13 +1643,20 @@ class DataPlaneDaemon:
                     # the generic handler below and be ANSWERED.) Job
                     # state is untouched; the healed client replays on a
                     # fresh connection.
+                    outcome = "transport"
                     return
                 except Exception as e:  # surface to the caller, keep serving
+                    outcome = "error"
                     logger.exception("request failed: %s", req.get("op"))
                     try:
                         protocol.send_json(conn, {"ok": False, "error": str(e)})
                     except OSError:
                         return
+                finally:
+                    # Per-op request accounting (a shed op counts "ok"
+                    # here; srml_daemon_busy_sheds_total carries the shed).
+                    _M_REQ_SECONDS.observe(time.perf_counter() - t0, op=op)
+                    _M_REQUESTS.inc(op=op, outcome=outcome)
 
     def _dispatch(self, conn, req: Dict[str, Any]) -> None:
         op = req.get("op")
@@ -1599,6 +1699,7 @@ class DataPlaneDaemon:
         if op in _SHEDDABLE_OPS:
             reason = self._overloaded()
             if reason is not None:
+                _M_BUSY_SHEDS.inc(op=_op_label(op))
                 _drain_payload()
                 protocol.send_json(
                     conn,
@@ -1645,13 +1746,13 @@ class DataPlaneDaemon:
         elif op == "export_state":
             job = self._get_job(req)
             arrays, meta = job.export_state()
-            protocol.send_arrays(conn, arrays, {"ok": True, **meta})
+            _send_arrays_counted(conn, "export_state", arrays, {"ok": True, **meta})
         elif op == "merge_state":
             self._op_merge_state(conn, req)
         elif op == "get_iterate":
             job = self._get_job(req)
             arrays, meta = job.get_iterate()
-            protocol.send_arrays(conn, arrays, {"ok": True, **meta})
+            _send_arrays_counted(conn, "get_iterate", arrays, {"ok": True, **meta})
         elif op == "set_iterate":
             arrays = _recv_arrays_aligned(conn, req)
             job = self._get_job(req)
@@ -1677,6 +1778,8 @@ class DataPlaneDaemon:
             protocol.send_json(conn, {"ok": True, "dropped": m is not None})
         elif op == "health":
             self._op_health(conn)
+        elif op == "metrics":
+            self._op_metrics(conn, req)
         elif op == "ping":
             protocol.send_json(
                 conn,
@@ -1744,6 +1847,39 @@ class DataPlaneDaemon:
             resp["busy_reason"] = reason
         protocol.send_json(conn, resp)
 
+    def _op_metrics(self, conn, req: Dict[str, Any]) -> None:
+        """Additive observability op: the process-wide metrics registry
+        (per-op request counts + latency histograms, byte counters, busy
+        sheds, replay hits, phase durations — docs/observability.md has
+        the catalog). Level gauges are refreshed at scrape time, so the
+        snapshot is self-consistent with what `health` would report.
+        ``format``: "json" (default — the registry snapshot, histogram
+        buckets cumulative) or "prometheus" (text exposition v0.0.4 in
+        ``text``). Never shed: a scrape is O(registry) host work and is
+        exactly what an operator needs most when the daemon is busy."""
+        _M_STAGED.set(self._staged_bytes_total())
+        with self._jobs_lock:
+            _M_JOBS.set(len(self._jobs))
+        with self._models_lock:
+            _M_MODELS.set(len(self._models))
+        with self._conns_lock:
+            _M_CONNS.set(self._active_conns)
+        fmt = str(_opt(req, "format", "json"))
+        base = {
+            "ok": True,
+            "v": protocol.PROTOCOL_VERSION,
+            "id": self.instance_id,
+            "uptime_s": float(self._clock() - self._started),
+        }
+        if fmt == "prometheus":
+            protocol.send_json(
+                conn, {**base, "text": metrics_mod.render_prometheus()}
+            )
+        elif fmt == "json":
+            protocol.send_json(conn, {**base, "metrics": metrics_mod.snapshot()})
+        else:
+            raise ValueError(f"unknown metrics format {fmt!r} (json|prometheus)")
+
     def _get_job(self, req) -> _Job:
         name = str(req.get("job"))
         with self._jobs_lock:
@@ -1756,9 +1892,7 @@ class DataPlaneDaemon:
 
         from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
 
-        payload = protocol.recv_frame(conn)
-        if payload is None:
-            raise protocol.ProtocolError("connection closed before feed payload")
+        payload = _recv_payload_counted(conn, "feed")
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         input_col = _opt(req, "input_col", "features")
@@ -1892,9 +2026,7 @@ class DataPlaneDaemon:
 
         from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
 
-        payload = protocol.recv_frame(conn)
-        if payload is None:
-            raise protocol.ProtocolError("connection closed before seed payload")
+        payload = _recv_payload_counted(conn, "seed")
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         name = str(req["job"])
@@ -1993,9 +2125,7 @@ class DataPlaneDaemon:
 
         from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
 
-        payload = protocol.recv_frame(conn)
-        if payload is None:
-            raise protocol.ProtocolError("connection closed before transform payload")
+        payload = _recv_payload_counted(conn, "transform")
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         name = str(req["model"])
@@ -2007,7 +2137,9 @@ class DataPlaneDaemon:
             table, _opt(req, "input_col", "features"), req.get("n_cols")
         )
         outs = served.transform(x)
-        protocol.send_arrays(conn, outs, {"ok": True, "rows": int(x.shape[0])})
+        _send_arrays_counted(
+            conn, "transform", outs, {"ok": True, "rows": int(x.shape[0])}
+        )
 
     def _op_kneighbors(self, conn, req: Dict[str, Any]) -> None:
         """Query a daemon-registered KNN/ANN index: query batch in, the
@@ -2017,9 +2149,7 @@ class DataPlaneDaemon:
 
         from spark_rapids_ml_tpu.bridge.arrow import table_column_to_matrix
 
-        payload = protocol.recv_frame(conn)
-        if payload is None:
-            raise protocol.ProtocolError("connection closed before kneighbors payload")
+        payload = _recv_payload_counted(conn, "kneighbors")
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         name = str(req["model"])
@@ -2036,8 +2166,9 @@ class DataPlaneDaemon:
         )
         k = req.get("k")
         dists, idx = served.kneighbors(q, None if k is None else int(k))
-        protocol.send_arrays(
+        _send_arrays_counted(
             conn,
+            "kneighbors",
             {"distances": np.asarray(dists, np.float64),
              "indices": np.asarray(idx, np.int64)},
             {"ok": True, "rows": int(q.shape[0])},
@@ -2077,8 +2208,9 @@ class DataPlaneDaemon:
                 )
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
-            protocol.send_arrays(
-                conn, info, {"ok": True, "rows": job.rows, "model": name}
+            _send_arrays_counted(
+                conn, "finalize", info,
+                {"ok": True, "rows": job.rows, "model": name},
             )
             return
         drop = bool(_opt(req, "drop", True))
@@ -2088,4 +2220,6 @@ class DataPlaneDaemon:
         if drop:
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
-        protocol.send_arrays(conn, arrays, {"ok": True, "rows": job.rows})
+        _send_arrays_counted(
+            conn, "finalize", arrays, {"ok": True, "rows": job.rows}
+        )

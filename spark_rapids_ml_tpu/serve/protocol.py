@@ -27,6 +27,18 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as _metrics
+
+#: Wire-level byte accounting (length prefixes included). Both roles of
+#: this process count here — a daemon process's numbers are the daemon's,
+#: a Spark executor's are its client's (one process, one role in any
+#: real deployment; the daemon additionally keeps per-op byte counters).
+_TX_BYTES = _metrics.counter(
+    "srml_wire_tx_bytes_total", "Frame bytes sent by this process"
+)
+_RX_BYTES = _metrics.counter(
+    "srml_wire_rx_bytes_total", "Frame bytes received by this process"
+)
 
 #: Frozen wire-protocol version. Bump ONLY on breaking changes to
 #: existing ops' frames or semantics; new ops are additive under the
@@ -70,6 +82,7 @@ def send_frame(sock, payload: bytes) -> None:
         )
     sock.sendall(_LEN.pack(len(payload)))
     sock.sendall(payload)
+    _TX_BYTES.inc(_LEN.size + len(payload))
 
 
 def recv_exact(sock, n: int) -> Optional[bytes]:
@@ -89,7 +102,10 @@ def recv_frame(sock) -> Optional[bytes]:
     (n,) = _LEN.unpack(header)
     if n > MAX_FRAME:
         raise ProtocolError(f"frame of {n} bytes exceeds MAX_FRAME {MAX_FRAME}")
-    return recv_exact(sock, n)
+    payload = recv_exact(sock, n)
+    if payload is not None:
+        _RX_BYTES.inc(_LEN.size + n)
+    return payload
 
 
 def send_json(sock, obj: Dict[str, Any]) -> None:

@@ -37,6 +37,8 @@ from typing import Any, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.spark import daemon_session
+from spark_rapids_ml_tpu.utils import journal
+from spark_rapids_ml_tpu.utils.profiling import trace_span
 
 
 def _pyspark():
@@ -349,6 +351,14 @@ class _SparkAdapter:
         return _SparkModelAdapter(core_model)
 
     def _fit_knn(self, df):
+        """Journal-wrapped shell — see :meth:`_fit_knn_inner`."""
+        with journal.run(
+            "fit", estimator=type(self).__name__, algo="knn",
+            uid=self._core.uid,
+        ):
+            return self._fit_knn_inner(df)
+
+    def _fit_knn_inner(self, df):
         """Daemon-fed KNN/ANN fit: executors stream partitions to a knn
         accumulation job; finalize BUILDS the index on the daemon's
         devices and registers it for kneighbors serving. The dataset (and
@@ -388,9 +398,10 @@ class _SparkAdapter:
         fn = _FeedTask(
             host, port, token, job, "knn", input_col, "label", {}, None
         )
-        acks = sel.mapInArrow(
-            fn, "partition int, rows long, daemon string, daemon_id string"
-        ).collect()
+        with trace_span("feed pass"):
+            acks = sel.mapInArrow(
+                fn, "partition int, rows long, daemon string, daemon_id string"
+            ).collect()
         total, per_daemon, addr_of, _ = _ack_rows(acks)
         if total == 0:
             raise ValueError("cannot fit on an empty DataFrame")
@@ -467,30 +478,31 @@ class _SparkAdapter:
         try:
             from concurrent.futures import ThreadPoolExecutor
 
-            if ivf and multi:
-                # The first build is the quantizer owner — it must run
-                # before the peers; the peers' dataset-sized builds are
-                # then independent and run CONCURRENTLY (fit wall-clock =
-                # first + max of the rest, not the sum over daemons).
-                first_info, first_shard = _finalize_shard(
-                    daemon_ids[0], first=True
-                )
-                shards.append(first_shard)
-                cent = first_info["centroids"]
-                rest = daemon_ids[1:]
-                with ThreadPoolExecutor(max_workers=min(len(rest), 16)) as ex:
-                    futs = [ex.submit(_finalize_shard, did, cent)
-                            for did in rest]
-                    shards.extend(f.result()[1] for f in futs)
-            else:
-                # Exact mode (or one daemon): no cross-shard dependency —
-                # every build runs concurrently.
-                with ThreadPoolExecutor(
-                    max_workers=min(len(daemon_ids), 16)
-                ) as ex:
-                    futs = [ex.submit(_finalize_shard, did)
-                            for did in daemon_ids]
-                    shards.extend(f.result()[1] for f in futs)
+            with trace_span("knn build"):
+                if ivf and multi:
+                    # The first build is the quantizer owner — it must run
+                    # before the peers; the peers' dataset-sized builds are
+                    # then independent and run CONCURRENTLY (fit wall-clock =
+                    # first + max of the rest, not the sum over daemons).
+                    first_info, first_shard = _finalize_shard(
+                        daemon_ids[0], first=True
+                    )
+                    shards.append(first_shard)
+                    cent = first_info["centroids"]
+                    rest = daemon_ids[1:]
+                    with ThreadPoolExecutor(max_workers=min(len(rest), 16)) as ex:
+                        futs = [ex.submit(_finalize_shard, did, cent)
+                                for did in rest]
+                        shards.extend(f.result()[1] for f in futs)
+                else:
+                    # Exact mode (or one daemon): no cross-shard dependency —
+                    # every build runs concurrently.
+                    with ThreadPoolExecutor(
+                        max_workers=min(len(daemon_ids), 16)
+                    ) as ex:
+                        futs = [ex.submit(_finalize_shard, did)
+                                for did in daemon_ids]
+                        shards.extend(f.result()[1] for f in futs)
         except Exception:
             _cleanup(drop_models=[name])
             raise
@@ -516,6 +528,17 @@ class _SparkAdapter:
     # -- distributed fit ---------------------------------------------------
 
     def _fit_distributed(self, df):
+        """Journal-wrapped shell — the run journal (env
+        ``SRML_RUN_JOURNAL``) gets one run per fit, with every feed
+        pass / step / merge / finalize phase nested under it; see
+        :meth:`_fit_distributed_inner` for the actual protocol."""
+        with journal.run(
+            "fit", estimator=type(self).__name__, algo=self._daemon_algo,
+            uid=self._core.uid,
+        ):
+            return self._fit_distributed_inner(df)
+
+    def _fit_distributed_inner(self, df):
         """Executor-fed fit: partition batches flow task→daemon, the
         driver sees only O(d²) finalize output — the reference's
         partition-Gram + small-partials property (RapidsRowMatrix.scala:
@@ -627,10 +650,11 @@ class _SparkAdapter:
                     host, port, token, job, wire_algo, input_col,
                     label_col or "label", feed_params, pass_id,
                 )
-                acks = sel.mapInArrow(
-                    fn,
-                    "partition int, rows long, daemon string, daemon_id string",
-                ).collect()
+                with trace_span("feed pass"):
+                    acks = sel.mapInArrow(
+                        fn,
+                        "partition int, rows long, daemon string, daemon_id string",
+                    ).collect()
                 n, per, addr_of, owner = _ack_rows(acks)
                 for did, cnt in per.items():
                     fed_by_daemon[did] = fed_by_daemon.get(did, 0) + cnt
@@ -661,18 +685,20 @@ class _SparkAdapter:
                             )
                         peers[did] = daemon_session._parse_addr(addr_of[did])
                 if merge:
-                    _merge_peer_daemons(
-                        client, job, primary_id, per, addr_of, owner,
-                        peer_client, wire_algo, feed_params,
-                        drop_peer=drop_peer,
-                    )
+                    with trace_span("merge peers"):
+                        _merge_peer_daemons(
+                            client, job, primary_id, per, addr_of, owner,
+                            peer_client, wire_algo, feed_params,
+                            drop_peer=drop_peer,
+                        )
                 total_fed += n
                 return n
 
             def finalize_guarded(params):
                 """Primary finalize + the split-brain row guard: the
                 daemon-accounted total must equal what tasks acked."""
-                arrays, fin_rows = client.finalize(job, params)
+                with trace_span("finalize"):
+                    arrays, fin_rows = client.finalize(job, params)
                 if fin_rows != total_fed:
                     detail = ", ".join(
                         f"{addr_by_id.get(d, d)}={n}"
@@ -750,7 +776,8 @@ class _SparkAdapter:
                 for it in range(core.getMaxIter()):
                     if run_pass(it) == 0:
                         raise ValueError("cannot fit on an empty DataFrame")
-                    info = client.step(job)
+                    with trace_span("step"):
+                        info = client.step(job)
                     # Every peer opens the new pass with the primary's
                     # post-step centers (set_iterate resets its pass
                     # stats) — the cross-host Lloyd lockstep. Runs even on
@@ -792,7 +819,8 @@ class _SparkAdapter:
                     rows = run_pass(it)
                     if rows == 0:
                         raise ValueError("cannot fit on an empty DataFrame")
-                    info = client.step(job, params=step_params)
+                    with trace_span("step"):
+                        info = client.step(job, params=step_params)
                     if info["delta"] <= core.getTol():
                         break  # converged: nothing reads a peer sync now
                     # Peers open the new pass with the primary's post-step

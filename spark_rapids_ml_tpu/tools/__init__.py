@@ -1,0 +1,6 @@
+"""Operator CLIs (``python -m spark_rapids_ml_tpu.tools.<name>``).
+
+These are deliberately thin shells over the wire ops any client can
+speak (``health`` / ``metrics``, docs/protocol.md) — the same numbers a
+real scrape pipeline would collect, rendered for a human terminal.
+"""

@@ -1,0 +1,211 @@
+"""``top`` for the data-plane daemon: live queue depth, op rates, latency.
+
+Polls a running daemon's additive ``health`` + ``metrics`` wire ops
+(docs/protocol.md) and renders a per-op table — request totals, rates
+since the previous poll, latency quantiles interpolated from the
+cumulative histogram buckets, and payload byte rates — plus the
+trace_span phase breakdown. Nothing here is privileged: it reads exactly
+what any scraper reads, so the number an operator stares at IS the
+number the dashboard records.
+
+Usage::
+
+    python -m spark_rapids_ml_tpu.tools.top [host:port] \
+        [--interval 2] [--count N] [--once] [--token SECRET]
+
+``host:port`` defaults to ``$SRML_DAEMON_ADDRESS``. ``--once`` prints a
+single snapshot and exits (scripts/tests); the default loop redraws in
+place until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REQ = "srml_daemon_requests_total"
+LAT = "srml_daemon_request_seconds"
+RX = "srml_daemon_rx_bytes_total"
+TX = "srml_daemon_tx_bytes_total"
+PHASES = "srml_phase_duration_seconds"
+
+
+def quantile_from_buckets(buckets: Dict[str, int], q: float) -> Optional[float]:
+    """Estimate the q-quantile (0 < q < 1) from CUMULATIVE le→count
+    buckets (the snapshot/Prometheus shape), linearly interpolating
+    inside the target bucket. None when empty; the +Inf bucket clamps to
+    the largest finite bound (no upper edge to interpolate against)."""
+    pairs: List[Tuple[float, int]] = sorted(
+        (math.inf if le == "+Inf" else float(le), n)
+        for le, n in buckets.items()
+    )
+    if not pairs or pairs[-1][1] <= 0:
+        return None
+    total = pairs[-1][1]
+    target = q * total
+    prev_bound, prev_count = 0.0, 0
+    for bound, count in pairs:
+        if count >= target:
+            if math.isinf(bound):
+                return prev_bound
+            if count == prev_count:
+                return bound
+            frac = (target - prev_count) / (count - prev_count)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_count = (0.0 if math.isinf(bound) else bound), count
+    return prev_bound
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TB"
+
+
+def _fmt_secs(s: Optional[float]) -> str:
+    if s is None:
+        return "-"
+    if s < 1e-3:
+        return f"{s * 1e6:.0f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+def _sum_by_op(metric: Optional[Dict[str, Any]], value_key: str = "value"
+               ) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for s in (metric or {}).get("samples", []):
+        op = s["labels"].get("op", "")
+        out[op] = out.get(op, 0.0) + float(s.get(value_key, 0.0))
+    return out
+
+
+def _hist_by_label(metric: Optional[Dict[str, Any]], label: str
+                   ) -> Dict[str, Dict[str, Any]]:
+    return {
+        s["labels"].get(label, ""): s
+        for s in (metric or {}).get("samples", [])
+    }
+
+
+def render(
+    health: Dict[str, Any],
+    snap: Dict[str, Any],
+    prev: Optional[Dict[str, Any]] = None,
+    dt: Optional[float] = None,
+) -> str:
+    """One screenful from a health dict + metrics snapshot; ``prev``/
+    ``dt`` (the previous snapshot and the seconds between them) turn
+    totals into rates. Pure function — the unit under test."""
+    lines: List[str] = []
+    busy = " [BUSY: %s]" % health.get("busy_reason") if health.get("busy") else ""
+    lines.append(
+        "daemon %s — up %.0fs  conns %d  staged %s  jobs %d  models %d%s"
+        % (
+            health.get("id", "?"),
+            float(health.get("uptime_s", 0.0)),
+            int(health.get("queue_depth", 0)),
+            _fmt_bytes(float(health.get("staged_bytes", 0))),
+            int(health.get("active_jobs", 0)),
+            int(health.get("served_models", 0)),
+            busy,
+        )
+    )
+    reqs = _sum_by_op(snap.get(REQ))
+    prev_reqs = _sum_by_op((prev or {}).get(REQ))
+    lat = _hist_by_label(snap.get(LAT), "op")
+    rx = _sum_by_op(snap.get(RX))
+    tx = _sum_by_op(snap.get(TX))
+    lines.append("")
+    lines.append(
+        f"{'op':<14}{'reqs':>8}{'rate/s':>9}{'p50':>9}{'p90':>9}"
+        f"{'p99':>9}{'rx':>10}{'tx':>10}"
+    )
+    for op in sorted(reqs):
+        h = lat.get(op)
+        buckets = h.get("buckets", {}) if h else {}
+        rate = ""
+        if prev is not None and dt:
+            rate = f"{max(reqs[op] - prev_reqs.get(op, 0.0), 0.0) / dt:.1f}"
+        lines.append(
+            f"{op:<14}{int(reqs[op]):>8}{rate:>9}"
+            f"{_fmt_secs(quantile_from_buckets(buckets, 0.50)):>9}"
+            f"{_fmt_secs(quantile_from_buckets(buckets, 0.90)):>9}"
+            f"{_fmt_secs(quantile_from_buckets(buckets, 0.99)):>9}"
+            f"{_fmt_bytes(rx.get(op, 0.0)):>10}"
+            f"{_fmt_bytes(tx.get(op, 0.0)):>10}"
+        )
+    phases = _hist_by_label(snap.get(PHASES), "phase")
+    if phases:
+        lines.append("")
+        lines.append(f"{'phase':<22}{'count':>8}{'total':>10}{'p50':>9}{'p99':>9}")
+        for name in sorted(phases):
+            s = phases[name]
+            lines.append(
+                f"{name:<22}{int(s.get('count', 0)):>8}"
+                f"{_fmt_secs(float(s.get('sum', 0.0))):>10}"
+                f"{_fmt_secs(quantile_from_buckets(s.get('buckets', {}), 0.50)):>9}"
+                f"{_fmt_secs(quantile_from_buckets(s.get('buckets', {}), 0.99)):>9}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_ml_tpu.tools.top",
+        description="Live telemetry for a data-plane daemon "
+        "(health + metrics wire ops).",
+    )
+    ap.add_argument(
+        "address", nargs="?", default=os.environ.get("SRML_DAEMON_ADDRESS"),
+        help="daemon host:port (default: $SRML_DAEMON_ADDRESS)",
+    )
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls (default 2)")
+    ap.add_argument("--count", type=int, default=0,
+                    help="number of polls, 0 = until interrupted")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen redraw)")
+    ap.add_argument("--token", default=os.environ.get("SRML_DAEMON_TOKEN"),
+                    help="shared-secret daemon token (default: "
+                    "$SRML_DAEMON_TOKEN)")
+    args = ap.parse_args(argv)
+    if not args.address:
+        ap.error("no daemon address: pass host:port or set $SRML_DAEMON_ADDRESS")
+
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+    from spark_rapids_ml_tpu.spark.daemon_session import _parse_addr
+
+    host, port = _parse_addr(args.address)
+    prev_snap: Optional[Dict[str, Any]] = None
+    prev_t: Optional[float] = None
+    polls = 0
+    with DataPlaneClient(host, port, token=args.token) as client:
+        while True:
+            health = client.health()
+            snap = client.metrics()
+            now = time.monotonic()
+            dt = None if prev_t is None else now - prev_t
+            body = render(health, snap, prev_snap, dt)
+            if args.once or args.count:
+                print(body)
+                print()
+            else:
+                # In-place redraw: clear + home, like top(1).
+                print("\x1b[2J\x1b[H" + body, flush=True)
+            polls += 1
+            if args.once or (args.count and polls >= args.count):
+                return 0
+            prev_snap, prev_t = snap, now
+            time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

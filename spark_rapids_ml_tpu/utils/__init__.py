@@ -1,4 +1,5 @@
 from spark_rapids_ml_tpu.utils.profiling import trace_span, Timer
 from spark_rapids_ml_tpu.utils.logging import get_logger
+from spark_rapids_ml_tpu.utils import journal, metrics
 
-__all__ = ["trace_span", "Timer", "get_logger"]
+__all__ = ["trace_span", "Timer", "get_logger", "journal", "metrics"]

@@ -1,0 +1,229 @@
+"""Structured run journal: one JSON line per run/phase event.
+
+Where ``utils/metrics.py`` answers "how is the system doing in aggregate",
+the journal answers "what did THIS fit do": every ``trace_span`` phase
+(gram fold, eigensolve, Lloyd pass, solve, transform …) becomes one line
+carrying ``run_id`` / ``span_id`` / ``parent_id``, so a fit's per-phase
+breakdown is a one-liner of ``jq`` away — the queryable form of the
+reference's NVTX ranges, which only a profiler GUI could read.
+
+Activation: set the env ``SRML_RUN_JOURNAL=/path/to/journal.jsonl``
+(deployment-facing, so no ``SRML_TPU_`` prefix — same family as
+``SRML_DAEMON_ADDRESS`` / ``SRML_FAULT_PLAN``), or programmatically
+``config.set("run_journal", path)``. Unset, every hook is one config read
+and an early return — no event dict, no JSON encoding, no I/O ("zero
+allocation of journal lines", the production state).
+
+Line schema (all events)::
+
+    {"ts": <unix seconds, event START>, "pid": int,
+     "event": "run_start" | "run_end" | "phase" | "mark",
+     "run_id": hex, "span_id": hex, "parent_id": hex | null,
+     "name": str, ...}
+
+``run_end`` and ``phase`` additionally carry ``duration_s``. Extra
+keyword fields pass through verbatim (estimator class, algo, job name).
+Nesting is per-thread: spans opened inside a ``run()`` (or inside another
+span) parent to it; a span on a thread with no open run becomes its own
+root (fresh ``run_id``, ``parent_id`` null) — daemon-side phases journal
+standalone. Files are opened append-mode and written one line per event
+under a lock, so daemon threads (and multiple processes on a shared
+file, via O_APPEND line writes) interleave whole lines, never halves.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["enabled", "run", "span", "mark", "read", "close"]
+
+_lock = threading.Lock()
+_files: Dict[str, Any] = {}  # path -> open append handle
+_tls = threading.local()
+#: Latched True after a write failure (bad path, disk full, read-only
+#: FS): telemetry must NEVER take the workload down — the journal logs
+#: one warning, disables itself for the process, and every fit keeps
+#: running. close() re-arms (a fresh path can be configured after).
+_broken = False
+
+
+def _path() -> Optional[str]:
+    if _broken:
+        return None
+    from spark_rapids_ml_tpu import config
+
+    p = config.peek("run_journal")
+    return str(p) if p else None
+
+
+def enabled() -> bool:
+    """True when a journal path is configured for this process."""
+    return _path() is not None
+
+
+def _stack() -> List[Tuple[str, str]]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current() -> Tuple[Optional[str], Optional[str]]:
+    """(run_id, span_id) of this thread's innermost open frame."""
+    s = _stack()
+    return s[-1] if s else (None, None)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _write(path: str, obj: Dict[str, Any]) -> None:
+    global _broken
+    line = json.dumps(obj, separators=(",", ":"), default=str) + "\n"
+    try:
+        with _lock:
+            f = _files.get(path)
+            if f is None:
+                f = _files[path] = open(path, "a", encoding="utf-8")
+            f.write(line)
+            f.flush()
+    except (OSError, ValueError) as e:  # ValueError: write on closed file
+        # Emitted from finally blocks (span/run exits): raising here would
+        # MASK the workload's own in-flight exception — and an unwritable
+        # journal path must not fail fits. Warn once, self-disable.
+        _broken = True
+        from spark_rapids_ml_tpu.utils.logging import get_logger
+
+        get_logger("utils.journal").warning(
+            "run journal disabled: cannot write %s (%s)", path, e
+        )
+
+
+def _event(
+    path: str,
+    event: str,
+    name: str,
+    run_id: str,
+    span_id: str,
+    parent_id: Optional[str],
+    ts: float,
+    fields: Dict[str, Any],
+    duration_s: Optional[float] = None,
+) -> None:
+    obj: Dict[str, Any] = {
+        "ts": ts,
+        "pid": os.getpid(),
+        "event": event,
+        "run_id": run_id,
+        "span_id": span_id,
+        "parent_id": parent_id,
+        "name": name,
+    }
+    if duration_s is not None:
+        obj["duration_s"] = duration_s
+    obj.update(fields)
+    _write(path, obj)
+
+
+@contextlib.contextmanager
+def run(name: str, **fields: Any) -> Iterator[Optional[str]]:
+    """Open a named run (one estimator fit, one bench iteration): emits
+    ``run_start`` now and ``run_end`` (with ``duration_s``) on exit;
+    spans on this thread inside the block parent to it. Yields the
+    run_id (None when the journal is off)."""
+    path = _path()
+    if path is None:
+        yield None
+        return
+    run_id = _new_id()
+    span_id = _new_id()
+    _, parent = current()
+    ts = time.time()
+    t0 = time.perf_counter()
+    _event(path, "run_start", name, run_id, span_id, parent, ts, fields)
+    stack = _stack()
+    stack.append((run_id, span_id))
+    try:
+        yield run_id
+    finally:
+        stack.pop()
+        _event(
+            path, "run_end", name, run_id, span_id, parent, ts, fields,
+            duration_s=time.perf_counter() - t0,
+        )
+
+
+@contextlib.contextmanager
+def span(name: str, **fields: Any) -> Iterator[Optional[str]]:
+    """One phase: emits a single ``phase`` line on exit (ts = phase
+    start). ``trace_span`` routes here, so every instrumented phase in
+    the package journals for free when the journal is on."""
+    path = _path()
+    if path is None:
+        yield None
+        return
+    stack = _stack()
+    if stack:
+        run_id, parent = stack[-1]
+    else:
+        run_id, parent = _new_id(), None
+    span_id = _new_id()
+    ts = time.time()
+    t0 = time.perf_counter()
+    stack.append((run_id, span_id))
+    try:
+        yield span_id
+    finally:
+        stack.pop()
+        _event(
+            path, "phase", name, run_id, span_id, parent, ts, fields,
+            duration_s=time.perf_counter() - t0,
+        )
+
+
+def mark(name: str, **fields: Any) -> None:
+    """One-shot event (no duration) under the current run, if any."""
+    path = _path()
+    if path is None:
+        return
+    run_id, parent = current()
+    _event(
+        path, "mark", name, run_id or _new_id(), _new_id(), parent,
+        time.time(), fields,
+    )
+
+
+def read(path: str) -> List[Dict[str, Any]]:
+    """Parse a journal file back into event dicts (tools and tests).
+    Blank lines are skipped; a torn final line (killed process) raises —
+    the journal's whole-line write discipline makes that a real error."""
+    out: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def close() -> None:
+    """Flush and close every open journal handle (tests; idempotent —
+    the next event reopens append-mode). Also re-arms a journal that
+    self-disabled after a write failure."""
+    global _broken
+    with _lock:
+        files = list(_files.values())
+        _files.clear()
+        _broken = False
+    for f in files:
+        try:
+            f.close()
+        except OSError:
+            pass

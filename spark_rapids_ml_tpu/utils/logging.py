@@ -1,24 +1,61 @@
 """Logging setup — the Spark ``Logging`` trait equivalent.
 
-(Reference: RapidsRowMatrix extends Logging, RapidsRowMatrix.scala:24,32, and
-debug breadcrumbs marking which transform path ran, RapidsPCA.scala:131,158.)
+(Reference: RapidsRowMatrix extends Logging, RapidsRowMatrix.scala:24,32,
+and debug breadcrumbs marking which transform path ran,
+RapidsPCA.scala:131,158.)
+
+Library discipline: configuration attaches ONE handler to the
+``spark_rapids_ml_tpu`` package logger — never ``logging.basicConfig``,
+which would hijack the host application's root logger (a Spark driver or
+serving process embedding this package must keep its own logging intact).
+Every logger this package creates lives under the package namespace, so
+``propagate=False`` on the package logger is the whole isolation story:
+our records hit our handler exactly once and never double-print through
+a root handler the application configured. ``SRML_TPU_LOG_LEVEL`` sets
+the package level (default WARNING). Setup is idempotent and
+thread-safe; host applications that want full control can remove or
+replace the handler on ``logging.getLogger("spark_rapids_ml_tpu")``.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 
-_CONFIGURED = False
+_PKG = "spark_rapids_ml_tpu"
+_lock = threading.Lock()
+_configured = False
+
+
+def _ensure_package_handler() -> None:
+    global _configured
+    if _configured:
+        return
+    with _lock:
+        if _configured:
+            return
+        pkg = logging.getLogger(_PKG)
+        level = os.environ.get("SRML_TPU_LOG_LEVEL", "WARNING").upper()
+        pkg.setLevel(getattr(logging, level, logging.WARNING))
+        if not any(
+            getattr(h, "_srml_handler", False) for h in pkg.handlers
+        ):
+            handler = logging.StreamHandler()
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+            )
+            handler._srml_handler = True  # idempotency marker
+            pkg.addHandler(handler)
+        pkg.propagate = False
+        _configured = True
 
 
 def get_logger(name: str) -> logging.Logger:
-    global _CONFIGURED
-    if not _CONFIGURED:
-        level = os.environ.get("SRML_TPU_LOG_LEVEL", "WARNING").upper()
-        logging.basicConfig(
-            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-            level=getattr(logging, level, logging.WARNING),
-        )
-        _CONFIGURED = True
+    """A logger under the package namespace (short names like
+    ``"serve.daemon"`` are prefixed), with the package handler attached
+    once per process."""
+    _ensure_package_handler()
+    if name != _PKG and not name.startswith(_PKG + "."):
+        name = f"{_PKG}.{name}"
     return logging.getLogger(name)

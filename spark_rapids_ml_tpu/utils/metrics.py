@@ -1,0 +1,347 @@
+"""Process-wide metrics registry: labeled counters, gauges, histograms.
+
+The reference has no metrics story at all — its observability is two NVTX
+ranges (RapidsRowMatrix.scala:62,70) readable only inside Nsight. A system
+that serves heavy traffic needs numbers a dashboard can scrape, so this
+module is the single registry every layer records into: the daemon's
+per-op request/latency/byte counters (serve/daemon.py, exposed by the
+additive ``metrics`` wire op), the client's healing counters
+(serve/client.py), the wire framing's byte totals (serve/protocol.py),
+and every ``trace_span`` phase duration (utils/profiling.py).
+
+Zero dependencies by design (the daemon host may have nothing but the
+package itself); the Prometheus text exposition (v0.0.4) is ~40 lines,
+not a client library. Everything is thread-safe: one lock per metric,
+held only for the dict update — the registry sits on the daemon's
+request hot path.
+
+Naming convention (lint-enforced, tests/test_lint.py):
+``srml_<area>_<name>[_<unit>]`` — counters end ``_total``, histograms end
+in their unit (``_seconds``/``_bytes``), gauges are bare quantities
+(``srml_daemon_staged_bytes``). Labels are lowercase identifiers.
+
+Disabled state: ``config.set("metrics", False)`` (env
+``SRML_TPU_METRICS=0``) turns every record call into an early return —
+no label-key allocation, no lock — and ``snapshot()``/
+``render_prometheus()`` are only ever executed on demand (a scrape),
+never in the background.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "REGISTRY",
+    "DEFAULT_BUCKETS",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "render_prometheus",
+    "reset",
+]
+
+#: Default latency buckets (seconds): sub-millisecond host ops through
+#: the tens-of-seconds first-compile tail the daemon's feed path can hit.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _enabled() -> bool:
+    # Lazy import: config pulls utils.logging; importing it at module load
+    # from here would make the utils package order-sensitive. config.peek
+    # is a lock-free dict read — this gate sits on the daemon's per-frame
+    # hot path, and the disabled state must truly be an early return (no
+    # process-wide lock), as the module docstring promises.
+    from spark_rapids_ml_tpu import config
+
+    return bool(config.peek("metrics"))
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    """Canonical hashable form: sorted (name, str(value)) pairs, so
+    ``inc(op="feed")`` and ``inc(**{"op": "feed"})`` land in one series."""
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+
+    def _clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+    def _samples(self) -> List[Tuple[Dict[str, str], Any]]:
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._series.items())]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if not _enabled():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        """Current value of one series (0.0 when never incremented)."""
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        if not _enabled():
+            return
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if not _enabled():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (per-bucket counts + sum + count). Buckets
+    are upper bounds with ``le`` (≤) semantics plus an implicit +Inf —
+    exactly the Prometheus model, so exposition is a cumulative sum."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        uppers = tuple(float(b) for b in buckets)
+        if not uppers or list(uppers) != sorted(set(uppers)):
+            raise ValueError(
+                f"histogram {name!r} buckets must be distinct and "
+                f"ascending, got {buckets!r}"
+            )
+        self.buckets = uppers
+
+    def _samples(self):
+        # Deep-copy rows under the lock: the base copies the mapping but a
+        # row list mutated by a concurrent observe would tear a scrape.
+        with self._lock:
+            return [
+                (dict(k), [list(row[0]), row[1], row[2]])
+                for k, row in sorted(self._series.items())
+            ]
+
+    def observe(self, value: float, **labels: Any) -> None:
+        if not _enabled():
+            return
+        value = float(value)
+        idx = bisect_left(self.buckets, value)  # == len(buckets) → +Inf
+        key = _label_key(labels)
+        with self._lock:
+            row = self._series.get(key)
+            if row is None:
+                row = self._series[key] = [
+                    [0] * (len(self.buckets) + 1), 0.0, 0,
+                ]
+            row[0][idx] += 1
+            row[1] += value
+            row[2] += 1
+
+    def series(self, **labels: Any):
+        """(cumulative buckets {le_str: n}, sum, count) of one series, or
+        None when never observed — test/tool convenience."""
+        with self._lock:
+            row = self._series.get(_label_key(labels))
+            if row is None:
+                return None
+            counts, total, n = list(row[0]), row[1], row[2]
+        return self._cumulate(counts), total, n
+
+    def _cumulate(self, counts: List[int]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        running = 0
+        for upper, c in zip(self.buckets, counts):
+            running += c
+            out[_fmt_float(upper)] = running
+        out["+Inf"] = running + counts[-1]
+        return out
+
+
+def _fmt_float(v: float) -> str:
+    """Minimal decimal form ("0.005", "1", "60") for bucket bounds and
+    sample values — deterministic for the exposition golden test."""
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class Registry:
+    """Named metrics, get-or-create. Module-level instances register at
+    import; ``reset()`` clears recorded series but keeps the registered
+    metric OBJECTS valid (call sites hold direct references)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"not {cls.kind}"
+                    )
+                return m
+            m = cls(name, help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def reset(self) -> None:
+        """Clear every recorded series (tests; metric objects survive)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view of every metric with ≥ 1 recorded series — what
+        the daemon's ``metrics`` op returns. Histogram buckets are
+        CUMULATIVE (Prometheus ``le`` semantics)."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for name, m in metrics:
+            samples = []
+            if isinstance(m, Histogram):
+                for labels, row in m._samples():
+                    samples.append(
+                        {
+                            "labels": labels,
+                            "buckets": m._cumulate(row[0]),
+                            "sum": row[1],
+                            "count": row[2],
+                        }
+                    )
+            else:
+                for labels, v in m._samples():
+                    samples.append({"labels": labels, "value": v})
+            if samples:
+                out[name] = {"type": m.kind, "help": m.help, "samples": samples}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format v0.0.4 (the format every
+        scraper accepts), metrics and series in sorted order."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
+            samples = m._samples()
+            if not samples:
+                continue
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                for labels, row in samples:
+                    cum = m._cumulate(row[0])
+                    for le, n in cum.items():
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_render_labels({**labels, 'le': le})} {n}"
+                        )
+                    lines.append(
+                        f"{name}_sum{_render_labels(labels)} "
+                        f"{_fmt_float(row[1])}"
+                    )
+                    lines.append(
+                        f"{name}_count{_render_labels(labels)} {row[2]}"
+                    )
+            else:
+                for labels, v in samples:
+                    lines.append(
+                        f"{name}{_render_labels(labels)} {_fmt_float(v)}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: The process-wide registry every layer records into.
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+def snapshot() -> Dict[str, Any]:
+    return REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+def reset() -> None:
+    REGISTRY.reset()

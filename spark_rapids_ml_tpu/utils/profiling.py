@@ -4,8 +4,17 @@ The reference wraps its two fit phases in NVTX ranges so they show up in
 Nsight (``NvtxRange("compute cov", RED)`` / ``NvtxRange("cuSolver SVD",
 BLUE)``, RapidsRowMatrix.scala:62,70, closed in ``finally``). The TPU
 equivalent is ``jax.profiler.TraceAnnotation``, which names the span in
-xprof/Perfetto traces. ``trace_span`` keeps the same phase-named-span idiom
-and degrades to a no-op timer when tracing is disabled.
+xprof/Perfetto traces. ``trace_span`` keeps the same phase-named-span
+idiom and additionally feeds the two always-on observability sinks:
+
+* the process-wide metrics registry — every span's wall-clock lands in
+  the ``srml_phase_duration_seconds{phase=...}`` histogram (so bench
+  records and the daemon's ``metrics`` op carry per-phase breakdowns);
+* the run journal (``utils/journal.py``, env ``SRML_RUN_JOURNAL``) —
+  one JSON line per phase with run/span/parent ids.
+
+With tracing off, the journal unset, and metrics disabled, a span is a
+Timer plus three cheap flag checks — safe on hot paths.
 """
 
 from __future__ import annotations
@@ -15,9 +24,18 @@ import time
 from typing import Iterator, Optional
 
 from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.utils import journal
+from spark_rapids_ml_tpu.utils import metrics
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
 _logger = get_logger(__name__)
+
+#: Every trace_span records here: the per-phase latency breakdown all
+#: other layers (bench.py, docs/observability.md) read.
+PHASE_SECONDS = metrics.histogram(
+    "srml_phase_duration_seconds",
+    "Wall-clock duration of trace_span phases, by phase name",
+)
 
 
 class Timer:
@@ -42,16 +60,18 @@ def trace_span(name: str, log: bool = False) -> Iterator[Timer]:
             gram = compute_gram(...)
     """
     timer = Timer()
-    if config.get("tracing"):
+    tracing = config.get("tracing")
+    if tracing:
         import jax.profiler
 
         cm: contextlib.AbstractContextManager = jax.profiler.TraceAnnotation(name)
     else:
         cm = contextlib.nullcontext()
-    with cm:
+    with cm, journal.span(name):
         try:
             yield timer
         finally:
             timer.stop()
-            if log or config.get("tracing"):
+            PHASE_SECONDS.observe(timer.elapsed, phase=name)
+            if log or tracing:
                 _logger.debug("phase %s: %.3fs", name, timer.elapsed)

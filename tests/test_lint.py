@@ -63,3 +63,110 @@ def test_fault_checkpoints_exist_at_contract_sites():
                 f"fault-injection site {site!r} missing from {rel} "
                 "(utils/faults.py module docstring lists the contract)"
             )
+
+
+def _def_bodies(text: str, pattern: str):
+    """Yield (name, body) for each def matching ``pattern`` (a regex on
+    the full def line). The body runs to the next def/class/decorator at
+    the same or shallower indentation — indentation-aware so decorated
+    neighbors don't bleed in."""
+    lines = text.split("\n")
+    for i, line in enumerate(lines):
+        m = re.match(pattern, line)
+        if not m:
+            continue
+        indent = len(line) - len(line.lstrip())
+        body = []
+        for j in range(i + 1, len(lines)):
+            nxt = lines[j]
+            if nxt.strip():
+                nxt_indent = len(nxt) - len(nxt.lstrip())
+                if nxt_indent <= indent and re.match(
+                    r"\s*(def |class |@)", nxt
+                ):
+                    break
+            body.append(nxt)
+        yield m.group("name"), "\n".join(body)
+
+
+def test_model_fit_and_transform_hot_paths_are_spanned():
+    """Every model hot path must run under a ``trace_span``: spans are
+    the ONLY source of the per-phase breakdown (metrics histogram + run
+    journal, docs/observability.md) — an unspanned fit or transform is
+    invisible to every dashboard and every perf PR. Checked paths:
+    module-level ``fit_*`` functions, ``transform_matrix`` methods, and
+    ``kneighbors`` methods (the KNN transform surface) in models/."""
+    offenders = []
+    for path in sorted((PKG / "models").glob("*.py")):
+        if path.name == "__init__.py":
+            continue
+        text = path.read_text()
+        targets = list(_def_bodies(text, r"def (?P<name>fit_\w+)\("))
+        targets += list(
+            _def_bodies(text, r"    def (?P<name>transform_matrix|kneighbors)\(")
+        )
+        for name, body in targets:
+            if "trace_span(" not in body:
+                offenders.append(f"{path.name}:{name}")
+    assert offenders == [], (
+        "model hot paths without a trace_span: " + ", ".join(offenders)
+    )
+
+
+def test_metric_names_follow_the_convention():
+    """Metric names are an API (dashboards/alerts key on them): enforce
+    ``srml_<area>_<name>[_unit]`` at every registration site — counters
+    end ``_total``, histograms end in their unit, gauges don't carry the
+    counter suffix. docs/observability.md is the catalog."""
+    name_re = re.compile(r"^srml_[a-z0-9]+(_[a-z0-9]+)+$")
+    call_re = re.compile(
+        r"\.(?P<kind>counter|gauge|histogram)\(\s*[\"'](?P<name>[^\"']+)[\"']",
+        re.S,
+    )
+    offenders = []
+    sources = [p for p in _py_sources() if p.name != "metrics.py"]
+    sources.append(PKG.parent / "bench.py")
+    found = 0
+    for path in sources:
+        for m in call_re.finditer(path.read_text()):
+            found += 1
+            kind, name = m.group("kind"), m.group("name")
+            where = f"{path.name}:{name}"
+            if not name_re.match(name):
+                offenders.append(f"{where} (not srml_<area>_<name>)")
+            elif kind == "counter" and not name.endswith("_total"):
+                offenders.append(f"{where} (counter must end _total)")
+            elif kind == "histogram" and not name.endswith(
+                ("_seconds", "_bytes")
+            ):
+                offenders.append(f"{where} (histogram must end in a unit)")
+            elif kind == "gauge" and name.endswith("_total"):
+                offenders.append(f"{where} (gauge must not end _total)")
+    assert found >= 15, (
+        f"only {found} metric registrations found — the regex or the "
+        "instrumentation regressed"
+    )
+    assert offenders == [], "metric naming violations: " + ", ".join(offenders)
+
+
+def test_no_bare_print_in_package():
+    """Library code must log through the package logger (or record
+    metrics), never print — stdout belongs to the host application (and
+    to Spark's worker protocol!). Exempt: ``tools/`` (operator CLIs
+    print by design) and ``if __name__ == "__main__"`` tails (CLI
+    entry points like spark/discovery.py)."""
+    offenders = []
+    for path in _py_sources():
+        if path.parent.name == "tools":
+            continue
+        text = path.read_text()
+        m_guard = re.search(r'^if __name__ == "__main__"', text, re.M)
+        main_guard = -1 if m_guard is None else m_guard.start()
+        for m in re.finditer(r"^[ \t]*print\(", text, re.M):
+            if main_guard != -1 and m.start() > main_guard:
+                continue
+            line = text[: m.start()].count("\n") + 1
+            offenders.append(f"{path.relative_to(PKG.parent)}:{line}")
+    assert offenders == [], (
+        "bare print( in library code at: " + ", ".join(offenders)
+    )

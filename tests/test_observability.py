@@ -1,0 +1,468 @@
+"""Observability layer: metrics registry, run journal, daemon telemetry.
+
+Covers the tentpole contracts: registry exactness under thread hammering,
+the Prometheus text exposition byte-for-byte, journal round-trips with
+run/span/parent nesting, zero-overhead disabled states, the daemon's
+additive ``metrics`` op under real load, chaos faults landing in the
+client healing counters, and the ``tools.top`` renderer.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.utils import faults, journal, metrics
+from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Isolate: the registry is process-wide and other suites feed it."""
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    c = metrics.counter("srml_t1_ops_total", "ops")
+    c.inc(op="feed")
+    c.inc(2.5, op="feed")
+    c.inc(op="commit")
+    assert c.value(op="feed") == 3.5
+    assert c.value(op="commit") == 1.0
+    assert c.value(op="never") == 0.0
+
+    g = metrics.gauge("srml_t1_depth", "depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value() == 5.0
+
+    h = metrics.histogram("srml_t1_wait_seconds", "w", buckets=(0.1, 1.0))
+    h.observe(0.1)   # le semantics: lands in the 0.1 bucket
+    h.observe(0.5)
+    h.observe(99.0)  # +Inf overflow
+    buckets, total, count = h.series()
+    assert buckets == {"0.1": 1, "1": 2, "+Inf": 3}
+    assert count == 3
+    assert abs(total - 99.6) < 1e-9
+    assert h.series(op="other") is None
+
+
+def test_registry_get_or_create_and_kind_collision():
+    a = metrics.counter("srml_t2_x_total", "first")
+    b = metrics.counter("srml_t2_x_total", "second registration ignored")
+    assert a is b and a.help == "first"
+    with pytest.raises(ValueError, match="already registered"):
+        metrics.gauge("srml_t2_x_total")
+
+
+def test_registry_concurrency_is_exact():
+    """N threads hammering one counter + histogram: totals must be EXACT
+    (a lost increment means a lock is missing, and every number the
+    daemon reports becomes untrustworthy)."""
+    c = metrics.counter("srml_t3_hammer_total")
+    h = metrics.histogram("srml_t3_hammer_seconds", buckets=(0.5,))
+    threads, per = 16, 2000
+
+    def hammer(i):
+        for k in range(per):
+            c.inc(op=f"op{i % 4}")
+            h.observe(0.25 if k % 2 else 0.75)
+
+    ts = [threading.Thread(target=hammer, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = sum(
+        s["value"]
+        for s in metrics.snapshot()["srml_t3_hammer_total"]["samples"]
+    )
+    assert total == threads * per
+    buckets, _, count = h.series()
+    assert count == threads * per
+    assert buckets["0.5"] == threads * per // 2
+    assert buckets["+Inf"] == threads * per
+
+
+def test_prometheus_exposition_golden():
+    """Byte-exact v0.0.4 exposition: sorted metrics, sorted series,
+    cumulative buckets, minimal number formatting, escaped labels —
+    scrapers parse this text, so its shape is an API."""
+    c = metrics.counter("srml_t4_ops_total", "Demo ops")
+    c.inc(3, op="feed")
+    c.inc(op="commit")
+    g = metrics.gauge("srml_t4_depth", "Demo depth")
+    g.set(2)
+    h = metrics.histogram("srml_t4_wait_seconds", "Demo waits", buckets=(0.1, 1.0))
+    h.observe(0.05, op="a")
+    h.observe(0.5, op="a")
+    h.observe(5.0, op="a")
+    e = metrics.counter("srml_t4_weird_total", "Escapes")
+    e.inc(err='he said "hi"\nback\\slash')
+    expected = (
+        '# HELP srml_t4_depth Demo depth\n'
+        '# TYPE srml_t4_depth gauge\n'
+        'srml_t4_depth 2\n'
+        '# HELP srml_t4_ops_total Demo ops\n'
+        '# TYPE srml_t4_ops_total counter\n'
+        'srml_t4_ops_total{op="commit"} 1\n'
+        'srml_t4_ops_total{op="feed"} 3\n'
+        '# HELP srml_t4_wait_seconds Demo waits\n'
+        '# TYPE srml_t4_wait_seconds histogram\n'
+        'srml_t4_wait_seconds_bucket{le="0.1",op="a"} 1\n'
+        'srml_t4_wait_seconds_bucket{le="1",op="a"} 2\n'
+        'srml_t4_wait_seconds_bucket{le="+Inf",op="a"} 3\n'
+        'srml_t4_wait_seconds_sum{op="a"} 5.55\n'
+        'srml_t4_wait_seconds_count{op="a"} 3\n'
+        '# HELP srml_t4_weird_total Escapes\n'
+        '# TYPE srml_t4_weird_total counter\n'
+        'srml_t4_weird_total{err="he said \\"hi\\"\\nback\\\\slash"} 1\n'
+    )
+    assert metrics.render_prometheus() == expected
+
+
+def test_snapshot_is_json_round_trippable():
+    metrics.counter("srml_t5_a_total").inc(op="x")
+    metrics.histogram("srml_t5_b_seconds").observe(0.01)
+    snap = metrics.snapshot()
+    again = json.loads(json.dumps(snap))
+    assert again == snap
+    assert again["srml_t5_a_total"]["type"] == "counter"
+    assert again["srml_t5_b_seconds"]["samples"][0]["count"] == 1
+
+
+def test_disabled_metrics_record_nothing():
+    c = metrics.counter("srml_t6_off_total")
+    h = metrics.histogram("srml_t6_off_seconds")
+    with config.option("metrics", False):
+        c.inc(op="x")
+        h.observe(1.0)
+        with trace_span("invisible"):
+            pass
+    assert c.value(op="x") == 0.0
+    assert h.series() is None
+    snap = metrics.snapshot()
+    assert "srml_t6_off_total" not in snap
+    assert not any(
+        s["labels"].get("phase") == "invisible"
+        for s in snap.get("srml_phase_duration_seconds", {}).get("samples", [])
+    )
+
+
+def test_trace_span_feeds_phase_histogram():
+    with trace_span("obs test phase"):
+        pass
+    samples = metrics.snapshot()["srml_phase_duration_seconds"]["samples"]
+    mine = [s for s in samples if s["labels"] == {"phase": "obs test phase"}]
+    assert len(mine) == 1 and mine[0]["count"] == 1
+    assert mine[0]["sum"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# run journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_round_trip_with_nesting(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with config.option("run_journal", path):
+        assert journal.enabled()
+        with journal.run("fit", estimator="T", algo="pca") as run_id:
+            with trace_span("compute cov"):
+                with trace_span("inner"):
+                    pass
+            journal.mark("note", detail=7)
+    journal.close()
+    events = journal.read(path)
+    by_name = {(e["event"], e["name"]): e for e in events}
+    assert [(e["event"], e["name"]) for e in events] == [
+        ("run_start", "fit"),
+        ("phase", "inner"),
+        ("phase", "compute cov"),
+        ("mark", "note"),
+        ("run_end", "fit"),
+    ]
+    start = by_name[("run_start", "fit")]
+    assert start["run_id"] == run_id
+    assert start["parent_id"] is None
+    assert start["estimator"] == "T" and start["algo"] == "pca"
+    assert all(e["run_id"] == run_id for e in events)
+    assert all(e["pid"] == os.getpid() for e in events)
+    outer = by_name[("phase", "compute cov")]
+    inner = by_name[("phase", "inner")]
+    assert outer["parent_id"] == start["span_id"]
+    assert inner["parent_id"] == outer["span_id"]
+    assert 0.0 <= inner["duration_s"] <= outer["duration_s"]
+    assert by_name[("run_end", "fit")]["duration_s"] >= outer["duration_s"]
+    assert by_name[("mark", "note")]["detail"] == 7
+
+
+def test_journal_disabled_is_zero_io(tmp_path):
+    """The production state: no path configured → no file, no lines,
+    enabled() False — the zero-allocation promise."""
+    assert config.get("run_journal") is None
+    assert not journal.enabled()
+    with journal.run("fit") as rid:
+        assert rid is None
+        with trace_span("quiet"):
+            pass
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_journal_bad_path_self_disables_without_breaking_the_workload(tmp_path):
+    """An unwritable journal path is an observability problem, never a
+    workload problem: the first failed write warns + self-disables, the
+    span's own exception (raised while the journal line was being
+    emitted from the finally) propagates unmasked, and later spans are
+    silent no-ops. close() re-arms."""
+    bad = str(tmp_path / "no-such-dir" / "j.jsonl")
+    try:
+        with config.option("run_journal", bad):
+            with pytest.raises(RuntimeError, match="the real failure"):
+                with trace_span("phase under a broken journal"):
+                    raise RuntimeError("the real failure")
+            assert not journal.enabled()  # latched off for the process
+            with trace_span("quiet"):  # and harmless from here on
+                pass
+    finally:
+        journal.close()  # re-arm for the rest of the suite
+    assert not (tmp_path / "no-such-dir").exists()
+
+
+def test_journal_standalone_span_roots_itself(tmp_path):
+    path = str(tmp_path / "solo.jsonl")
+    with config.option("run_journal", path):
+        with trace_span("daemon-side phase"):
+            pass
+    journal.close()
+    (ev,) = journal.read(path)
+    assert ev["event"] == "phase" and ev["parent_id"] is None
+    assert ev["run_id"] and ev["span_id"]
+
+
+def test_journal_concurrent_writers_emit_whole_lines(tmp_path):
+    path = str(tmp_path / "threads.jsonl")
+
+    def worker(i):
+        with journal.run(f"run{i}"):
+            for _ in range(50):
+                with journal.span("work", worker=i):
+                    pass
+
+    with config.option("run_journal", path):
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    journal.close()
+    events = journal.read(path)  # raises on any torn line
+    assert len(events) == 8 * (2 + 50)
+    phases = [e for e in events if e["event"] == "phase"]
+    # Per-thread nesting survived the interleaving: every span parents
+    # to its own thread's run, never a sibling's.
+    run_span = {
+        e["run_id"]: e["span_id"] for e in events if e["event"] == "run_start"
+    }
+    assert all(e["parent_id"] == run_span[e["run_id"]] for e in phases)
+
+
+def test_kmeans_fit_journal_covers_every_phase(tmp_path, mesh8):
+    """Acceptance: a kmeans fit with the journal on yields a parseable
+    per-phase breakdown — both Lloyd phases present, each with a
+    duration."""
+    from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate(
+        [rng.normal(c, 0.1, (40, 3)) for c in (0.0, 5.0)]
+    ).astype(np.float64)
+    path = str(tmp_path / "kmeans.jsonl")
+    with config.option("run_journal", path):
+        with journal.run("fit", estimator="KMeans", algo="kmeans"):
+            fit_kmeans(x, k=2, max_iter=5, seed=0, mesh=mesh8)
+    journal.close()
+    events = journal.read(path)
+    phases = {e["name"] for e in events if e["event"] == "phase"}
+    assert {"kmeans init", "lloyd"} <= phases
+    run_ids = {e["run_id"] for e in events}
+    assert len(run_ids) == 1  # every phase nested under THE fit run
+    assert all(
+        e["duration_s"] >= 0.0 for e in events if e["event"] == "phase"
+    )
+
+
+# ---------------------------------------------------------------------------
+# daemon telemetry plane
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_metrics_op_under_load(mesh8):
+    """Acceptance: a daemon under (modest) load reports non-zero per-op
+    latency histograms and byte counters through the additive `metrics`
+    op, in both formats, and tools.top renders them."""
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+    from spark_rapids_ml_tpu.tools.top import render
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((256, 4))
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with DataPlaneClient(*d.address) as c:
+            for part in range(3):
+                c.feed("obs", x, algo="pca", partition=part)
+                c.commit("obs", partition=part)
+            arrays = c.finalize_pca("obs", k=2)
+            assert arrays["pc"].shape == (4, 2)
+            health = c.health()
+            snap = c.metrics()
+            text = c.metrics(format="prometheus")
+
+    reqs = {
+        (s["labels"]["op"], s["labels"]["outcome"]): s["value"]
+        for s in snap["srml_daemon_requests_total"]["samples"]
+    }
+    assert reqs[("feed", "ok")] == 3
+    assert reqs[("commit", "ok")] == 3
+    lat = {
+        s["labels"]["op"]: s
+        for s in snap["srml_daemon_request_seconds"]["samples"]
+    }
+    assert lat["feed"]["count"] == 3 and lat["feed"]["sum"] > 0
+    assert lat["finalize"]["count"] == 1
+    rx = {
+        s["labels"]["op"]: s["value"]
+        for s in snap["srml_daemon_rx_bytes_total"]["samples"]
+    }
+    assert rx["feed"] > 0
+    tx = {
+        s["labels"]["op"]: s["value"]
+        for s in snap["srml_daemon_tx_bytes_total"]["samples"]
+    }
+    assert tx["finalize"] > 0
+    assert snap["srml_wire_rx_bytes_total"]["samples"][0]["value"] > 0
+    # Prometheus side of the same scrape.
+    assert "# TYPE srml_daemon_requests_total counter" in text
+    assert 'srml_daemon_request_seconds_bucket{le="+Inf",op="feed"} 3' in text
+    # tools.top renders the same snapshot without a live socket.
+    screen = render(health, snap, None, None)
+    assert "feed" in screen and "finalize" in screen
+    assert "daemon" in screen.splitlines()[0]
+
+
+def test_daemon_replay_and_shed_counters(mesh8):
+    """Dedupe replays and busy sheds are counted: re-feeding a committed
+    partition hits `committed_partition`, and a staged-bytes watermark
+    shed lands in srml_daemon_busy_sheds_total."""
+    from spark_rapids_ml_tpu.serve import DaemonBusy, DataPlaneClient, DataPlaneDaemon
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((64, 3))
+    replays = metrics.REGISTRY.counter("srml_daemon_replay_hits_total")
+    sheds = metrics.REGISTRY.counter("srml_daemon_busy_sheds_total")
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with DataPlaneClient(*d.address) as c:
+            c.feed("rj", x, algo="pca", partition=0)
+            c.commit("rj", partition=0)
+            c.feed("rj", x, algo="pca", partition=0)  # post-commit duplicate
+            assert replays.value(kind="committed_partition") >= 1
+            c.drop("rj")
+    with DataPlaneDaemon(mesh=mesh8, max_staged_bytes=1, retry_after_s=0.05) as d:
+        with DataPlaneClient(
+            *d.address, max_busy_wait_s=0.0, max_op_attempts=1
+        ) as c:
+            c.feed("sj", x, algo="pca", partition=0)  # stages past watermark
+            with pytest.raises(DaemonBusy):
+                c.feed("sj", x, algo="pca", partition=1)
+    assert sheds.value(op="feed") >= 1
+
+
+def test_chaos_faults_show_in_client_counters(mesh8):
+    """Acceptance: injected faults are COUNTABLE — a healed chaos run
+    leaves its trace in srml_client_fault_trips_total / _reconnects_total
+    (and the per-instance stats agree)."""
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+    fault_trips = metrics.REGISTRY.counter("srml_client_fault_trips_total")
+    reconnects = metrics.REGISTRY.counter("srml_client_reconnects_total")
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((64, 3))
+    plan = faults.FaultPlan(seed=7).rule("client.op", "drop", times=3)
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with DataPlaneClient(*d.address, backoff_base_s=0.01,
+                             backoff_max_s=0.05) as c:
+            with faults.active(plan):
+                c.feed("cj", x, algo="pca")
+                arrays = c.finalize_pca("cj", k=2)
+    assert arrays["pc"].shape == (3, 2)
+    assert plan.fired.get("client.op") == 3
+    assert fault_trips.value(op="feed") + fault_trips.value(
+        op="finalize"
+    ) + fault_trips.value(op="ping") + fault_trips.value(op="drop") >= 3
+    assert sum(
+        s["value"]
+        for s in metrics.snapshot()["srml_client_reconnects_total"]["samples"]
+    ) >= 3
+    assert c.stats["reconnects"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# tools.top
+# ---------------------------------------------------------------------------
+
+
+def test_top_quantile_interpolation():
+    from spark_rapids_ml_tpu.tools.top import quantile_from_buckets
+
+    # 10 observations: 4 ≤ 0.1, 6 more ≤ 1.0 (cumulative 10).
+    buckets = {"0.1": 4, "1": 10, "+Inf": 10}
+    assert quantile_from_buckets(buckets, 0.4) == pytest.approx(0.1)
+    # p70 → target 7: 3 of 6 into the (0.1, 1.0] bucket → 0.1 + 0.5·0.9
+    assert quantile_from_buckets(buckets, 0.7) == pytest.approx(0.55)
+    # everything in +Inf clamps to the largest finite bound
+    assert quantile_from_buckets({"0.5": 0, "+Inf": 3}, 0.9) == 0.5
+    assert quantile_from_buckets({}, 0.5) is None
+    assert quantile_from_buckets({"1": 0, "+Inf": 0}, 0.5) is None
+
+
+def test_top_render_rates_from_deltas():
+    from spark_rapids_ml_tpu.tools.top import render
+
+    health = {
+        "id": "abc", "uptime_s": 10.0, "queue_depth": 2,
+        "staged_bytes": 2048, "active_jobs": 1, "served_models": 0,
+        "busy": True, "busy_reason": "too many connections",
+    }
+
+    def snap_at(n):
+        return {
+            "srml_daemon_requests_total": {
+                "type": "counter", "help": "", "samples": [
+                    {"labels": {"op": "feed", "outcome": "ok"}, "value": n},
+                ],
+            },
+            "srml_daemon_request_seconds": {
+                "type": "histogram", "help": "", "samples": [
+                    {"labels": {"op": "feed"},
+                     "buckets": {"0.1": n, "+Inf": n}, "sum": 0.01 * n,
+                     "count": n},
+                ],
+            },
+        }
+
+    screen = render(health, snap_at(30), snap_at(10), dt=2.0)
+    assert "BUSY: too many connections" in screen
+    line = [ln for ln in screen.splitlines() if ln.startswith("feed")][0]
+    assert "30" in line          # total
+    assert "10.0" in line        # (30-10)/2 per second
+    assert "2.0KB" in screen     # staged bytes humanized

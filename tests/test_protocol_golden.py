@@ -173,6 +173,53 @@ def test_ping_is_version_exempt_and_echoes_version(daemon):
         sock.close()
 
 
+def test_metrics_op_is_additive_v1(daemon):
+    """The `metrics` op is additive under v1 (docs/protocol.md): JSON
+    and prometheus formats answer under the frozen version, histogram
+    buckets are cumulative with a +Inf terminal, an unknown format
+    errors WITHOUT desyncing the connection, and the op rides the same
+    request framing every other control op uses."""
+    with DataPlaneClient(*daemon.address) as c:
+        c.feed("metrics-live", golden_matrix(), algo="pca")
+        snap = c.metrics()
+        feed_lat = [
+            s for s in snap["srml_daemon_request_seconds"]["samples"]
+            if s["labels"]["op"] == "feed"
+        ]
+        assert feed_lat and feed_lat[0]["count"] >= 1
+        assert feed_lat[0]["buckets"]["+Inf"] == feed_lat[0]["count"]
+        rx = [
+            s for s in snap["srml_daemon_rx_bytes_total"]["samples"]
+            if s["labels"]["op"] == "feed"
+        ]
+        assert rx and rx[0]["value"] > 0
+        text = c.metrics(format="prometheus")
+        assert "# TYPE srml_daemon_requests_total counter" in text
+        c.drop("metrics-live")
+
+    sock = socket.create_connection(daemon.address, timeout=30)
+    try:
+        protocol.send_json(
+            sock,
+            {"v": protocol.PROTOCOL_VERSION, "op": "metrics", "format": "nope"},
+        )
+        resp = protocol.recv_json(sock)
+        assert resp is not None and resp["ok"] is False
+        assert "unknown metrics format" in resp["error"]
+        # connection still aligned: null format means json (the v1
+        # omitted-or-null rule) and succeeds on the same socket
+        protocol.send_json(
+            sock,
+            {"v": protocol.PROTOCOL_VERSION, "op": "metrics", "format": None},
+        )
+        resp2 = protocol.recv_json(sock)
+        assert resp2 is not None and resp2["ok"] is True
+        assert resp2["v"] == protocol.PROTOCOL_VERSION
+        assert isinstance(resp2["metrics"], dict)
+    finally:
+        sock.close()
+
+
 def test_live_client_speaks_the_frozen_version(daemon):
     """Today's DataPlaneClient must emit v1 requests the golden daemon
     accepts — ties the library to the document."""
