@@ -167,6 +167,24 @@ _DEFAULTS: Dict[str, Any] = {
     # The retry hint (seconds) a shed client is told to wait; clients
     # jitter around it so a shed fleet doesn't return as one wave.
     "daemon_retry_after_s": _env("DAEMON_RETRY_AFTER_S", 1.0, float),
+    # Durable daemon job state (serve/daemon.py): a directory where the
+    # daemon write-ahead-snapshots iterative jobs at pass boundaries
+    # (iterate + pass counter + creation params; atomic tmp+rename via
+    # core/checkpoint.py) and persists its instance identity, so a
+    # crashed-and-restarted daemon resurrects its jobs instead of
+    # failing every in-flight fit. None = off — the zero-overhead
+    # default (no snapshot writes, no restore lookups). Env key is
+    # SRML_DAEMON_STATE_DIR: deployment-facing like SRML_RUN_JOURNAL /
+    # SRML_DAEMON_ADDRESS, hence no SRML_TPU_ prefix.
+    "daemon_state_dir": os.environ.get("SRML_DAEMON_STATE_DIR") or None,
+    # Bounded fit-level pass-replay budget for the Spark estimators
+    # (spark/estimator.py): how many times one pass-boundary unit (scan
+    # + step / finalize) may be replayed after a daemon incarnation
+    # change before the failure surfaces. 0 = off: a restart mid-fit
+    # fails loudly with the split-brain error instead of healing.
+    # Overridable per session via $SRML_FIT_RECOVERY_ATTEMPTS /
+    # spark.srml.fit.recovery_attempts (spark/daemon_session.py).
+    "fit_recovery_attempts": _env("FIT_RECOVERY_ATTEMPTS", 0, int),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

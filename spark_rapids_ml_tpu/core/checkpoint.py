@@ -47,6 +47,16 @@ def load_state(path: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any
     return arrays, meta
 
 
+def discard_state(path: str) -> None:
+    """Remove a checkpoint if present (idempotent). A consumed resume
+    point must not resurrect its job: the daemon deletes a job's
+    snapshot the moment the job is finalized, dropped, or TTL-evicted."""
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 def require_consistent_visibility(restored) -> None:
     """Multi-host guard: every process must see the same restored-or-not
     state, or the lockstep scans desync — a checkpoint visible on some

@@ -40,6 +40,16 @@ from spark_rapids_ml_tpu.utils.retry import decorrelated_jitter
 
 logger = get_logger("serve.client")
 
+#: Ops whose acks prove rows/state landed on the answering incarnation —
+#: the only acks that feed the boot fence. A ping's boot_id is excluded:
+#: a restart between a task's identity ping and its first feed is
+#: harmless (every row lands on the new incarnation), and counting it
+#: would fail a fully consistent pass.
+_STATE_ACK_OPS = frozenset((
+    "feed", "feed_raw", "seed", "commit", "step", "set_iterate",
+    "merge_state", "finalize",
+))
+
 #: Client healing telemetry (process-wide registry; per-instance deltas
 #: live in ``DataPlaneClient.stats``). A retry storm, a backoff pile-up,
 #: or a fault-injection campaign is countable here — PR 2 proved the
@@ -118,6 +128,18 @@ class DataPlaneClient:
         self.stats: Dict[str, int] = {
             "reconnects": 0, "replays": 0, "busy_waits": 0,
         }
+        #: Every daemon incarnation (boot_id) whose STATE-TOUCHING acks
+        #: (feed/seed/commit/step/… — see _STATE_ACK_OPS; pings are
+        #: excluded) this client has seen. One entry is the normal case;
+        #: two means rows/state straddled a restart — the incarnation
+        #: fence the Spark estimator's pass replay keys on
+        #: (docs/protocol.md "Crash recovery").
+        self.seen_boot_ids: set = set()
+        #: The instance id of the LAST ack received — live ground truth
+        #: that outranks any cached ping: after a volatile restart the
+        #: daemon answers with a new identity, and callers that keep an
+        #: id cache (the executor-side feed task) must follow it.
+        self.last_server_id: Optional[str] = None
 
     # -- connection --------------------------------------------------------
 
@@ -210,6 +232,12 @@ class DataPlaneClient:
                     float(resp.get("retry_after_s", 1.0)),
                 )
             raise RuntimeError(f"daemon error: {resp.get('error')}")
+        boot = resp.get("boot_id")
+        if boot is not None and req.get("op") in _STATE_ACK_OPS:
+            self.seen_boot_ids.add(str(boot))
+        sid = resp.get("id")
+        if sid is not None:
+            self.last_server_id = str(sid)
         outs = protocol.recv_arrays(sock, resp) if want_arrays else None
         return resp, outs
 
@@ -348,6 +376,14 @@ class DataPlaneClient:
         resp, _ = self._roundtrip({"op": "ping"})
         sid = resp.get("id")
         return None if sid is None else str(sid)
+
+    def server_info(self) -> Dict[str, Any]:
+        """Full ping identity: ``{"v", "id", "boot_id"}``. ``id`` is the
+        daemon's durable identity (stable across restarts on a
+        state_dir daemon); ``boot_id`` is the incarnation, fresh every
+        start — two boot_ids under one id IS a restart."""
+        resp, _ = self._roundtrip({"op": "ping"})
+        return {k: v for k, v in resp.items() if k != "ok"}
 
     @staticmethod
     def _to_ipc(data, input_col: str, label_col: str) -> bytes:
@@ -515,11 +551,15 @@ class DataPlaneClient:
     def finalize(
         self, job: str, params: Dict[str, Any], drop: bool = True,
         arrays: Optional[Dict[str, np.ndarray]] = None,
-    ) -> Tuple[Dict[str, np.ndarray], int]:
-        """Finalize a job; returns (result arrays, total rows). ``arrays``
-        (optional, additive to protocol v1) sends raw array frames with
-        the request — the sharded KNN build ships the shared quantizer
-        this way (docs/protocol.md).
+        with_meta: bool = False,
+    ):
+        """Finalize a job; returns (result arrays, total rows) — or, with
+        ``with_meta=True``, (arrays, rows, meta) where ``meta`` carries
+        the response's additive fields (``pass_rows``, ``boot_id``: the
+        crash-recovery reconciliation inputs, docs/protocol.md).
+        ``arrays`` (optional, additive to protocol v1) sends raw array
+        frames with the request — the sharded KNN build ships the shared
+        quantizer this way.
 
         Replay-safe split (retry obligation #4): the wire request always
         carries ``drop: false`` so a reconnect replay after a lost
@@ -531,6 +571,11 @@ class DataPlaneClient:
         resp, outs = self._op(req, arrays=arrays or None, want_arrays=True)
         if drop:
             self.drop(job)
+        if with_meta:
+            meta = {
+                k: v for k, v in resp.items() if k not in ("ok", "arrays")
+            }
+            return outs, int(resp["rows"]), meta
         return outs, int(resp["rows"])
 
     # -- cross-daemon merge (multi-host data plane) -------------------------
@@ -586,14 +631,35 @@ class DataPlaneClient:
         return arrays, int(resp["iteration"])
 
     def set_iterate(
-        self, job: str, arrays: Dict[str, np.ndarray], iteration: int
+        self, job: str, arrays: Dict[str, np.ndarray], iteration: int,
+        algo: Optional[str] = None, n_cols: Optional[int] = None,
+        params: Optional[Dict[str, Any]] = None,
     ) -> None:
-        """Install a driver-pushed iterate on a peer daemon's job and open
-        pass ``iteration`` (resets the pass statistics and staging)."""
-        self._send_arrays_op(
-            {"op": "set_iterate", "job": job, "iteration": int(iteration)},
-            arrays,
-        )
+        """Install a driver-pushed iterate on a daemon's job and open
+        pass ``iteration`` (resets the pass statistics and staging).
+        With ``n_cols`` (plus ``algo``/``params``, mirroring a first
+        feed) the job is CREATED when the daemon does not know it — the
+        recovery path that re-seeds a restarted daemon from the driver's
+        ledger (docs/protocol.md "Crash recovery")."""
+        req: Dict[str, Any] = {
+            "op": "set_iterate", "job": job, "iteration": int(iteration),
+        }
+        if n_cols is None and (algo is not None or params is not None):
+            # The caller asked for recreation context without the width:
+            # derive it from the iterate itself (centers are (k, d);
+            # coefficients are (d,) or (d, C)) rather than silently
+            # sending a request the daemon can only answer with
+            # "no such job".
+            a = arrays.get("centers")
+            if a is not None:
+                n_cols = int(np.asarray(a).shape[1])
+            elif arrays.get("w") is not None:
+                n_cols = int(np.asarray(arrays["w"]).shape[0])
+        if n_cols is not None:
+            req["algo"] = algo or "pca"
+            req["n_cols"] = int(n_cols)
+            req["params"] = params or {}
+        self._send_arrays_op(req, arrays)
 
     # -- model serving (daemon-side transform) -----------------------------
 

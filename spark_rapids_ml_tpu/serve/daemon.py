@@ -43,11 +43,23 @@ by a reaper thread (a driver that crashes between feed and finalize no
 longer leaks d×d device buffers forever), and an optional shared-secret
 ``token`` is checked on every op (the transport-trust story Spark gave
 the reference for free).
+
+Crash recovery (docs/protocol.md "Crash recovery"): with a ``state_dir``
+the daemon persists its instance identity and write-ahead-snapshots
+iterative jobs at every pass boundary (seed/step/set_iterate — iterate +
+pass counter + creation params, atomic tmp+rename via core/checkpoint),
+restoring them lazily after a restart; every ack carries a per-boot
+``boot_id`` so drivers can FENCE a pass that spanned two incarnations
+instead of trusting its poisoned row count. Pass-local state (stages,
+current-pass statistics, dedupe memories) deliberately dies with the
+incarnation — the recovery unit is the pass, replayed by the estimator.
 """
 
 from __future__ import annotations
 
+import hashlib
 import hmac
+import json
 import os
 import socket
 import threading
@@ -59,6 +71,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from spark_rapids_ml_tpu.core import checkpoint as checkpoint_mod
 from spark_rapids_ml_tpu.ops import gram as gram_ops
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import row_sharding
@@ -107,6 +120,11 @@ _M_JOBS = metrics_mod.gauge(
 )
 _M_MODELS = metrics_mod.gauge(
     "srml_daemon_served_models", "Registered served models (at scrape)"
+)
+_M_JOB_RESTORES = metrics_mod.counter(
+    "srml_daemon_job_restores_total",
+    "Jobs resurrected from durable pass-boundary state after a restart, "
+    "by algo",
 )
 
 #: Device-build cap for daemon-side IVF (bytes of raw f32 rows): past
@@ -354,6 +372,13 @@ class _Job:
         self.algo = algo
         self.n_cols = n_cols
         self.mesh = mesh
+        #: Creation params, kept verbatim (JSON-able): a durable snapshot
+        #: stores them so a restore can re-run this constructor.
+        self.params = dict(params)
+        #: Durability hook (None = off): called under the job lock at
+        #: every pass boundary (seed / step / set_iterate) BEFORE the op
+        #: acks — write-ahead, so an acked boundary is a recoverable one.
+        self.snapshot_cb = None
         self.lock = threading.Lock()
         self.rows = 0
         self.dropped = False
@@ -481,6 +506,90 @@ class _Job:
             return self._kmeans_zero_state()
         return self._logreg_zero_state()
 
+    def _iterate_arrays(self) -> Dict[str, np.ndarray]:
+        """Device-fetch the iterate (call under the job lock): the ONE
+        extraction both the wire (get_iterate) and the durable snapshot
+        (durable_arrays) use — the two must never drift."""
+        if self.algo == "kmeans":
+            with _DEVICE_LOCK:
+                return {"centers": np.asarray(jax.device_get(self.centers))}
+        if self.algo == "logreg":
+            with _DEVICE_LOCK:
+                return {
+                    "w": np.asarray(jax.device_get(self.w)),
+                    "b": np.asarray(jax.device_get(self.b)).reshape(-1),
+                }
+        raise ValueError(
+            f"algo {self.algo!r} is single-pass; it has no iterate"
+        )
+
+    def _install_iterate(self, arrays: Dict[str, np.ndarray]) -> None:
+        """Validate + device-install an iterate (call under the job
+        lock): shared by the wire (set_iterate) and the durable restore,
+        so the shape validation cannot drift between them."""
+        import jax.numpy as jnp
+
+        if self.algo == "kmeans":
+            c = np.asarray(arrays["centers"])
+            if c.shape != (self.k, self.n_cols):
+                raise ValueError(
+                    f"centers shape {c.shape} != ({self.k}, {self.n_cols})"
+                )
+            with _DEVICE_LOCK:
+                self.centers = jnp.asarray(c, self._accum)
+        elif self.algo == "logreg":
+            # Full shape validation at the boundary: a mis-shaped
+            # iterate installed here would otherwise crash opaquely
+            # inside the next feed's jitted update.
+            w = np.asarray(arrays["w"])
+            b = np.asarray(arrays["b"]).reshape(-1)
+            n_classes = getattr(self, "n_classes", 2)
+            want_w = (
+                (self.n_cols, n_classes) if n_classes > 2 else (self.n_cols,)
+            )
+            want_b = n_classes if n_classes > 2 else 1
+            if tuple(w.shape) != want_w:
+                raise ValueError(
+                    f"coefficients shape {tuple(w.shape)} != {want_w} "
+                    f"(n_cols={self.n_cols}, n_classes={n_classes})"
+                )
+            if b.shape[0] != want_b:
+                raise ValueError(
+                    f"intercept length {b.shape[0]} != {want_b} "
+                    f"(n_classes={n_classes})"
+                )
+            with _DEVICE_LOCK:
+                self.w = jnp.asarray(w, self._accum)
+                self.b = jnp.asarray(
+                    b if n_classes > 2 else b.reshape(()), self._accum
+                )
+        else:
+            raise ValueError(
+                f"algo {self.algo!r} is single-pass; set_iterate not applicable"
+            )
+
+    def durable_arrays(self) -> Dict[str, np.ndarray]:
+        """The iterate arrays a pass-boundary snapshot stores (call under
+        the job lock). Pass-local accumulator state is deliberately
+        excluded: at a boundary it is zero by construction, so the
+        snapshot is O(iterate) — the cheap-persistence property
+        core/checkpoint.py already proved for the O(d²) case."""
+        if self.algo not in ("kmeans", "logreg"):
+            return {}
+        if self.algo == "kmeans" and self.centers is None:
+            return {}
+        return self._iterate_arrays()
+
+    def _maybe_snapshot(self) -> None:
+        """Write the durable pass-boundary snapshot when configured (call
+        under the job lock, BEFORE the boundary op's ack goes out). A
+        write failure fails the op — silently losing durability would
+        turn the next crash into the data loss the snapshot exists to
+        prevent."""
+        cb = self.snapshot_cb
+        if cb is not None:
+            cb(self)
+
     @staticmethod
     def _merge(a, b):
         """Combine two accumulated states. Every job state in this daemon
@@ -549,6 +658,9 @@ class _Job:
             with _DEVICE_LOCK:
                 c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
                 self.centers = jnp.asarray(c0, self._accum)
+            # Seeded centers are the pass-0 boundary: persist them so a
+            # restarted daemon reopens pass 0 with identical centers.
+            self._maybe_snapshot()
             self.touched = self._clock()  # exit stamp (init can be slow)
 
     def _is_replay(self, feed_id: Optional[str], stage: Optional[_Stage]) -> bool:
@@ -868,24 +980,9 @@ class _Job:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
             self.touched = self._clock()
-            if self.algo == "kmeans":
-                if self.centers is None:
-                    raise ValueError("kmeans job has no centers yet (seed first)")
-                with _DEVICE_LOCK:
-                    arrays = {
-                        "centers": np.asarray(jax.device_get(self.centers))
-                    }
-            elif self.algo == "logreg":
-                with _DEVICE_LOCK:
-                    arrays = {
-                        "w": np.asarray(jax.device_get(self.w)),
-                        "b": np.asarray(jax.device_get(self.b)).reshape(-1),
-                    }
-            else:
-                raise ValueError(
-                    f"algo {self.algo!r} is single-pass; it has no iterate"
-                )
-            return arrays, {"iteration": self.iteration}
+            if self.algo == "kmeans" and self.centers is None:
+                raise ValueError("kmeans job has no centers yet (seed first)")
+            return self._iterate_arrays(), {"iteration": self.iteration}
 
     def set_iterate(self, arrays: Dict[str, np.ndarray], iteration: int) -> None:
         """Install a driver-pushed iterate and open the given pass: reset
@@ -893,51 +990,11 @@ class _Job:
         peer-daemon face of ``step`` — the primary daemon steps, every
         other daemon ``set_iterate``s the result, and the next scan's
         feeds carry the new pass_id everywhere."""
-        import jax.numpy as jnp
-
         with self.lock:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
             self.touched = self._clock()
-            if self.algo == "kmeans":
-                c = np.asarray(arrays["centers"])
-                if c.shape != (self.k, self.n_cols):
-                    raise ValueError(
-                        f"centers shape {c.shape} != ({self.k}, {self.n_cols})"
-                    )
-                with _DEVICE_LOCK:
-                    self.centers = jnp.asarray(c, self._accum)
-            elif self.algo == "logreg":
-                # Full shape validation at the op boundary (like the
-                # kmeans branch): a mis-shaped iterate installed here
-                # would otherwise crash opaquely inside the next feed's
-                # jitted update.
-                w = np.asarray(arrays["w"])
-                b = np.asarray(arrays["b"]).reshape(-1)
-                n_classes = getattr(self, "n_classes", 2)
-                want_w = (
-                    (self.n_cols, n_classes) if n_classes > 2 else (self.n_cols,)
-                )
-                want_b = n_classes if n_classes > 2 else 1
-                if tuple(w.shape) != want_w:
-                    raise ValueError(
-                        f"coefficients shape {tuple(w.shape)} != {want_w} "
-                        f"(n_cols={self.n_cols}, n_classes={n_classes})"
-                    )
-                if b.shape[0] != want_b:
-                    raise ValueError(
-                        f"intercept length {b.shape[0]} != {want_b} "
-                        f"(n_classes={n_classes})"
-                    )
-                with _DEVICE_LOCK:
-                    self.w = jnp.asarray(w, self._accum)
-                    self.b = jnp.asarray(
-                        b if n_classes > 2 else b.reshape(()), self._accum
-                    )
-            else:
-                raise ValueError(
-                    f"algo {self.algo!r} is single-pass; set_iterate not applicable"
-                )
+            self._install_iterate(arrays)
             with _DEVICE_LOCK:
                 self.state = self._zero_state()
             self.staged.clear()
@@ -945,6 +1002,7 @@ class _Job:
             self.committed.clear()
             self.iteration = int(iteration)
             self.pass_rows = 0
+            self._maybe_snapshot()  # a pushed iterate is a pass boundary too
             self.touched = self._clock()  # exit stamp
 
     def step(
@@ -1055,7 +1113,11 @@ class _Job:
     def _cache_step(
         self, step_id: Optional[str], info: Dict[str, Any]
     ) -> Dict[str, Any]:
-        """Record the applied step for lost-ack replay (call under lock)."""
+        """Record the applied step for lost-ack replay (call under lock).
+        Also the per-pass durability point: the snapshot lands BEFORE the
+        step ack (write-ahead), so a daemon that dies anywhere after here
+        resurrects at this exact boundary."""
+        self._maybe_snapshot()
         self._last_step_id = None if step_id is None else str(step_id)
         self._last_step_info = dict(info)
         return info
@@ -1416,6 +1478,7 @@ class DataPlaneDaemon:
         max_connections: Optional[int] = None,
         max_staged_bytes: Optional[int] = None,
         retry_after_s: Optional[float] = None,
+        state_dir: Optional[str] = None,
     ):
         from spark_rapids_ml_tpu import config
 
@@ -1452,9 +1515,28 @@ class DataPlaneDaemon:
         # Self-reported identity: host:port spellings alias (localhost vs
         # 127.0.0.1 vs FQDN), so the driver keys daemons by this id (from
         # ping) — never by the address string a client happened to use.
+        # With a state_dir the id is PERSISTED there: a restarted daemon
+        # is the same logical daemon (it resurrects its jobs), so it must
+        # not masquerade as a new peer mid-fit.
         self.instance_id = uuid.uuid4().hex[:12]
+        #: Incarnation id, fresh every start (durable or not): stamped on
+        #: feed/seed/commit/step/finalize acks and exposed via ping +
+        #: health, so a driver can detect that one pass's traffic spanned
+        #: a restart — the fence that turns a poisoned row count into an
+        #: explicit replay trigger (docs/protocol.md "Crash recovery").
+        self.boot_id = uuid.uuid4().hex[:12]
+        sd = config.get("daemon_state_dir") if state_dir is None else state_dir
+        self._state_dir = str(sd) if sd else None
+        if self._state_dir is not None:
+            os.makedirs(self._state_dir, exist_ok=True)
+            self.instance_id = self._durable_identity()
         self._jobs: Dict[str, _Job] = {}
         self._jobs_lock = threading.Lock()
+        # Serializes durable restores (rare: post-restart only): without
+        # it, the first scan's N feed tasks would all miss the registry
+        # and run N npz-load + device-install restores for one job,
+        # overcounting srml_daemon_job_restores_total N-fold.
+        self._restore_lock = threading.Lock()
         self._models: Dict[str, _ServedModel] = {}
         self._models_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
@@ -1555,6 +1637,10 @@ class DataPlaneDaemon:
                         continue  # op in flight — it refreshes touched
                     try:
                         if now - job.touched > self._ttl:
+                            # Snapshot first (see the drop op): an
+                            # evicted job must not be resurrectable, so
+                            # the file dies before the registry entry.
+                            self._discard_job_state(name)
                             job.dropped = True
                             del self._jobs[name]
                             evicted.append((name, job))
@@ -1579,12 +1665,204 @@ class DataPlaneDaemon:
                     del self._models[n]
             for n in stale_models:
                 logger.warning("evicted idle served model %r", n)
+            self._sweep_orphan_snapshots()
+
+    def _sweep_orphan_snapshots(self) -> None:
+        """Durable-state leak guard: a crashed fit whose driver also died
+        leaves a job snapshot that is never mentioned again — never
+        lazily restored, so never TTL-evicted through the registry.
+        Sweep snapshot files with no live job once they have sat
+        unmodified longer than the TTL (boundary writes refresh mtime,
+        so an in-flight fit's snapshot is never swept) — the on-disk
+        twin of the in-memory reaper above."""
+        if self._state_dir is None:
+            return
+        with self._jobs_lock:
+            live = {self._job_state_path(n) for n in self._jobs}
+        try:
+            names = os.listdir(self._state_dir)
+        except OSError:
+            return
+        now_wall = time.time()  # file mtimes are wall-clock
+        for fname in names:
+            path = os.path.join(self._state_dir, fname)
+            if fname.endswith(".tmp"):
+                # A writer SIGKILLed between mkstemp and the atomic
+                # rename (exactly the crash window this feature
+                # engineers) leaves a .tmp the except-path cleanup
+                # never ran for. In-flight writes are milliseconds
+                # old; anything TTL-stale is litter.
+                try:
+                    if now_wall - os.path.getmtime(path) > self._ttl:
+                        os.unlink(path)
+                        logger.warning(
+                            "swept stale temp file %s (crashed "
+                            "mid-write)", fname,
+                        )
+                except OSError:
+                    pass
+                continue
+            if not (fname.startswith("job-") and fname.endswith(".npz")):
+                continue
+            if path in live:
+                continue
+            try:
+                if now_wall - os.path.getmtime(path) > self._ttl:
+                    os.unlink(path)
+                    logger.warning(
+                        "swept orphan job snapshot %s (idle > ttl %.1fs "
+                        "with no live job)", fname, self._ttl,
+                    )
+            except OSError:
+                pass  # raced a restore/drop, or already gone
 
     def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc):
         self.stop()
+
+    # -- durable job state (crash recovery; docs/protocol.md) --------------
+
+    def _identity(self) -> Dict[str, str]:
+        """The ack identity stamp: durable instance id + per-boot
+        incarnation id. Stamped on every state-touching ack so a client
+        (and the executor-side id cache above it) always learns who is
+        REALLY holding its rows — a cached ping from before a restart
+        must never outrank a live ack."""
+        return {"id": self.instance_id, "boot_id": self.boot_id}
+
+    def _durable_identity(self) -> str:
+        """Load (or first-write) the persisted instance id: a restarted
+        durable daemon keeps its identity so mid-fit drivers don't
+        mistake it for a new peer. Atomic write via tmp+rename."""
+        path = os.path.join(self._state_dir, "identity.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                ident = str(json.load(f)["instance_id"])
+            if ident:
+                return ident
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        tmp = f"{path}.{uuid.uuid4().hex[:8]}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"instance_id": self.instance_id}, f)
+        os.replace(tmp, path)
+        return self.instance_id
+
+    def _job_state_path(self, name: str) -> str:
+        """Snapshot file for one job. Job names are caller-chosen strings:
+        keep a readable sanitized prefix, disambiguate with a digest so
+        two names that sanitize identically cannot share a snapshot."""
+        safe = "".join(
+            c if c.isalnum() or c in "._-" else "_" for c in name
+        )[:64]
+        digest = hashlib.sha1(name.encode()).hexdigest()[:10]
+        return os.path.join(self._state_dir, f"job-{safe}-{digest}.npz")
+
+    def _save_job_state(self, name: str, job: _Job) -> None:
+        """The snapshot_cb target (runs under the job lock at every pass
+        boundary, before the boundary op acks): iterate + the metadata a
+        restore needs to re-run the job constructor."""
+        checkpoint_mod.save_state(
+            self._job_state_path(name),
+            job.durable_arrays(),
+            {
+                "name": name,
+                "algo": job.algo,
+                "n_cols": job.n_cols,
+                "params": job.params,
+                "iteration": job.iteration,
+                "rows": job.rows,
+                "boot_id": self.boot_id,
+            },
+        )
+
+    def _discard_job_state(self, name: str) -> None:
+        """A finalized/dropped/evicted job must not resurrect."""
+        if self._state_dir is not None:
+            checkpoint_mod.discard_state(self._job_state_path(name))
+
+    def _attach_durability(self, name: str, job: _Job) -> None:
+        """Arm pass-boundary snapshots on an iterative job. Single-pass
+        jobs (pca/linreg/knn) have no boundary before finalize — their
+        recovery unit is the whole (re-runnable) scan, driver-side."""
+        if self._state_dir is None or job.algo not in ("kmeans", "logreg"):
+            return
+        job.snapshot_cb = lambda j, _n=name: self._save_job_state(_n, j)
+
+    def _restore_job(self, name: str) -> Optional[_Job]:
+        """Resurrect a job from its pass-boundary snapshot: re-run the
+        constructor from the persisted creation params, install the
+        iterate and pass counter. Pass-LOCAL state (stages, current-pass
+        statistics, dedupe memories, the step replay cache) died with the
+        old incarnation by design — the job reopens exactly at the
+        boundary the snapshot recorded."""
+        data = checkpoint_mod.load_state(self._job_state_path(name))
+        if data is None:
+            return None
+        arrays, meta = data
+        job = _Job(
+            str(meta["algo"]), int(meta["n_cols"]), self._mesh,
+            meta.get("params") or {}, clock=self._clock,
+        )
+        with job.lock:
+            if arrays:
+                # The same validate+install the wire set_iterate uses —
+                # a tampered/truncated snapshot errors cleanly here
+                # instead of crashing inside the next feed's update.
+                job._install_iterate(arrays)
+            job.iteration = int(meta["iteration"])
+            job.rows = int(meta["rows"])
+            job.touched = self._clock()
+        self._attach_durability(name, job)
+        # label is safe un-clamped: the _Job constructor only accepts the
+        # closed algo set, so a tampered snapshot cannot mint series
+        _M_JOB_RESTORES.inc(algo=str(job.algo))
+        logger.warning(
+            "restored job %r from durable state at pass %d "
+            "(%d rows committed; snapshot by boot %s, this boot %s)",
+            name, job.iteration, job.rows, meta.get("boot_id"), self.boot_id,
+        )
+        return job
+
+    def _lookup_job(self, name: str) -> Optional[_Job]:
+        """Registry lookup, falling back to a lazy durable restore. The
+        restore happens outside the registry lock (it builds device
+        state) but single-files on the restore lock with a re-check, so
+        concurrent first-mentions after a restart produce ONE restore;
+        publication is still race-safe against a concurrent create."""
+        with self._jobs_lock:
+            job = self._jobs.get(name)
+        if job is not None or self._state_dir is None:
+            return job
+        with self._restore_lock:
+            with self._jobs_lock:
+                job = self._jobs.get(name)
+            if job is not None:
+                return job  # another thread restored/created it first
+            restored = self._restore_job(name)
+        if restored is None:
+            return None
+        with self._jobs_lock:
+            current = self._jobs.get(name)
+            if current is None:
+                self._jobs[name] = restored
+                current = restored
+        if current is restored and not os.path.exists(
+            self._job_state_path(name)
+        ):
+            # A drop/finalize raced this restore and already discarded
+            # the snapshot (discard happens BEFORE unregistration, so a
+            # missing file is authoritative): honor the abort — the
+            # resurrected copy must not outlive it.
+            with self._jobs_lock:
+                if self._jobs.get(name) is restored:
+                    del self._jobs[name]
+            with restored.lock:
+                restored.dropped = True
+            return None
+        return current
 
     # -- serving -----------------------------------------------------------
 
@@ -1724,19 +2002,31 @@ class DataPlaneDaemon:
                 int(_opt(req, "attempt", 0)),
                 req.get("pass_id"),
             )
-            protocol.send_json(conn, {"ok": True, "rows": rows})
+            protocol.send_json(conn, {"ok": True, "rows": rows, **self._identity()})
         elif op == "finalize":
             self._op_finalize(conn, req)
         elif op == "step":
             job = self._get_job(req)
             info = job.step(_opt(req, "params", {}), step_id=req.get("step_id"))
-            protocol.send_json(conn, {"ok": True, **info})
+            # The crash-between-passes chaos site: the step applied and
+            # its durable snapshot (if armed) landed — a crash HERE is a
+            # daemon dying at the exact pass boundary, ack unsent.
+            faults.checkpoint("daemon.pass_boundary")
+            protocol.send_json(conn, {"ok": True, **self._identity(), **info})
         elif op == "status":
             job = self._get_job(req)
             protocol.send_json(
                 conn, {"ok": True, "rows": job.rows, "algo": job.algo, "n_cols": job.n_cols}
             )
         elif op == "drop":
+            # Snapshot discard FIRST — unconditionally, even with no
+            # live job (drop is the abort op, and an orphan snapshot
+            # would resurrect the aborted job at its next mention), and
+            # BEFORE unregistration so a lazy restore racing this drop
+            # either finds the registry entry or finds no file; the
+            # restore path re-checks file existence after publishing to
+            # close the remaining load-in-flight window.
+            self._discard_job_state(str(req.get("job")))
             with self._jobs_lock:
                 job = self._jobs.pop(str(req.get("job")), None)
             if job is not None:
@@ -1754,10 +2044,7 @@ class DataPlaneDaemon:
             arrays, meta = job.get_iterate()
             _send_arrays_counted(conn, "get_iterate", arrays, {"ok": True, **meta})
         elif op == "set_iterate":
-            arrays = _recv_arrays_aligned(conn, req)
-            job = self._get_job(req)
-            job.set_iterate(arrays, int(req["iteration"]))
-            protocol.send_json(conn, {"ok": True})
+            self._op_set_iterate(conn, req)
         elif op == "ensure_model":
             self._op_ensure_model(conn, req)
         elif op == "transform":
@@ -1784,7 +2071,7 @@ class DataPlaneDaemon:
             protocol.send_json(
                 conn,
                 {"ok": True, "v": protocol.PROTOCOL_VERSION,
-                 "id": self.instance_id},
+                 "id": self.instance_id, "boot_id": self.boot_id},
             )
         else:
             raise ValueError(f"unknown op {op!r}")
@@ -1835,6 +2122,8 @@ class DataPlaneDaemon:
             "ok": True,
             "v": protocol.PROTOCOL_VERSION,
             "id": self.instance_id,
+            "boot_id": self.boot_id,
+            "durable": self._state_dir is not None,
             "queue_depth": queue_depth,
             "staged_bytes": staged_bytes,
             "active_jobs": active_jobs,
@@ -1882,10 +2171,10 @@ class DataPlaneDaemon:
 
     def _get_job(self, req) -> _Job:
         name = str(req.get("job"))
-        with self._jobs_lock:
-            if name not in self._jobs:
-                raise KeyError(f"no such job {name!r}")
-            return self._jobs[name]
+        job = self._lookup_job(name)  # registry, then durable restore
+        if job is None:
+            raise KeyError(f"no such job {name!r}")
+        return job
 
     def _op_feed(self, conn, req: Dict[str, Any]) -> None:
         import pyarrow as pa
@@ -1959,26 +2248,30 @@ class DataPlaneDaemon:
                     )
 
                     validate_binary_labels(y)
-        if req_algo == "kmeans":
+        # Registry first, then the durable-state restore: a feed naming a
+        # job a crashed predecessor snapshotted resurrects it here.
+        job = self._lookup_job(name)
+        if job is None and req_algo == "kmeans":
             # Validate the seeding constraint BEFORE registering: a first
             # batch smaller than k must not leave an orphan centerless job
             # parked under the name (whose params later feeds would
             # silently inherit).
             k_req = int((req.get("params") or {}).get("k", 0))
-            with self._jobs_lock:
-                is_new = name not in self._jobs
-            if is_new and x.shape[0] < k_req:
+            if x.shape[0] < k_req:
                 raise ValueError(
                     f"first kmeans batch has {x.shape[0]} rows < k={k_req}; "
                     f"feed a larger first batch (it seeds the centers)"
                 )
-        with self._jobs_lock:
-            job = self._jobs.get(name)
-            created = job is None
-            if created:
-                job = _Job(req_algo, x.shape[1], self._mesh, req.get("params"),
-                           clock=self._clock)
-                self._jobs[name] = job
+        created = False
+        if job is None:
+            with self._jobs_lock:
+                job = self._jobs.get(name)
+                created = job is None
+                if created:
+                    job = _Job(req_algo, x.shape[1], self._mesh,
+                               req.get("params"), clock=self._clock)
+                    self._attach_durability(name, job)
+                    self._jobs[name] = job
         if job.algo != req_algo:
             raise ValueError(
                 f"job {name!r} is algo {job.algo!r}; feed requested {req_algo!r}"
@@ -2017,7 +2310,9 @@ class DataPlaneDaemon:
                                 job.dropped = True
                                 del self._jobs[name]
             raise
-        protocol.send_json(conn, {"ok": True, "rows": job.rows})
+        protocol.send_json(
+            conn, {"ok": True, "rows": job.rows, **self._identity()}
+        )
 
     def _op_seed(self, conn, req: Dict[str, Any]) -> None:
         """Driver-sent deterministic kmeans init: payload batch seeds the
@@ -2037,14 +2332,19 @@ class DataPlaneDaemon:
         k_req = int(params.get("k", 0))
         if x.shape[0] < k_req:
             raise ValueError(f"seed batch has {x.shape[0]} rows < k={k_req}")
-        with self._jobs_lock:
-            job = self._jobs.get(name)
-            if job is None:
-                job = _Job("kmeans", x.shape[1], self._mesh, params,
-                           clock=self._clock)
-                self._jobs[name] = job
+        job = self._lookup_job(name)
+        if job is None:
+            with self._jobs_lock:
+                job = self._jobs.get(name)
+                if job is None:
+                    job = _Job("kmeans", x.shape[1], self._mesh, params,
+                               clock=self._clock)
+                    self._attach_durability(name, job)
+                    self._jobs[name] = job
         job.seed_centers(x)
-        protocol.send_json(conn, {"ok": True, "rows": job.rows})
+        protocol.send_json(
+            conn, {"ok": True, "rows": job.rows, **self._identity()}
+        )
 
     def _op_merge_state(self, conn, req: Dict[str, Any]) -> None:
         """Fold a peer daemon's exported job state into the named job —
@@ -2057,8 +2357,7 @@ class DataPlaneDaemon:
         req_algo = str(_opt(req, "algo", "pca"))
         contrib = int(_opt(req, "rows", 0))
         merge_id = req.get("merge_id")
-        with self._jobs_lock:
-            job = self._jobs.get(name)
+        job = self._lookup_job(name)
         if job is None:
             n_cols = req.get("n_cols")
             if n_cols is None:
@@ -2069,6 +2368,7 @@ class DataPlaneDaemon:
             # the feed path keeps for rejected first feeds).
             job = _Job(req_algo, int(n_cols), self._mesh, req.get("params"),
                        clock=self._clock)
+            self._attach_durability(name, job)
             rows = job.merge_remote(arrays, contrib, merge_id=merge_id)
             with self._jobs_lock:
                 current = self._jobs.get(name)
@@ -2090,6 +2390,43 @@ class DataPlaneDaemon:
             )
         rows = job.merge_remote(arrays, contrib, merge_id=merge_id)
         protocol.send_json(conn, {"ok": True, "rows": rows})
+
+    def _op_set_iterate(self, conn, req: Dict[str, Any]) -> None:
+        """Install a driver-pushed iterate. Additive recovery extension:
+        when the job is unknown AND the request carries ``n_cols`` (plus
+        ``algo``/``params`` like a first feed), the job is CREATED at the
+        pushed iterate — the driver-held recovery ledger can re-seed a
+        daemon that lost the job entirely (docs/protocol.md "Crash
+        recovery"). Without ``n_cols`` an unknown job stays an error."""
+        arrays = _recv_arrays_aligned(conn, req)
+        name = str(req["job"])
+        job = self._lookup_job(name)
+        if job is None:
+            n_cols = req.get("n_cols")
+            if n_cols is None:
+                raise KeyError(
+                    f"no such job {name!r} (a recovery set_iterate that "
+                    "should recreate it must carry n_cols/algo/params)"
+                )
+            job = _Job(
+                str(_opt(req, "algo", "pca")), int(n_cols), self._mesh,
+                req.get("params"), clock=self._clock,
+            )
+            self._attach_durability(name, job)
+            # Install BEFORE publishing: a rejected iterate (bad shape)
+            # must not leave an orphan job parked under the name — the
+            # same invariant merge_state keeps for rejected payloads.
+            job.set_iterate(arrays, int(req["iteration"]))
+            with self._jobs_lock:
+                current = self._jobs.get(name)
+                if current is None:
+                    self._jobs[name] = job
+            if current is None:
+                protocol.send_json(conn, {"ok": True, **self._identity()})
+                return
+            job = current  # raced a concurrent creation: converge on it
+        job.set_iterate(arrays, int(req["iteration"]))
+        protocol.send_json(conn, {"ok": True, **self._identity()})
 
     def _op_ensure_model(self, conn, req: Dict[str, Any]) -> None:
         """Register a fitted model for serving (idempotent). The request
@@ -2206,20 +2543,30 @@ class DataPlaneDaemon:
                 self._models[name] = _ServedModel.from_model(
                     algo, model, clock=self._clock, id_map=id_map
                 )
+            self._discard_job_state(str(req.get("job")))  # before pop (see drop)
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
             _send_arrays_counted(
                 conn, "finalize", info,
-                {"ok": True, "rows": job.rows, "model": name},
+                {"ok": True, "rows": job.rows, "model": name,
+                 **self._identity()},
             )
             return
         drop = bool(_opt(req, "drop", True))
         arrays = job.finalize(params, drop=drop)
         # Unregister BEFORE sending: if the client disconnects mid-response
         # the name must not stay poisoned (dropped=True) in _jobs forever.
+        # Snapshot discard before the pop (see the drop op's ordering).
         if drop:
+            self._discard_job_state(str(req.get("job")))
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)
+        # pass_rows (additive): the rows behind the CURRENT pass's state —
+        # a restored-at-boundary job answers 0 here, which is how a driver
+        # tells "finalize over the pass I just fed" from "finalize over a
+        # resurrected empty pass" (the kmeans cost would silently read 0).
         _send_arrays_counted(
-            conn, "finalize", arrays, {"ok": True, "rows": job.rows}
+            conn, "finalize", arrays,
+            {"ok": True, "rows": job.rows, "pass_rows": job.pass_rows,
+             **self._identity()},
         )

@@ -108,6 +108,44 @@ def client_kwargs(spark=None) -> dict:
     return out
 
 
+def recovery_attempts(spark=None) -> int:
+    """Fit-level pass-replay budget (spark/estimator.py "Crash recovery"):
+    how many times one pass-boundary unit may be replayed after a daemon
+    incarnation change before the failure surfaces. 0 (the default) =
+    recovery off — a daemon restart mid-fit fails loudly. Sources, env
+    first then Spark conf then config: ``$SRML_FIT_RECOVERY_ATTEMPTS`` /
+    ``spark.srml.fit.recovery_attempts`` /
+    ``config "fit_recovery_attempts"``."""
+    sources = [("$SRML_FIT_RECOVERY_ATTEMPTS",
+                os.environ.get("SRML_FIT_RECOVERY_ATTEMPTS"))]
+    if spark is not None:
+        sources.append((
+            "spark.srml.fit.recovery_attempts",
+            _spark_conf_get(spark, "spark.srml.fit.recovery_attempts"),
+        ))
+    for src, v in sources:
+        if v is None:
+            continue
+        try:
+            return max(int(v), 0)
+        except (TypeError, ValueError):
+            # A typo'd value must not SILENTLY disable the crash
+            # recovery the operator explicitly configured: warn and
+            # fall through to the next source.
+            from spark_rapids_ml_tpu.utils.logging import get_logger
+
+            get_logger("spark.daemon_session").warning(
+                "ignoring invalid fit recovery attempts %r from %s "
+                "(want a non-negative integer)", v, src,
+            )
+    from spark_rapids_ml_tpu import config
+
+    try:
+        return max(int(config.get("fit_recovery_attempts")), 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 def resolve_all(spark=None) -> list:
     """The full daemon set for fits that must know every peer BEFORE the
     first scan (kmeans: centers are seeded on all daemons up front).

@@ -38,7 +38,41 @@ import numpy as np
 
 from spark_rapids_ml_tpu.spark import daemon_session
 from spark_rapids_ml_tpu.utils import journal
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.logging import get_logger
 from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+logger = get_logger("spark.estimator")
+
+#: Crash-recovery telemetry (docs/observability.md). Recoveries are the
+#: pass replays the fit performed after a daemon incarnation change or a
+#: poisoned pass; drop errors are cleanup drops that failed — each one is
+#: a daemon job leaked until the TTL reaper finds it.
+_M_FIT_RECOVERIES = metrics_mod.counter(
+    "srml_fit_recoveries_total",
+    "Fit passes replayed after a daemon incarnation change or poisoned "
+    "pass, by algo",
+)
+_M_DROP_ERRORS = metrics_mod.counter(
+    "srml_client_drop_errors_total",
+    "Cleanup drop() calls that failed (the daemon job leaks until its "
+    "TTL), by stage",
+)
+
+
+def _drop_quietly(client, job: str, stage: str) -> None:
+    """Cleanup drop that cannot mask the fit's outcome — but is COUNTED
+    and logged: a silently swallowed failure here leaks a daemon job
+    (d×d device buffers, or a dataset-sized knn stage) invisibly until
+    the TTL reaper hides the evidence."""
+    try:
+        client.drop(job)
+    except Exception as e:
+        _M_DROP_ERRORS.inc(stage=stage)
+        logger.debug(
+            "cleanup drop of job %r failed (%s); the daemon holds it "
+            "until its TTL: %s", job, stage, e,
+        )
 
 
 def _pyspark():
@@ -163,16 +197,31 @@ class _FeedTask:
                 c.commit(
                     self.job, partition=pid, attempt=attempt, pass_id=self.pass_id
                 )
+            if c.last_server_id and c.last_server_id != daemon_id:
+                # The daemon ANSWERED with a different identity than the
+                # cached ping: it restarted (volatile, new instance id)
+                # under this reused worker. The ack must name who really
+                # holds the rows, and later tasks must not keep
+                # reporting the ghost id.
+                daemon_id = c.last_server_id
+                _DAEMON_ID_CACHE[(self.job, h, p)] = daemon_id
         # The ack names the daemon this task actually fed (id + a
         # reachable address): the driver merges partials from exactly
         # this set and reconciles the row counts — no daemon's rows can
-        # be silently dropped.
+        # be silently dropped. `boots` carries every daemon INCARNATION
+        # the task's acks came from: two boots in one pass means the
+        # daemon restarted under the scan and rows acked to the dead
+        # incarnation are gone — the driver's fence (docs/protocol.md
+        # "Crash recovery").
         yield pa.RecordBatch.from_pydict(
             {
                 "partition": pa.array([pid], pa.int32()),
                 "rows": pa.array([rows], pa.int64()),
                 "daemon": pa.array([f"{h}:{p}"], pa.string()),
                 "daemon_id": pa.array([daemon_id], pa.string()),
+                "boots": pa.array(
+                    [",".join(sorted(c.seen_boot_ids))], pa.string()
+                ),
             }
         )
 
@@ -213,18 +262,40 @@ def _probe_num_classes(df, label_col) -> int:
 
 def _ack_rows(acks):
     """(total rows, rows by daemon id, id → reachable address, partition →
-    winning daemon id) from one feed pass's task acks. Daemons are keyed
-    by their self-reported instance id — address spellings alias."""
+    winning daemon id, daemon id → boot incarnations observed) from one
+    feed pass's task acks. Daemons are keyed by their self-reported
+    instance id — address spellings alias."""
     per: dict = {}
     addr_of: dict = {}
     owner: dict = {}
+    boots: dict = {}
     for r in acks:
         did = r["daemon_id"]
         per[did] = per.get(did, 0) + int(r["rows"])
         addr_of.setdefault(did, r["daemon"])
         if int(r["rows"]) > 0:
             owner[int(r["partition"])] = did
-    return sum(per.values()), per, addr_of, owner
+        bs = boots.setdefault(did, set())
+        for b in str(r["boots"] or "").split(","):
+            if b:
+                bs.add(b)
+    return sum(per.values()), per, addr_of, owner, boots
+
+
+def _incarnation_change(addr: str, boots) -> RuntimeError:
+    """The fence: a pass whose acks span two incarnations of one daemon
+    fed SOME rows to a state that died with the old incarnation — the
+    acked row count is poisoned and must not be trusted (or silently
+    reconciled). With recovery enabled the estimator replays the pass
+    from the last boundary; otherwise this failure IS the answer."""
+    return RuntimeError(
+        f"daemon {addr} restarted mid-pass (incarnations "
+        f"{sorted(boots)}): rows acked to the dead incarnation are gone "
+        "from the accumulator while the tasks still count them. Enable "
+        "fit recovery (SRML_FIT_RECOVERY_ATTEMPTS / "
+        "spark.srml.fit.recovery_attempts) to replay the pass from the "
+        "last boundary, or refit."
+    )
 
 
 def _split_brain(context: str, expected: int, got: int, detail: str) -> RuntimeError:
@@ -293,16 +364,6 @@ def _merge_peer_daemons(
             job, arrays, rows=int(meta["pass_rows"]), algo=wire_algo,
             n_cols=int(meta["n_cols"]), params=feed_params,
         )
-
-
-def _sync_iterate_to_peers(client, job, peers, get_peer):
-    """Push the primary's post-step iterate to every peer daemon, opening
-    the next pass there (set_iterate resets their pass statistics)."""
-    if not peers:
-        return
-    arrays, iteration = client.get_iterate(job)
-    for did in sorted(peers):
-        get_peer(did).set_iterate(job, arrays, iteration)
 
 
 class _SparkAdapter:
@@ -400,9 +461,11 @@ class _SparkAdapter:
         )
         with trace_span("feed pass"):
             acks = sel.mapInArrow(
-                fn, "partition int, rows long, daemon string, daemon_id string"
+                fn,
+                "partition int, rows long, daemon string, daemon_id string, "
+                "boots string",
             ).collect()
-        total, per_daemon, addr_of, _ = _ack_rows(acks)
+        total, per_daemon, addr_of, _, _ = _ack_rows(acks)
         if total == 0:
             raise ValueError("cannot fit on an empty DataFrame")
         with DataPlaneClient(host, port, token=token, **ckw) as pc0:
@@ -418,11 +481,14 @@ class _SparkAdapter:
                     ah, ap = daemon_session._parse_addr(addr_of[did])
                     with DataPlaneClient(ah, ap, token=token, **ckw) as dc:
                         if drop_jobs:
-                            dc.drop(job)
+                            _drop_quietly(dc, job, "knn_cleanup")
                         for m in drop_models:
                             dc.drop_model(m)
-                except Exception:
-                    pass
+                except Exception as e:
+                    _M_DROP_ERRORS.inc(stage="knn_cleanup")
+                    logger.debug(
+                        "knn cleanup on %s failed: %s", addr_of[did], e
+                    )
 
         multi = len(fed) > 1
         if multi and any(":" in d for d in list(fed) + [primary_id]):
@@ -554,6 +620,11 @@ class _SparkAdapter:
         # via each task's own env read, executors): op deadlines bound the
         # healing, busy hints are honored with jittered waits.
         ckw = daemon_session.client_kwargs(spark)
+        # Crash recovery: how many times one pass-boundary unit (scan +
+        # step / finalize) may be REPLAYED after a daemon incarnation
+        # change before the failure surfaces. 0 = off — and genuinely
+        # zero-overhead: no ledger pulls, no extra wire ops.
+        rec_attempts = daemon_session.recovery_attempts(spark)
         job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
         input_col = core.getOrDefault(
             "inputCol" if core.hasParam("inputCol") else "featuresCol"
@@ -594,6 +665,15 @@ class _SparkAdapter:
                 c = DataPlaneClient(h2, p2, token=token, **ckw)
                 peer_clients[did] = c
             return c
+
+        # Driver-held recovery ledger: the last-known-good iterate and
+        # the pass it opens, snapshotted from the same get_iterate pull
+        # the peer sync already makes at every boundary. On a daemon
+        # incarnation change the pass is replayed from HERE — the daemon
+        # is re-seeded (set_iterate recreates the job if the restart lost
+        # it entirely), so recovery works even without daemon-side
+        # durable state.
+        ledger: dict = {"arrays": None, "iteration": None}
 
         try:
             if algo == "logreg":
@@ -641,6 +721,12 @@ class _SparkAdapter:
                         # an unreachable/unauthorized peer)
                         if not registered:
                             pc.close()
+                if rec_attempts:
+                    # Ledger seed: pass 0 opens with the seeded centers —
+                    # a pass-0 replay re-installs exactly these.
+                    ledger["arrays"], ledger["iteration"] = (
+                        client.get_iterate(job)
+                    )
 
             def run_pass(pass_id, merge=True, drop_peer=False):
                 """One executor scan; folds peer-daemon partials into the
@@ -653,9 +739,10 @@ class _SparkAdapter:
                 with trace_span("feed pass"):
                     acks = sel.mapInArrow(
                         fn,
-                        "partition int, rows long, daemon string, daemon_id string",
+                        "partition int, rows long, daemon string, "
+                        "daemon_id string, boots string",
                     ).collect()
-                n, per, addr_of, owner = _ack_rows(acks)
+                n, per, addr_of, owner, boots = _ack_rows(acks)
                 for did, cnt in per.items():
                     fed_by_daemon[did] = fed_by_daemon.get(did, 0) + cnt
                     addr_by_id.setdefault(did, addr_of[did])
@@ -664,6 +751,23 @@ class _SparkAdapter:
                     # without ever creating the job there — set_iterate
                     # against it would fail an otherwise-consistent fit.
                     if cnt > 0 and did != primary_id and did not in peers:
+                        # An unknown id AT THE PRIMARY ADDRESS — or one
+                        # the live primary now answers with (the
+                        # alias-proof identity check; address spellings
+                        # alias) — is not a peer: it is the primary
+                        # having restarted WITHOUT durable state (a
+                        # state_dir daemon keeps its instance id).
+                        # Registering it would export the primary's
+                        # state and merge it into itself. Fence it like
+                        # any incarnation change; recover() re-resolves
+                        # the identity. The ping runs once per newly
+                        # seen id per fit — not per pass.
+                        if addr_of[did] == f"{host}:{port}" or did == (
+                            client.server_id() or primary_id
+                        ):
+                            raise _incarnation_change(
+                                addr_of[did], {primary_id, did}
+                            )
                         # Instance ids are opaque hex; a ":" means the
                         # address-string FALLBACK for a daemon that does
                         # not report an id — such a daemon predates the
@@ -684,6 +788,15 @@ class _SparkAdapter:
                                 "daemon."
                             )
                         peers[did] = daemon_session._parse_addr(addr_of[did])
+                # Incarnation fence AFTER peer registration (recover()
+                # must know every daemon this pass touched, so it can
+                # rewind/drop them all) but BEFORE any merge: partials
+                # from a daemon that restarted under the scan are partial
+                # in an unknowable way — folding them would poison the
+                # primary.
+                for did, bs in boots.items():
+                    if len(bs) > 1:
+                        raise _incarnation_change(addr_of.get(did, did), bs)
                 if merge:
                     with trace_span("merge peers"):
                         _merge_peer_daemons(
@@ -694,24 +807,154 @@ class _SparkAdapter:
                 total_fed += n
                 return n
 
-            def finalize_guarded(params):
+            def _fed_detail():
+                return ", ".join(
+                    f"{addr_by_id.get(d, d)}={c}"
+                    for d, c in sorted(fed_by_daemon.items())
+                ) or "no acks"
+
+            def finalize_guarded(params, pass_rows_expected=None):
                 """Primary finalize + the split-brain row guard: the
-                daemon-accounted total must equal what tasks acked."""
+                daemon-accounted total must equal what tasks acked.
+                Replay-safe split: finalize with drop=False, validate,
+                THEN drop — a guard failure leaves the job intact for a
+                recovery replay. ``pass_rows_expected`` additionally pins
+                the CURRENT pass's rows (the kmeans cost reads the
+                current pass's state; a job resurrected at an empty
+                boundary would silently answer cost 0)."""
                 with trace_span("finalize"):
-                    arrays, fin_rows = client.finalize(job, params)
-                if fin_rows != total_fed:
-                    detail = ", ".join(
-                        f"{addr_by_id.get(d, d)}={n}"
-                        for d, n in sorted(fed_by_daemon.items())
+                    arrays, fin_rows, meta = client.finalize(
+                        job, params, drop=False, with_meta=True
                     )
-                    raise _split_brain("finalize", total_fed, fin_rows, detail)
+                if fin_rows != total_fed:
+                    raise _split_brain(
+                        "finalize", total_fed, fin_rows, _fed_detail()
+                    )
+                if (
+                    pass_rows_expected is not None
+                    and meta.get("pass_rows") is not None
+                    and int(meta["pass_rows"]) != int(pass_rows_expected)
+                ):
+                    raise _split_brain(
+                        "finalize (current pass)", int(pass_rows_expected),
+                        int(meta["pass_rows"]), _fed_detail(),
+                    )
+                # Best-effort: the validated arrays are already in hand —
+                # a cleanup failure here must not fail (or re-scan) the
+                # fit. The outer finally retries the drop anyway.
+                _drop_quietly(client, job, "finalize")
                 return arrays, fin_rows
 
+            def sync_and_record(push_peers=True):
+                """Pass boundary: distribute the primary's post-step
+                iterate to every peer AND snapshot it into the recovery
+                ledger (one get_iterate serves both).
+                ``push_peers=False`` records the ledger only — the
+                converged-logreg boundary, where nothing will read a
+                peer's iterate but a finalize replay still rewinds to
+                exactly this iterate."""
+                if not (peers and push_peers) and not rec_attempts:
+                    return
+                arrays, iteration = client.get_iterate(job)
+                if push_peers:
+                    for did in sorted(peers):
+                        peer_client(did).set_iterate(job, arrays, iteration)
+                if rec_attempts:
+                    # The ledger advances ONLY once every daemon holds
+                    # the new boundary: a half-pushed boundary (a peer
+                    # died mid-sync) must replay from the OLD one — an
+                    # early-advanced ledger would pin the daemons at
+                    # iteration N+1 while the replay re-feeds pass N,
+                    # turning every replay into a stale-pass rejection.
+                    ledger["arrays"], ledger["iteration"] = arrays, iteration
+
+            def recover(err):
+                """Rewind the fit to the last pass boundary: re-seed the
+                iterate from the driver ledger on EVERY daemon
+                (set_iterate discards the poisoned pass-local state and
+                recreates lost jobs), then resynchronize the row
+                accounting from the daemon's authoritative total. With no
+                ledger yet (pass 0 of a fresh fit, or a single-pass
+                algo) the unit is re-runnable from nothing: drop the
+                jobs and replay the whole scan."""
+                nonlocal total_fed, primary_id
+                _M_FIT_RECOVERIES.inc(algo=str(algo))
+                logger.warning(
+                    "fit recovery (%s): replaying from the last pass "
+                    "boundary after: %s", algo, err,
+                )
+                journal.mark(
+                    "fit recovery", algo=algo, job=job, error=str(err)[:300]
+                )
+                with trace_span("recovery"):
+                    # Re-resolve the primary's identity: a volatile
+                    # (no-state_dir) restart minted a new instance id,
+                    # and the replay's acks must match it — otherwise
+                    # the restarted primary would register as its own
+                    # peer and be merged into itself.
+                    new_id = client.server_id() or primary_id
+                    if new_id != primary_id:
+                        addr_by_id[new_id] = f"{host}:{port}"
+                        peers.pop(new_id, None)
+                        primary_id = new_id
+                    arrays = ledger["arrays"]
+                    if arrays is not None:
+                        n_cols = int(
+                            arrays["centers"].shape[1]
+                            if "centers" in arrays else arrays["w"].shape[0]
+                        )
+                        iteration = int(ledger["iteration"])
+                        client.set_iterate(
+                            job, arrays, iteration, algo=wire_algo,
+                            n_cols=n_cols, params=feed_params,
+                        )
+                        for did in sorted(peers):
+                            peer_client(did).set_iterate(
+                                job, arrays, iteration, algo=wire_algo,
+                                n_cols=n_cols, params=feed_params,
+                            )
+                        total_fed = int(client.status(job)["rows"])
+                    else:
+                        for c_ in [client] + [
+                            peer_client(d) for d in sorted(peers)
+                        ]:
+                            _drop_quietly(c_, job, "recovery")
+                        total_fed = 0
+                    fed_by_daemon.clear()
+
+            def with_recovery(body):
+                """Run one pass-boundary-delimited unit (scan [+ step]
+                [+ finalize]) under the bounded replay loop. Recovery
+                off (the default) adds nothing: the first failure
+                surfaces unchanged. Deterministic driver-side failures
+                (validation/config/programming errors) are never
+                replayed — a full-dataset re-scan cannot fix an empty
+                DataFrame or a bad label column. Daemon/task failures
+                (RuntimeError from acks, transport errors, job aborts)
+                are the retryable class the replay exists for."""
+                for attempt in range(rec_attempts + 1):
+                    try:
+                        return body()
+                    except (ValueError, TypeError, KeyError,
+                            AttributeError, AssertionError,
+                            NotImplementedError):
+                        raise  # deterministic — a replay cannot help
+                    except Exception as e:
+                        if attempt >= rec_attempts:
+                            raise
+                        recover(e)
+
             if algo == "scaler":
-                n = run_pass(None, drop_peer=True)
-                if n == 0:
-                    raise ValueError("cannot fit on an empty DataFrame")
-                arrays, _ = finalize_guarded({"raw_moments": True})
+
+                def scaler_shot():
+                    n = run_pass(None, drop_peer=True)
+                    if n == 0:
+                        raise ValueError("cannot fit on an empty DataFrame")
+                    return finalize_guarded(
+                        {"raw_moments": True}, pass_rows_expected=n
+                    )
+
+                arrays, _ = with_recovery(scaler_shot)
                 from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
 
                 cnt = float(arrays["count"][0])
@@ -724,16 +967,21 @@ class _SparkAdapter:
                     mean=mean, std=np.sqrt(np.maximum(var, 0.0))
                 )
             elif algo == "pca":
-                n = run_pass(None, drop_peer=True)
-                if n == 0:
-                    raise ValueError("cannot fit on an empty DataFrame")
-                arrays, _ = finalize_guarded(
-                    {
-                        "k": core.getK(),
-                        "mean_center": core.getMeanCentering(),
-                        "solver": core.getSolver(),
-                    }
-                )
+
+                def pca_shot():
+                    n = run_pass(None, drop_peer=True)
+                    if n == 0:
+                        raise ValueError("cannot fit on an empty DataFrame")
+                    return finalize_guarded(
+                        {
+                            "k": core.getK(),
+                            "mean_center": core.getMeanCentering(),
+                            "solver": core.getSolver(),
+                        },
+                        pass_rows_expected=n,
+                    )
+
+                arrays, _ = with_recovery(pca_shot)
                 from spark_rapids_ml_tpu.models.pca import PCAModel
 
                 model = PCAModel(
@@ -742,18 +990,23 @@ class _SparkAdapter:
                     mean=arrays["mean"],
                 )
             elif algo == "linreg":
-                n = run_pass(None, drop_peer=True)
-                if n == 0:
-                    raise ValueError("cannot fit on an empty DataFrame")
-                arrays, rows = finalize_guarded(
-                    {
-                        "reg": core.getRegParam(),
-                        "elastic_net": core.getElasticNetParam(),
-                        "fit_intercept": core.getFitIntercept(),
-                        "max_iter": core.getMaxIter(),
-                        "tol": core.getTol(),
-                    },
-                )
+
+                def linreg_shot():
+                    n = run_pass(None, drop_peer=True)
+                    if n == 0:
+                        raise ValueError("cannot fit on an empty DataFrame")
+                    return finalize_guarded(
+                        {
+                            "reg": core.getRegParam(),
+                            "elastic_net": core.getElasticNetParam(),
+                            "fit_intercept": core.getFitIntercept(),
+                            "max_iter": core.getMaxIter(),
+                            "tol": core.getTol(),
+                        },
+                        pass_rows_expected=n,
+                    )
+
+                arrays, rows = with_recovery(linreg_shot)
                 from spark_rapids_ml_tpu.models.linear_regression import (
                     LinearRegressionModel,
                     LinearRegressionTrainingSummary,
@@ -773,26 +1026,53 @@ class _SparkAdapter:
             elif algo == "kmeans":
                 tol2 = core.getTol() ** 2
                 info = {"cost": float("nan"), "iteration": 0}
-                for it in range(core.getMaxIter()):
-                    if run_pass(it) == 0:
+
+                def kmeans_pass(pass_id):
+                    n = run_pass(pass_id)
+                    if n == 0:
                         raise ValueError("cannot fit on an empty DataFrame")
                     with trace_span("step"):
-                        info = client.step(job)
+                        inf = client.step(job)
+                    # The step's statistics must cover exactly the rows
+                    # the scan acked: a job resurrected mid-pass (its
+                    # pass-local state died with the old incarnation)
+                    # answers short here instead of stepping on partial
+                    # sums.
+                    if int(inf["pass_rows"]) != n:
+                        raise _split_brain(
+                            f"step (pass {pass_id})", n,
+                            int(inf["pass_rows"]), _fed_detail(),
+                        )
                     # Every peer opens the new pass with the primary's
                     # post-step centers (set_iterate resets its pass
-                    # stats) — the cross-host Lloyd lockstep. Runs even on
-                    # the converged pass: the final cost-only scan below
-                    # feeds peers against the updated centers.
-                    _sync_iterate_to_peers(client, job, peers, peer_client)
+                    # stats) — the cross-host Lloyd lockstep — and the
+                    # recovery ledger snapshots the same pull. Runs even
+                    # on the converged pass: the final cost-only scan
+                    # below feeds peers against the updated centers.
+                    # INSIDE the recovery unit: a daemon dying in this
+                    # window rewinds to the previous boundary and the
+                    # whole scan+step+sync replays.
+                    sync_and_record()
+                    return inf
+
+                for it in range(core.getMaxIter()):
+                    info = with_recovery(lambda pid=it: kmeans_pass(pid))
                     if info["moved2"] <= tol2:
                         break
+
                 # One final cost-only scan at the UPDATED centers (r2
                 # advisor: step() evaluates cost against the pre-update
                 # centers, so the last step's cost is one Lloyd iteration
                 # stale). finalize reads the unstepped pass's inertia —
                 # the exact fit_kmeans_stream trainingCost semantics.
-                n_rows = run_pass(info["iteration"])
-                arrays, _ = finalize_guarded({})
+                def kmeans_final():
+                    n = run_pass(info["iteration"])
+                    fin_arrays, _ = finalize_guarded(
+                        {}, pass_rows_expected=n
+                    )
+                    return n, fin_arrays
+
+                n_rows, arrays = with_recovery(kmeans_final)
                 cost = float(arrays["cost"][0])
                 from spark_rapids_ml_tpu.models.kmeans import (
                     KMeansModel,
@@ -815,19 +1095,38 @@ class _SparkAdapter:
                     "fit_intercept": core.getFitIntercept(),
                 }
                 rows = 0
-                for it in range(core.getMaxIter()):
-                    rows = run_pass(it)
-                    if rows == 0:
+
+                def logreg_pass(pass_id):
+                    n = run_pass(pass_id)
+                    if n == 0:
                         raise ValueError("cannot fit on an empty DataFrame")
                     with trace_span("step"):
-                        info = client.step(job, params=step_params)
+                        inf = client.step(job, params=step_params)
+                    if int(inf["pass_rows"]) != n:
+                        raise _split_brain(
+                            f"step (pass {pass_id})", n,
+                            int(inf["pass_rows"]), _fed_detail(),
+                        )
+                    # Boundary sync INSIDE the recovery unit (a daemon
+                    # dying here rewinds to the previous boundary and the
+                    # whole scan+step+sync replays). Converged: nothing
+                    # reads a peer sync now, but the ledger still needs
+                    # THIS iterate — a finalize replay rewinds to it.
+                    # (Pass 0 needs no peer sync either way: every daemon
+                    # starts at the zero iterate — a pass-0 replay just
+                    # drops and recreates the job.)
+                    sync_and_record(
+                        push_peers=inf["delta"] > core.getTol()
+                    )
+                    return n, inf
+
+                for it in range(core.getMaxIter()):
+                    rows, info = with_recovery(
+                        lambda pid=it: logreg_pass(pid)
+                    )
                     if info["delta"] <= core.getTol():
-                        break  # converged: nothing reads a peer sync now
-                    # Peers open the new pass with the primary's post-step
-                    # coefficients (pass 0 needs no sync: every daemon
-                    # starts at the zero iterate).
-                    _sync_iterate_to_peers(client, job, peers, peer_client)
-                arrays, _ = finalize_guarded({})
+                        break
+                arrays, _ = with_recovery(lambda: finalize_guarded({}))
                 from spark_rapids_ml_tpu.models.logistic_regression import (
                     LogisticRegressionModel,
                     LogisticTrainingSummary,
@@ -847,16 +1146,19 @@ class _SparkAdapter:
                     loss=info["loss"], numIter=info["iteration"], n_rows=rows
                 )
         finally:
-            try:
-                client.drop(job)  # no-op when finalize already dropped it
-            except Exception:
-                pass
+            # no-op when finalize already dropped it; failures are
+            # COUNTED (srml_client_drop_errors_total) — a swallowed drop
+            # leaks the daemon job until the TTL reaper hides it.
+            _drop_quietly(client, job, "primary")
             client.close()
             for did in list(peers):
                 try:
-                    peer_client(did).drop(job)
-                except Exception:
-                    pass
+                    _drop_quietly(peer_client(did), job, "peer")
+                except Exception as e:  # peer_client() itself can fail
+                    _M_DROP_ERRORS.inc(stage="peer")
+                    logger.debug(
+                        "cleanup drop on peer %s failed: %s", did, e
+                    )
             for pc in peer_clients.values():
                 pc.close()
             if multi_pass:

@@ -32,6 +32,8 @@ LAT = "srml_daemon_request_seconds"
 RX = "srml_daemon_rx_bytes_total"
 TX = "srml_daemon_tx_bytes_total"
 PHASES = "srml_phase_duration_seconds"
+RESTORES = "srml_daemon_job_restores_total"
+RECOVERIES = "srml_fit_recoveries_total"
 
 
 def quantile_from_buckets(buckets: Dict[str, int], q: float) -> Optional[float]:
@@ -118,6 +120,28 @@ def render(
             busy,
         )
     )
+    # Incarnation line: boot_id changes on every restart (with durable
+    # state the instance id above stays put), so a restart — and any jobs
+    # resurrected or fits replayed since — is visible at a glance.
+    boot = health.get("boot_id")
+    restores = sum(
+        float(s.get("value", 0.0))
+        for s in (snap.get(RESTORES) or {}).get("samples", [])
+    )
+    recoveries = sum(
+        float(s.get("value", 0.0))
+        for s in (snap.get(RECOVERIES) or {}).get("samples", [])
+    )
+    if boot or restores or recoveries:
+        bits = []
+        if boot:
+            durable = "durable" if health.get("durable") else "volatile"
+            bits.append(f"boot {boot} ({durable})")
+        if restores:
+            bits.append(f"jobs restored {int(restores)}")
+        if recoveries:
+            bits.append(f"fit recoveries {int(recoveries)}")
+        lines.append("  ".join(bits))
     reqs = _sum_by_op(snap.get(REQ))
     prev_reqs = _sum_by_op((prev or {}).get(REQ))
     lat = _hist_by_label(snap.get(LAT), "op")

@@ -21,6 +21,10 @@ fires):
 ``client.op``             before each client request attempt
 ``daemon.conn``           daemon side, once per accepted connection
 ``daemon.op``             daemon side, per dispatched request (crash-on-Nth-op)
+``daemon.pass_boundary``  after an iterative job's step applied (and its
+                          durable snapshot, when armed), before the ack —
+                          a crash here is a daemon dying exactly between
+                          two passes
 ``wire.send_frame``       every outbound frame, both directions (partial/drop)
 ``bridge.to_matrix``      Arrow list column → matrix conversion
 ``bridge.to_ipc``         matrix/table → Arrow IPC encode (client feed path)

@@ -12,10 +12,13 @@ test never leaks the process).
 
 ``argv[1]`` (optional) pins the port — the chaos suite restarts a killed
 daemon AT THE SAME ADDRESS, the way a supervised production daemon comes
-back. A ``SRML_FAULT_PLAN`` env spec is honored by the in-process fault
-registry (utils/faults.py import-time activation), so a crash-on-Nth-op
-rule makes this worker die the way a real daemon process dies: abruptly,
-mid-traffic, exit code 17.
+back. ``argv[2]`` (optional) is a durable state directory: the recovery
+suite SIGKILLs this worker and restarts a twin pointing at the same
+directory, which must resurrect the jobs (serve/daemon.py crash
+recovery). A ``SRML_FAULT_PLAN`` env spec is honored by the in-process
+fault registry (utils/faults.py import-time activation), so a
+crash-on-Nth-op rule makes this worker die the way a real daemon process
+dies: abruptly, mid-traffic, exit code 17.
 """
 
 import sys
@@ -31,7 +34,10 @@ def main() -> None:
     from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
 
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
-    daemon = DataPlaneDaemon(host="127.0.0.1", port=port, ttl=600.0).start()
+    state_dir = sys.argv[2] if len(sys.argv) > 2 else None
+    daemon = DataPlaneDaemon(
+        host="127.0.0.1", port=port, ttl=600.0, state_dir=state_dir
+    ).start()
     print(f"READY {daemon.address[1]}", flush=True)
     sys.stdin.read()  # block until the parent closes our stdin
     daemon.stop()

@@ -52,7 +52,8 @@ def test_fault_checkpoints_exist_at_contract_sites():
     hook (turning chaos coverage into a no-op) fails loudly."""
     expect = {
         "serve/client.py": ["client.connect", "client.op"],
-        "serve/daemon.py": ["daemon.conn", "daemon.op"],
+        "serve/daemon.py": ["daemon.conn", "daemon.op",
+                            "daemon.pass_boundary"],
         "serve/protocol.py": ["wire.send_frame"],
         "bridge/arrow.py": ["bridge.to_matrix", "bridge.to_ipc"],
     }
@@ -147,6 +148,37 @@ def test_metric_names_follow_the_convention():
         "instrumentation regressed"
     )
     assert offenders == [], "metric naming violations: " + ", ".join(offenders)
+
+
+def test_wire_ops_are_clamped_and_documented():
+    """Every op string the daemon dispatches must appear in BOTH the
+    known-op clamp set (``_KNOWN_OPS`` — the metrics-label allowlist: an
+    op missing there records its telemetry under op="unknown") and
+    ``docs/protocol.md`` (the frozen wire contract third-party clients
+    build against). An op cannot be added without docs + safe labeling."""
+    text = (PKG / "serve" / "daemon.py").read_text()
+    dispatched = set(re.findall(r'\bop == "([a-z_]+)"', text))
+    assert len(dispatched) >= 15, (
+        f"only {len(dispatched)} dispatched ops found — the dispatch "
+        "shape or this regex regressed"
+    )
+    m = re.search(r"_KNOWN_OPS = frozenset\(\((.*?)\)\)", text, re.S)
+    assert m is not None, "_KNOWN_OPS frozenset literal not found"
+    known = set(re.findall(r'"([a-z_]+)"', m.group(1)))
+    unclamped = sorted(dispatched - known)
+    assert unclamped == [], (
+        "ops dispatched but missing from the _KNOWN_OPS metrics-label "
+        f"clamp (they would all record as op=\"unknown\"): {unclamped}"
+    )
+    docs = (PKG.parent / "docs" / "protocol.md").read_text()
+    undocumented = [
+        op for op in sorted(dispatched)
+        if not re.search(rf"\b{op}\b", docs)
+    ]
+    assert undocumented == [], (
+        "ops dispatched by the daemon but absent from docs/protocol.md "
+        f"(the frozen contract): {undocumented}"
+    )
 
 
 def test_no_bare_print_in_package():

@@ -1,0 +1,580 @@
+"""Fit-level crash recovery (ISSUE 4): durable job state, incarnation
+fencing, and pass replay.
+
+The claim under test: a daemon that DIES mid-fit (SIGKILL, not a polite
+stop) and comes back — same address, same state directory — resurrects
+its jobs at the last pass boundary, and the fit completes with a model
+BITWISE-identical to the uninterrupted run. Three layers of evidence:
+
+* daemon-level: snapshot/restore semantics, durable identity, snapshot
+  deletion on drop/finalize (in-process, fast);
+* flagship subprocess runs: a worker process SIGKILLed between two
+  kmeans (and logreg) passes, restarted against the same state dir —
+  the documented acceptance scenario (marked ``slow`` + ``recovery``);
+* estimator-level: a Spark-driven fit (sparksim: real OS-process tasks,
+  real TCP) whose daemon crashes at a pass boundary and is restarted by
+  a supervisor — the driver's recovery ledger replays the pass and the
+  fitted model matches the clean run exactly.
+
+With recovery DISABLED the same deaths still fail loudly (stale-pass /
+split-brain errors) — never silent wrong answers.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.recovery
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leaks():
+    yield
+    faults.deactivate()
+    assert faults.active_plan() is None
+
+
+def _client(daemon_or_addr, **kw):
+    addr = (
+        daemon_or_addr.address
+        if hasattr(daemon_or_addr, "address") else daemon_or_addr
+    )
+    kw.setdefault("timeout", 15.0)
+    kw.setdefault("backoff_base_s", 0.02)
+    kw.setdefault("backoff_max_s", 0.5)
+    kw.setdefault("max_op_attempts", 12)
+    return DataPlaneClient(*addr, **kw)
+
+
+def _counter_total(snap, name):
+    return sum(
+        float(s.get("value", 0.0))
+        for s in (snap.get(name) or {}).get("samples", [])
+    )
+
+
+def _blobs(rng, n, d, k, scale=3.0):
+    x = (
+        rng.normal(size=(n, d))
+        + scale * rng.integers(0, k, size=(n, 1))
+    ).astype(np.float64)
+    return x
+
+
+# ------------------------- daemon-level semantics ----------------------------
+
+
+def test_boot_id_stamped_on_acks_and_exposed(mesh8, rng):
+    data = rng.normal(size=(32, 4))
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with _client(d) as c:
+            info = c.server_info()
+            assert info["id"] == d.instance_id
+            assert info["boot_id"] == d.boot_id
+            h = c.health()
+            assert h["boot_id"] == d.boot_id
+            assert h["durable"] is False
+            c.feed("bj", data, algo="pca", partition=0)
+            c.commit("bj", partition=0)
+            # every ack carried the one incarnation this daemon ever had
+            assert c.seen_boot_ids == {d.boot_id}
+            c.drop("bj")
+
+
+def test_durable_identity_survives_restart_boot_id_does_not(tmp_path, mesh8):
+    state = str(tmp_path / "state")
+    d1 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    id1, boot1 = d1.instance_id, d1.boot_id
+    d1.stop()
+    d2 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    try:
+        assert d2.instance_id == id1  # identity persisted: same daemon
+        assert d2.boot_id != boot1    # incarnation fresh: restart visible
+        with _client(d2) as c:
+            assert c.health()["durable"] is True
+    finally:
+        d2.stop()
+
+
+def test_kmeans_job_resurrected_at_pass_boundary(tmp_path, mesh8, rng):
+    """Seed + one full pass + step on daemon #1; daemon #2 over the same
+    state dir must resurrect the job at pass 1 with bitwise-identical
+    centers and the committed-row total — then serve pass 1 normally."""
+    state = str(tmp_path / "state")
+    x = _blobs(rng, 120, 5, 3)
+    parts = [np.ascontiguousarray(p) for p in np.array_split(x, 3)]
+    params = {"k": 3, "seed": 7}
+    d1 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    with _client(d1) as c:
+        c.seed_kmeans("rj", x[:30], k=3, params=params)
+        for pid, p in enumerate(parts):
+            c.feed("rj", p, algo="kmeans", partition=pid, pass_id=0,
+                   params=params)
+            c.commit("rj", partition=pid, pass_id=0)
+        c.step("rj")
+        centers1, it1 = c.get_iterate("rj")
+    d1.stop()  # in-memory registry dies with the daemon
+
+    d2 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    try:
+        with _client(d2) as c:
+            st = c.status("rj")  # first mention: lazy restore
+            assert st["rows"] == x.shape[0]
+            centers2, it2 = c.get_iterate("rj")
+            assert it2 == it1 == 1
+            np.testing.assert_array_equal(
+                centers2["centers"], centers1["centers"]
+            )
+            # the restored job serves the next pass as if nothing happened
+            for pid, p in enumerate(parts):
+                c.feed("rj", p, algo="kmeans", partition=pid, pass_id=1,
+                       params=params)
+                c.commit("rj", partition=pid, pass_id=1)
+            info = c.step("rj")
+            assert info["iteration"] == 2
+            assert info["pass_rows"] == x.shape[0]
+            c.drop("rj")
+        snap = metrics_mod.snapshot()
+        assert _counter_total(snap, "srml_daemon_job_restores_total") >= 1
+    finally:
+        d2.stop()
+
+
+def _job_snapshots(state_dir):
+    return [n for n in os.listdir(state_dir) if n.startswith("job-")]
+
+
+def test_drop_and_finalize_delete_the_snapshot(tmp_path, mesh8, rng):
+    """A finalized or dropped job must not resurrect: its snapshot goes
+    with it — and `drop` clears a snapshot even with no live job (abort
+    must not leave a resurrectable ghost)."""
+    state = str(tmp_path / "state")
+    x = _blobs(rng, 90, 4, 3)
+    params = {"k": 3, "seed": 1}
+    with DataPlaneDaemon(mesh=mesh8, state_dir=state) as d:
+        with _client(d) as c:
+            c.seed_kmeans("dj", x[:30], k=3, params=params)
+            assert _job_snapshots(state)  # seeding is the pass-0 boundary
+            c.drop("dj")
+            assert _job_snapshots(state) == []
+
+            c.seed_kmeans("fj", x[:30], k=3, params=params)
+            c.feed("fj", x, algo="kmeans", pass_id=0, params=params)
+            c.step("fj")
+            assert _job_snapshots(state)
+            c.finalize("fj", {})  # default drop=True
+            assert _job_snapshots(state) == []
+
+
+def test_reaper_sweeps_orphan_snapshots(tmp_path, mesh8, rng):
+    """A crashed fit whose driver also died leaves a snapshot no op will
+    ever mention: the TTL reaper must sweep it (stale mtime, no live
+    job) while leaving an in-flight job's fresh snapshot alone."""
+    state = str(tmp_path / "state")
+    x = _blobs(rng, 60, 4, 3)
+    d = DataPlaneDaemon(
+        mesh=mesh8, state_dir=state, ttl=0.5, reap_interval=0.05
+    ).start()
+    try:
+        with _client(d) as c:
+            c.seed_kmeans("live", x[:30], k=3, params={"k": 3, "seed": 1})
+            # Plant an orphan: a snapshot from a "previous incarnation"
+            # whose fit was abandoned, mtime well past the TTL.
+            orphan = os.path.join(state, "job-ghost-0123456789.npz")
+            with open(orphan, "wb") as f:
+                f.write(b"npz-ish")
+            os.utime(orphan, (1.0, 1.0))
+            # ...and a .tmp from a writer SIGKILLed mid-snapshot (the
+            # atomic-rename never happened, the except-cleanup never ran).
+            litter = os.path.join(state, "tmpdead01.tmp")
+            with open(litter, "wb") as f:
+                f.write(b"partial")
+            os.utime(litter, (1.0, 1.0))
+            import time as _time
+            for _ in range(100):
+                if not (os.path.exists(orphan) or os.path.exists(litter)):
+                    break
+                c.status("live")  # keep the live job warm (not evicted)
+                _time.sleep(0.05)
+            assert not os.path.exists(orphan), "orphan snapshot not swept"
+            assert not os.path.exists(litter), "crashed .tmp not swept"
+            live_path = d._job_state_path("live")
+            assert os.path.exists(live_path), "live job's snapshot swept!"
+    finally:
+        d.stop()
+
+
+def test_set_iterate_creates_job_for_recovery(mesh8, rng):
+    """The driver-ledger path that needs NO daemon-side durability: a
+    recovery set_iterate carrying algo/n_cols/params recreates a lost
+    job at the pushed iterate and pass counter."""
+    k, d_cols = 3, 5
+    centers = rng.normal(size=(k, d_cols))
+    x = rng.normal(size=(60, d_cols))
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with _client(d) as c:
+            # without the creation fields an unknown job stays an error
+            with pytest.raises(RuntimeError, match="no such job"):
+                c.set_iterate("lost", {"centers": centers}, 2)
+            c.set_iterate(
+                "lost", {"centers": centers}, 2, algo="kmeans",
+                n_cols=d_cols, params={"k": k, "seed": 0},
+            )
+            got, it = c.get_iterate("lost")
+            assert it == 2
+            np.testing.assert_allclose(got["centers"], centers, atol=0)
+            # the recreated job serves the reopened pass
+            c.feed("lost", x, algo="kmeans", partition=0, pass_id=2,
+                   params={"k": k})
+            c.commit("lost", partition=0, pass_id=2)
+            info = c.step("lost")
+            assert info["iteration"] == 3 and info["pass_rows"] == 60
+            c.drop("lost")
+
+
+def test_top_render_shows_boot_and_restores():
+    """ISSUE 4 satellite: an operator sees a restart at a glance —
+    boot id + durability + resurrected-job/recovery counts."""
+    from spark_rapids_ml_tpu.tools.top import render
+
+    health = {
+        "id": "abcdef", "boot_id": "b00t1d", "durable": True,
+        "uptime_s": 4.2, "queue_depth": 1, "staged_bytes": 0,
+        "active_jobs": 1, "served_models": 0, "busy": False,
+    }
+    snap = {
+        "srml_daemon_job_restores_total": {
+            "type": "counter", "help": "",
+            "samples": [{"labels": {"algo": "kmeans"}, "value": 2}],
+        },
+        "srml_fit_recoveries_total": {
+            "type": "counter", "help": "",
+            "samples": [{"labels": {"algo": "kmeans"}, "value": 1}],
+        },
+    }
+    screen = render(health, snap)
+    assert "boot b00t1d (durable)" in screen
+    assert "jobs restored 2" in screen
+    assert "fit recoveries 1" in screen
+    # absent fields must not render a ghost line
+    assert "boot" not in render({"id": "x"}, {})
+
+
+# --------------------- estimator-level recovery (sparksim) -------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def kmeans_blob_data(rng):
+    k, d = 3, 5
+    centers_true = rng.normal(size=(k, d)) * 8
+    x = np.concatenate(
+        [centers_true[i] + rng.normal(size=(120, d)) * 0.3 for i in range(k)]
+    ).astype(np.float32)
+    return x[rng.permutation(len(x))]
+
+
+def _supervised_daemon(port, mesh, state_dir):
+    """A restartable in-process daemon at a FIXED address — the
+    supervisor role a production deployment gives systemd/k8s."""
+    holder = {}
+
+    def start():
+        holder["d"] = DataPlaneDaemon(
+            host="127.0.0.1", port=port, mesh=mesh, state_dir=state_dir
+        ).start()
+
+    def restart():
+        holder["d"].stop()
+        start()
+
+    start()
+    return holder, restart
+
+
+def test_spark_kmeans_fit_recovers_from_boundary_crash_bitwise(
+    tmp_path, mesh8, monkeypatch, kmeans_blob_data
+):
+    """The estimator-level proof: the daemon dies AT a pass boundary
+    (fault site daemon.pass_boundary — step applied, snapshot written,
+    ack unsent), a supervisor restarts it, and the fit — recovery
+    enabled — replays the pass from the driver ledger and produces the
+    clean run's model bit-for-bit, same iteration count."""
+    from sparksim import SimDataFrame, simdf_from_numpy
+    from spark_rapids_ml_tpu.spark import estimator as spark_est
+    from spark_rapids_ml_tpu.spark.estimator import SparkKMeans
+
+    spark_est.register_dataframe_type(SimDataFrame)
+    port = _free_port()
+    holder, restart = _supervised_daemon(
+        port, mesh8, str(tmp_path / "state")
+    )
+    monkeypatch.setenv("SRML_DAEMON_ADDRESS", f"127.0.0.1:{port}")
+    x = kmeans_blob_data
+    try:
+        def fit():
+            # concurrency=1: bitwise f32 fold comparison needs ordered
+            # commits (same caveat as the determinism suite).
+            df = simdf_from_numpy(x, n_partitions=3, concurrency=1)
+            return SparkKMeans().setK(3).setMaxIter(4).setSeed(5).fit(df)
+
+        m_clean = fit()
+
+        monkeypatch.setenv("SRML_FIT_RECOVERY_ATTEMPTS", "2")
+        plan = (
+            FaultPlan(seed=3)
+            .rule("daemon.pass_boundary", "crash", after=1, times=1)
+            .on_crash(restart)
+        )
+        with faults.active(plan):
+            m_rec = fit()
+        assert plan.fired.get("daemon.pass_boundary") == 1, (
+            "the boundary crash never fired — the run proved nothing"
+        )
+        np.testing.assert_array_equal(m_clean.centers, m_rec.centers)
+        assert m_clean.summary.numIter == m_rec.summary.numIter
+        assert m_clean.summary.trainingCost == m_rec.summary.trainingCost
+        snap = metrics_mod.snapshot()
+        assert _counter_total(snap, "srml_fit_recoveries_total") >= 1
+        assert _counter_total(snap, "srml_daemon_job_restores_total") >= 1
+    finally:
+        holder["d"].stop()
+
+
+def test_spark_kmeans_boundary_crash_without_recovery_fails_loudly(
+    tmp_path, mesh8, monkeypatch, kmeans_blob_data
+):
+    """Recovery disabled (the default): the same death still fails with
+    a clear error — never a silently wrong model."""
+    from sparksim import SimDataFrame, simdf_from_numpy
+    from spark_rapids_ml_tpu.spark import estimator as spark_est
+    from spark_rapids_ml_tpu.spark.estimator import SparkKMeans
+
+    spark_est.register_dataframe_type(SimDataFrame)
+    port = _free_port()
+    holder, restart = _supervised_daemon(
+        port, mesh8, str(tmp_path / "state")
+    )
+    monkeypatch.setenv("SRML_DAEMON_ADDRESS", f"127.0.0.1:{port}")
+    monkeypatch.delenv("SRML_FIT_RECOVERY_ATTEMPTS", raising=False)
+    try:
+        plan = (
+            FaultPlan(seed=3)
+            .rule("daemon.pass_boundary", "crash", after=1, times=1)
+            .on_crash(restart)
+        )
+        with faults.active(plan):
+            df = simdf_from_numpy(
+                kmeans_blob_data, n_partitions=3, concurrency=1
+            )
+            with pytest.raises(
+                RuntimeError,
+                match="no rows fed this pass|row-count mismatch|"
+                      "restarted mid-pass",
+            ):
+                SparkKMeans().setK(3).setMaxIter(4).setSeed(5).fit(df)
+    finally:
+        holder["d"].stop()
+
+
+# ------------------- flagship: SIGKILL a daemon process ----------------------
+
+
+def _spawn_worker(port, state_dir=None):
+    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    argv = [
+        sys.executable,
+        os.path.join(os.path.dirname(__file__), "daemon_worker.py"),
+        str(port),
+    ]
+    if state_dir is not None:
+        argv.append(state_dir)
+    proc = subprocess.Popen(
+        argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        cwd=repo_root, env=env, text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    return proc
+
+
+def _stop_worker(proc):
+    try:
+        if proc.poll() is None:
+            proc.stdin.close()
+            proc.wait(timeout=15)
+    except Exception:
+        proc.kill()
+
+
+def _drive_kmeans_passes(c, job, parts, params, passes):
+    for it in passes:
+        for pid, p in enumerate(parts):
+            c.feed(job, p, algo="kmeans", partition=pid, pass_id=it,
+                   params=params)
+            c.commit(job, partition=pid, pass_id=it)
+        c.step(job)
+
+
+@pytest.mark.slow
+def test_flagship_sigkill_between_kmeans_passes_bitwise(tmp_path, rng):
+    """THE acceptance scenario: SIGKILL the daemon process strictly
+    between two kmeans passes (after a step's ack); restart it at the
+    same address over the same state_dir. The restarted daemon
+    resurrects the job and the fitted model equals the uninterrupted
+    fit's bit-for-bit."""
+    x = _blobs(rng, 160, 5, 3, scale=2.0)
+    parts = [np.ascontiguousarray(p) for p in np.array_split(x, 4)]
+    params = {"k": 3, "seed": 11}
+    seed_batch = np.concatenate(parts)[:30]
+    procs = []
+    try:
+        # Uninterrupted reference from its own clean worker.
+        port_r = _free_port()
+        proc_r = _spawn_worker(port_r, state_dir=str(tmp_path / "ref"))
+        procs.append(proc_r)
+        with _client(("127.0.0.1", port_r)) as c:
+            c.seed_kmeans("km", seed_batch, k=3, params=params)
+            _drive_kmeans_passes(c, "km", parts, params, range(3))
+            base, _ = c.finalize("km", {}, drop=False)
+            c.drop("km")
+        _stop_worker(proc_r)
+
+        # Crash run: pass 0, SIGKILL, restart, passes 1-2.
+        port = _free_port()
+        state = str(tmp_path / "state")
+        proc1 = _spawn_worker(port, state_dir=state)
+        procs.append(proc1)
+        with _client(("127.0.0.1", port)) as c:
+            c.seed_kmeans("km", seed_batch, k=3, params=params)
+            _drive_kmeans_passes(c, "km", parts, params, [0])
+            proc1.kill()  # SIGKILL: no shutdown hooks, no flush
+            proc1.wait(timeout=30)
+            proc2 = _spawn_worker(port, state_dir=state)
+            procs.append(proc2)
+            # The healed client resumes pass 1 against the RESURRECTED
+            # job — the daemon restores it lazily at first mention.
+            _drive_kmeans_passes(c, "km", parts, params, [1, 2])
+            healed, _ = c.finalize("km", {}, drop=False)
+            c.drop("km")
+            assert len(c.seen_boot_ids) >= 2, (
+                "the fit never spanned two incarnations — no crash proven"
+            )
+            snap = c.metrics()
+            assert _counter_total(
+                snap, "srml_daemon_job_restores_total"
+            ) >= 1, "the job was recreated, not restored"
+        np.testing.assert_array_equal(healed["centers"], base["centers"])
+        assert int(healed["n_iter"][0]) == int(base["n_iter"][0])
+    finally:
+        for p in procs:
+            _stop_worker(p)
+
+
+def _drive_logreg_passes(c, job, xs, ys, step_params, passes):
+    info = None
+    for it in passes:
+        for pid in range(len(xs)):
+            c.feed(job, (xs[pid], ys[pid]), algo="logreg", partition=pid,
+                   pass_id=it)
+            c.commit(job, partition=pid, pass_id=it)
+        info = c.step(job, params=step_params)
+    return info
+
+
+@pytest.mark.slow
+def test_flagship_sigkill_between_logreg_passes_bitwise(tmp_path, rng):
+    """The logreg half of the flagship: Newton state (w, b) survives the
+    SIGKILL via the pass-boundary snapshot; the final coefficients are
+    bitwise-equal to the uninterrupted fit."""
+    n, d = 180, 6
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (x @ w > 0).astype(np.float64)
+    xs = [np.ascontiguousarray(p) for p in np.array_split(x, 3)]
+    ys = [np.ascontiguousarray(p) for p in np.array_split(y, 3)]
+    step_params = {"reg": 1e-2, "fit_intercept": True}
+    procs = []
+    try:
+        port_r = _free_port()
+        proc_r = _spawn_worker(port_r, state_dir=str(tmp_path / "ref"))
+        procs.append(proc_r)
+        with _client(("127.0.0.1", port_r)) as c:
+            _drive_logreg_passes(c, "lr", xs, ys, step_params, range(3))
+            base, _ = c.finalize("lr", {}, drop=False)
+            c.drop("lr")
+        _stop_worker(proc_r)
+
+        port = _free_port()
+        state = str(tmp_path / "state")
+        proc1 = _spawn_worker(port, state_dir=state)
+        procs.append(proc1)
+        with _client(("127.0.0.1", port)) as c:
+            _drive_logreg_passes(c, "lr", xs, ys, step_params, [0])
+            proc1.kill()
+            proc1.wait(timeout=30)
+            proc2 = _spawn_worker(port, state_dir=state)
+            procs.append(proc2)
+            _drive_logreg_passes(c, "lr", xs, ys, step_params, [1, 2])
+            healed, _ = c.finalize("lr", {}, drop=False)
+            c.drop("lr")
+            assert len(c.seen_boot_ids) >= 2
+        np.testing.assert_array_equal(
+            healed["coefficients"], base["coefficients"]
+        )
+        np.testing.assert_array_equal(healed["intercept"], base["intercept"])
+        assert int(healed["n_iter"][0]) == int(base["n_iter"][0])
+    finally:
+        for p in procs:
+            _stop_worker(p)
+
+
+@pytest.mark.slow
+def test_flagship_sigkill_without_state_dir_fails_loudly(tmp_path, rng):
+    """The other half of the acceptance criterion: with durability OFF,
+    the restarted daemon cannot join the fit mid-flight — the next
+    pass's traffic is rejected with the existing clear error (the fit
+    fails; it never silently returns a model missing pass 0)."""
+    n, d = 120, 4
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    xs = [np.ascontiguousarray(p) for p in np.array_split(x, 2)]
+    ys = [np.ascontiguousarray(p) for p in np.array_split(y, 2)]
+    procs = []
+    try:
+        port = _free_port()
+        proc1 = _spawn_worker(port)  # NO state_dir
+        procs.append(proc1)
+        with _client(("127.0.0.1", port)) as c:
+            _drive_logreg_passes(c, "lr", xs, ys, {"reg": 0.0}, [0])
+            proc1.kill()
+            proc1.wait(timeout=30)
+            proc2 = _spawn_worker(port)
+            procs.append(proc2)
+            with pytest.raises(RuntimeError, match="behind the fit"):
+                c.feed("lr", (xs[0], ys[0]), algo="logreg", partition=0,
+                       pass_id=1)
+    finally:
+        for p in procs:
+            _stop_worker(p)
