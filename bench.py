@@ -28,10 +28,16 @@ north-star target (BASELINE.md) is within 2× of A100 per chip, i.e.
 vs_baseline >= 0.5.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``python bench.py --serve`` (or SRML_BENCH_SERVE=1) runs the SERVING
+benchmark instead: N concurrent transform clients against one in-process
+daemon, scheduler off then on (serve/scheduler.py), and prints one JSON
+line with QPS, p50/p99 latency, and mean batch occupancy for both modes.
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -243,5 +249,115 @@ def _ingest_inclusive(update):
     }
 
 
+def serve_bench() -> None:
+    """Serving-plane benchmark: N concurrent transform clients against
+    one daemon, micro-batching scheduler off vs on (the PR-5 acceptance
+    number: batching must raise QPS on the same workload). Emits ONE
+    JSON line with both modes' QPS + latency quantiles, the scheduler
+    run's mean batch occupancy, and the standard per-phase metrics
+    breakdown."""
+    import threading
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.pca import PCA
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+    from spark_rapids_ml_tpu.utils import metrics
+
+    d = int(os.environ.get("SRML_BENCH_SERVE_D", 256))
+    k = int(os.environ.get("SRML_BENCH_SERVE_K", 16))
+    clients = int(os.environ.get("SRML_BENCH_SERVE_CLIENTS", 8))
+    reqs = int(os.environ.get("SRML_BENCH_SERVE_REQS", 40))
+    rows = int(os.environ.get("SRML_BENCH_SERVE_ROWS", 64))
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((4096, d)).astype(np.float32)
+    model = PCA().setK(k).fit({"features": data})
+    arrays = model._model_data()
+    queries = rng.standard_normal((clients, rows, d)).astype(np.float32)
+
+    def run(batching: bool) -> dict:
+        metrics.reset()
+        lat: list = []
+        lat_lock = threading.Lock()
+        errors: list = []
+        with config.option("serve_batching", batching):
+            with DataPlaneDaemon() as daemon:
+                host, port = daemon.address
+                with DataPlaneClient(host, port) as c0:
+                    c0.ensure_model("bench-serve", "pca", arrays)
+                    if batching:
+                        c0.warmup("bench-serve", n_cols=d, dtype="float32")
+                    else:  # same warm jit caches for the off mode
+                        c0.transform("bench-serve", queries[0])
+                barrier = threading.Barrier(clients)
+
+                def worker(i: int) -> None:
+                    # A failed worker must fail the BENCH record: silently
+                    # dropping its requests would still divide by the full
+                    # clients*reqs and print a wrong QPS.
+                    mine = []
+                    try:
+                        with DataPlaneClient(host, port) as c:
+                            barrier.wait()
+                            for _ in range(reqs):
+                                t0 = time.perf_counter()
+                                c.transform("bench-serve", queries[i])
+                                mine.append(time.perf_counter() - t0)
+                    except BaseException as e:
+                        barrier.abort()  # peers fail fast, never hang
+                        with lat_lock:
+                            errors.append(e)
+                        raise
+                    with lat_lock:
+                        lat.extend(mine)
+
+                threads = [
+                    threading.Thread(target=worker, args=(i,))
+                    for i in range(clients)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)}/{clients} serve-bench workers failed "
+                f"(batching={batching})"
+            ) from errors[0]
+        lat.sort()
+        occ = metrics.snapshot().get("srml_scheduler_batch_rows", {})
+        samples = occ.get("samples", [])
+        total = sum(s["sum"] for s in samples)
+        count = sum(s["count"] for s in samples)
+        out = {
+            "qps": round(clients * reqs / wall, 1),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3, 3),
+        }
+        if count:
+            out["mean_batch_occupancy"] = round(total / count, 2)
+        return out
+
+    off = run(False)
+    metrics.reset()
+    on = run(True)
+    print(json.dumps({
+        "metric": f"serve_transform_qps_d{d}_k{k}_c{clients}_b{rows}",
+        "unit": "transforms/s",
+        "clients": clients,
+        "batch_rows": rows,
+        "scheduler_off": off,
+        "scheduler_on": on,
+        "speedup": round(on["qps"] / off["qps"], 3) if off["qps"] else None,
+        "metrics": _metrics_breakdown(metrics.snapshot()),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv or os.environ.get("SRML_BENCH_SERVE", "") in (
+        "1", "true"
+    ):
+        serve_bench()
+    else:
+        main()

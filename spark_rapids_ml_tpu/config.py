@@ -17,14 +17,22 @@ import threading
 from typing import Any, Callable, Dict, Optional
 
 
-def _env(name: str, default: Any, cast: Callable[[str], Any]) -> Any:
-    raw = os.environ.get(f"SRML_TPU_{name}")
+def _env(name: str, default: Any, cast: Callable[[str], Any],
+         prefix: str = "SRML_TPU_") -> Any:
+    raw = os.environ.get(prefix + name)
     if raw is None:
         return default
     try:
         return cast(raw)
     except (TypeError, ValueError):
         return default
+
+
+def _env_named(name: str, default: Any, cast: Callable[[str], Any]) -> Any:
+    """Deployment-facing env keys carry their FULL name (no SRML_TPU_
+    prefix): SRML_DAEMON_STATE_DIR, SRML_RUN_JOURNAL, SRML_SERVE_* —
+    the knobs an operator sets on a daemon host, not a tuning flag."""
+    return _env(name, default, cast, prefix="")
 
 
 def _as_bool(s: str) -> bool:
@@ -177,6 +185,42 @@ _DEFAULTS: Dict[str, Any] = {
     # SRML_DAEMON_STATE_DIR: deployment-facing like SRML_RUN_JOURNAL /
     # SRML_DAEMON_ADDRESS, hence no SRML_TPU_ prefix.
     "daemon_state_dir": os.environ.get("SRML_DAEMON_STATE_DIR") or None,
+    # Serving scheduler (serve/scheduler.py; docs/protocol.md "Serving
+    # scheduler"): cross-connection micro-batching for transform/
+    # kneighbors. OFF by default — the protocol goldens and every
+    # single-caller deployment behave byte-identically; flip on for
+    # concurrent serving traffic. Env keys are deployment-facing
+    # (SRML_SERVE_*), like SRML_DAEMON_STATE_DIR.
+    "serve_batching": _env_named("SRML_SERVE_BATCHING", False, _as_bool),
+    # Max milliseconds a queued request waits for co-batchable traffic
+    # before its micro-batch dispatches anyway.
+    "serve_batch_window_ms": _env_named(
+        "SRML_SERVE_BATCH_WINDOW_MS", 2.0, float
+    ),
+    # Row cap per dispatched micro-batch, floored to a boundary of the
+    # bucket ladder below (a batch coalesced past one would pad UP to
+    # the next bucket, dispatching more device rows than the cap).
+    "serve_max_batch_rows": _env_named("SRML_SERVE_MAX_BATCH_ROWS", 4096, int),
+    # The bucket ladder (comma-separated ascending row counts): batches
+    # are padded UP to the smallest bucket that fits, so jit
+    # compilations per served model are bounded by the ladder length —
+    # the padded rows are masked out of every result (bitwise-equal to
+    # solo requests). Single requests larger than the top bucket bypass
+    # the scheduler and dispatch solo.
+    "serve_batch_buckets": _env_named(
+        "SRML_SERVE_BATCH_BUCKETS", "64,256,1024,4096", str
+    ),
+    # Admission bound: max queued requests per served model; overflow
+    # (and requests whose deadline the backlog would miss) are shed with
+    # the busy/retry_after_s contract instead of queueing to death.
+    "serve_queue_depth": _env_named("SRML_SERVE_QUEUE_DEPTH", 256, int),
+    # Served-model registry cap (0 = unbounded): past it, the least-
+    # recently-used re-creatable registration is evicted (clients
+    # re-register on miss); daemon-built KNN indexes are evicted only
+    # when nothing re-creatable remains. The LRU twin of the TTL reaper
+    # — a long-lived daemon cannot grow its model registry without
+    # bound even when no TTL is configured.
+    "daemon_max_models": _env("DAEMON_MAX_MODELS", 0, int),
     # Bounded fit-level pass-replay budget for the Spark estimators
     # (spark/estimator.py): how many times one pass-boundary unit (scan
     # + step / finalize) may be replayed after a daemon incarnation

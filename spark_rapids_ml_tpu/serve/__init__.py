@@ -14,5 +14,9 @@ reference's JVM ``RDD.reduce`` played (RapidsRowMatrix.scala:139).
 
 from spark_rapids_ml_tpu.serve.client import DaemonBusy, DataPlaneClient
 from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+from spark_rapids_ml_tpu.serve.scheduler import RequestScheduler, SchedulerBusy
 
-__all__ = ["DaemonBusy", "DataPlaneClient", "DataPlaneDaemon"]
+__all__ = [
+    "DaemonBusy", "DataPlaneClient", "DataPlaneDaemon", "RequestScheduler",
+    "SchedulerBusy",
+]

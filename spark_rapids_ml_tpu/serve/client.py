@@ -691,22 +691,56 @@ class DataPlaneClient:
         data,
         input_col: str = "features",
         n_cols: Optional[int] = None,
+        deadline_s: Optional[float] = None,
     ) -> Dict[str, np.ndarray]:
         """Run a registered model over one batch on the daemon's devices.
         ``data``: Arrow Table/RecordBatch or (n, d) ndarray. Returns the
         role-keyed output arrays (the model's ``_serve_outputs`` roles,
-        e.g. {"output": ...} for PCA, {"prediction": ...} for KMeans)."""
+        e.g. {"output": ...} for PCA, {"prediction": ...} for KMeans).
+        ``deadline_s`` (additive): the request's latency budget hint —
+        a batching daemon sheds it with `busy` when its backlog would
+        already miss it (docs/protocol.md "Serving scheduler")."""
         _, arrays = self._op(
             {
                 "op": "transform",
                 "model": name,
                 "input_col": input_col,
                 "n_cols": n_cols,
+                "deadline_s": deadline_s,
             },
             payload=self._to_ipc(data, input_col, "label"),
             want_arrays=True,
         )
         return arrays
+
+    def warmup(
+        self,
+        name: str,
+        n_cols: int,
+        k: Optional[int] = None,
+        dtype: str = "float32",
+        kind: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Pre-compile the serving scheduler's bucket ladder for a
+        registered model (additive op): after a warmup, first-request
+        latency is a dispatch, not a jit compile, and the recompile
+        counters are primed for the whole ladder. ``dtype`` must match
+        the dtype real query batches will carry (jit caches are
+        dtype-keyed); ``kind`` defaults daemon-side to ``kneighbors``
+        for KNN/ANN models and ``transform`` otherwise. On a daemon
+        without batching enabled this is an honest no-op — the response
+        carries ``enabled: false``."""
+        resp, _ = self._roundtrip(
+            {
+                "op": "warmup",
+                "model": name,
+                "n_cols": int(n_cols),
+                "k": k,
+                "dtype": dtype,
+                "kind": kind,
+            }
+        )
+        return {kk: v for kk, v in resp.items() if kk != "ok"}
 
     def drop_model(self, name: str) -> bool:
         resp, _ = self._roundtrip({"op": "drop_model", "model": name})
@@ -762,9 +796,11 @@ class DataPlaneClient:
         k: Optional[int] = None,
         input_col: str = "features",
         n_cols: Optional[int] = None,
+        deadline_s: Optional[float] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Query a daemon-registered index: returns (distances (q, k),
-        indices (q, k)) with global partition-major row ids."""
+        indices (q, k)) with global partition-major row ids.
+        ``deadline_s``: latency-budget hint, see :meth:`transform`."""
         _, arrays = self._op(
             {
                 "op": "kneighbors",
@@ -772,6 +808,7 @@ class DataPlaneClient:
                 "k": k,
                 "input_col": input_col,
                 "n_cols": n_cols,
+                "deadline_s": deadline_s,
             },
             payload=self._to_ipc(queries, input_col, "label"),
             want_arrays=True,

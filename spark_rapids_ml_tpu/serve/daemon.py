@@ -10,6 +10,14 @@ dispatch itself single-files through a process-wide ``_DEVICE_LOCK`` —
 one process owns the host's chips, concurrent sharded programs on one
 device set buy nothing and can deadlock the CPU backend outright.
 
+Serving plane: with ``serve_batching`` on, concurrent ``transform``/
+``kneighbors`` requests do NOT dispatch per connection — they queue into
+the micro-batching scheduler (serve/scheduler.py), which coalesces them
+across connections per model, pads to the bucket ladder, runs ONE device
+dispatch, and scatters per-request slices back. Admission overflow and
+deadline misses are shed with the existing busy/retry_after_s contract;
+the additive ``warmup`` op pre-compiles the ladder.
+
 Jobs: "pca" folds (count, Σx, XᵀX); "linreg" folds (XᵀX, Xᵀy, Σx, Σy,
 Σy², n). ``finalize`` runs the algorithm's shared finalize (eigensolve /
 normal-equations solve) and streams the result arrays back.
@@ -76,6 +84,7 @@ from spark_rapids_ml_tpu.ops import gram as gram_ops
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import row_sharding
 from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.serve import scheduler as scheduler_mod
 from spark_rapids_ml_tpu.utils import faults
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
@@ -126,6 +135,11 @@ _M_JOB_RESTORES = metrics_mod.counter(
     "Jobs resurrected from durable pass-boundary state after a restart, "
     "by algo",
 )
+_M_MODEL_EVICTIONS = metrics_mod.counter(
+    "srml_daemon_model_evictions_total",
+    "Served models evicted from the registry, by reason (lru = over the "
+    "daemon_max_models cap; ttl = idle past the reaper's deadline)",
+)
 
 #: Device-build cap for daemon-side IVF (bytes of raw f32 rows): past
 #: this, the full (n, d) matrix would not fit one chip's HBM alongside
@@ -147,7 +161,7 @@ _PAYLOAD_OPS = ("feed", "seed", "transform", "kneighbors")
 #: O(1) control ops (ping, health, status, step) always pass.
 _SHEDDABLE_OPS = (
     "feed", "feed_raw", "seed", "transform", "kneighbors", "merge_state",
-    "ensure_model",
+    "ensure_model", "warmup",
 )
 
 #: Process-wide device-execution lock. One process owns the host's chips
@@ -169,7 +183,7 @@ _KNOWN_OPS = frozenset((
     "ping", "health", "metrics", "status", "feed", "feed_raw", "seed",
     "commit", "step", "finalize", "drop", "export_state", "merge_state",
     "get_iterate", "set_iterate", "ensure_model", "transform",
-    "kneighbors", "model_status", "drop_model",
+    "kneighbors", "model_status", "drop_model", "warmup",
 ))
 
 
@@ -1458,6 +1472,17 @@ class _ServedModel:
             return dists, idx
 
 
+def _resolve_k(served, k):
+    """Canonical ``k`` for kneighbors dispatch and scheduler keying:
+    ``None`` means the model's fitted k, resolved HERE so k-omitted and
+    explicit-fitted-k traffic land in one batch queue (and a warmup with
+    k omitted covers both)."""
+    if k is not None:
+        return int(k)
+    getk = getattr(served.model, "getK", None)
+    return int(getk()) if getk is not None else None
+
+
 class DataPlaneDaemon:
     """Arrow-over-TCP accumulation server on the TPU host.
 
@@ -1479,6 +1504,8 @@ class DataPlaneDaemon:
         max_staged_bytes: Optional[int] = None,
         retry_after_s: Optional[float] = None,
         state_dir: Optional[str] = None,
+        serve_batching: Optional[bool] = None,
+        max_models: Optional[int] = None,
     ):
         from spark_rapids_ml_tpu import config
 
@@ -1508,6 +1535,22 @@ class DataPlaneDaemon:
             config.get("daemon_retry_after_s")
             if retry_after_s is None else retry_after_s
         )
+        # Serving scheduler (serve/scheduler.py): cross-connection
+        # micro-batching for transform/kneighbors. Off by default — the
+        # frozen protocol goldens (and every single-caller deployment)
+        # behave byte-identically with it off.
+        self._serve_batching = bool(
+            config.get("serve_batching")
+            if serve_batching is None else serve_batching
+        )
+        self._scheduler: Optional[scheduler_mod.RequestScheduler] = None
+        # Served-model registry LRU cap (0/None = unbounded): the TTL
+        # reaper only runs when a ttl is configured, so without this a
+        # long-lived daemon's model registry grows without bound.
+        self._max_models = int(
+            config.get("daemon_max_models") if max_models is None
+            else max_models
+        ) or None
         self._active_conns = 0
         self._conn_socks: set = set()
         self._conns_lock = threading.Lock()
@@ -1554,6 +1597,13 @@ class DataPlaneDaemon:
         s.listen(64)
         self._sock = s
         self._port = s.getsockname()[1]
+        # After the bind: a failed start() (port in use) is never
+        # stop()ped by the caller, so nothing may be running yet — the
+        # scheduler's dispatcher thread would leak per attempt.
+        if self._serve_batching:
+            self._scheduler = scheduler_mod.RequestScheduler(
+                retry_after_s=self._retry_after_s
+            ).start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="srml-dataplane-accept", daemon=True
         )
@@ -1572,6 +1622,10 @@ class DataPlaneDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._scheduler is not None:
+            # First: queued serving requests fail out and unblock their
+            # connection threads before the sockets are torn down.
+            self._scheduler.stop()
         if self._sock is not None:
             # Wake a blocked accept(): on Linux, close() alone does not
             # reliably interrupt a thread parked in accept() — every stop
@@ -1664,6 +1718,7 @@ class DataPlaneDaemon:
                 for n in stale_models:
                     del self._models[n]
             for n in stale_models:
+                _M_MODEL_EVICTIONS.inc(reason="ttl")
                 logger.warning("evicted idle served model %r", n)
             self._sweep_orphan_snapshots()
 
@@ -2051,6 +2106,8 @@ class DataPlaneDaemon:
             self._op_transform(conn, req)
         elif op == "kneighbors":
             self._op_kneighbors(conn, req)
+        elif op == "warmup":
+            self._op_warmup(conn, req)
         elif op == "model_status":
             with self._models_lock:
                 m = self._models.get(str(req.get("model")))
@@ -2130,6 +2187,13 @@ class DataPlaneDaemon:
             "served_models": served_models,
             "uptime_s": float(self._clock() - self._started),
             "busy": reason is not None,
+            # Additive: serving-scheduler state (config echo, per-model
+            # queue depths, dispatched batches) — what a load balancer
+            # or tools.top reads next to the watermark fields above.
+            "scheduler": (
+                {"enabled": False} if self._scheduler is None
+                else self._scheduler.snapshot()
+            ),
         }
         if reason is not None:
             resp["retry_after_s"] = self._retry_after_s
@@ -2153,6 +2217,8 @@ class DataPlaneDaemon:
             _M_MODELS.set(len(self._models))
         with self._conns_lock:
             _M_CONNS.set(self._active_conns)
+        if self._scheduler is not None:
+            self._scheduler.snapshot()  # refreshes the queue-depth gauge
         fmt = str(_opt(req, "format", "json"))
         base = {
             "ok": True,
@@ -2428,6 +2494,39 @@ class DataPlaneDaemon:
         job.set_iterate(arrays, int(req["iteration"]))
         protocol.send_json(conn, {"ok": True, **self._identity()})
 
+    def _enforce_model_cap_locked(self, keep: str) -> list:
+        """LRU eviction past ``daemon_max_models`` (call under
+        ``_models_lock``, right after registering ``keep``): a long-lived
+        daemon's model registry must be bounded even with no TTL reaper.
+        Re-creatable ``ensure_model`` registrations (ttl_scale 1.0) go
+        first — clients simply re-register on miss; daemon-built KNN
+        indexes are only reclaimed when nothing re-creatable remains
+        (their owners get the explicit evicted-refit error on the next
+        query, never a silent wrong answer). Returns the evicted names
+        (log outside the lock)."""
+        if self._max_models is None:
+            return []
+        evicted = []
+        while len(self._models) > self._max_models:
+            candidates = sorted(
+                ((m.ttl_scale, m.touched, n)
+                 for n, m in self._models.items() if n != keep),
+            )
+            if not candidates:
+                break
+            victim = candidates[0][2]
+            del self._models[victim]
+            _M_MODEL_EVICTIONS.inc(reason="lru")
+            evicted.append(victim)
+        return evicted
+
+    def _log_lru_evictions(self, evicted: list) -> None:
+        for victim in evicted:
+            logger.warning(
+                "evicted served model %r (LRU, registry over the "
+                "%d-model cap)", victim, self._max_models,
+            )
+
     def _op_ensure_model(self, conn, req: Dict[str, Any]) -> None:
         """Register a fitted model for serving (idempotent). The request
         JSON carries the ``arrays`` spec; raw array frames follow — the
@@ -2443,6 +2542,7 @@ class DataPlaneDaemon:
                 self._models[name] = _ServedModel(algo, arrays, params,
                                                   clock=self._clock)
                 created = True
+                evicted = self._enforce_model_cap_locked(keep=name)
             else:
                 if existing.algo != algo:
                     raise ValueError(
@@ -2451,7 +2551,82 @@ class DataPlaneDaemon:
                     )
                 existing.touched = existing._clock()
                 created = False
+                evicted = []
+        self._log_lru_evictions(evicted)
         protocol.send_json(conn, {"ok": True, "created": created})
+
+    def _serve_dispatch(
+        self, conn, req: Dict[str, Any], kind: str, name: str, served, x,
+        k: Optional[int] = None,
+    ):
+        """Run one serving request through the micro-batching scheduler
+        (when enabled and the request fits the bucket ladder) or solo.
+        Returns the result, or None after answering a scheduler shed
+        with the standard busy/retry_after_s response (payload already
+        drained — framing stays aligned)."""
+        sched = self._scheduler
+        if sched is not None:
+            if sched.eligible(int(x.shape[0])):
+                try:
+                    return sched.submit(
+                        name, served, kind, x, k=k,
+                        deadline_s=req.get("deadline_s"),
+                    )
+                except scheduler_mod.SchedulerBusy as e:
+                    _M_BUSY_SHEDS.inc(op=_op_label(kind))
+                    protocol.send_json(
+                        conn,
+                        {
+                            "ok": False,
+                            "busy": True,
+                            "retry_after_s": e.retry_after_s,
+                            "error": f"busy: {e}",
+                        },
+                    )
+                    return None
+            elif x.shape[0]:  # 0-row isn't "larger than the ladder"
+                sched.note_bypass(kind)
+        if kind == "transform":
+            return served.transform(x)
+        return served.kneighbors(x, k)
+
+    def _op_warmup(self, conn, req: Dict[str, Any]) -> None:
+        """Additive op: pre-compile the scheduler's bucket ladder for a
+        served model, so first-request latency is a dispatch, not a jit
+        compile. ``n_cols`` names the feature width to warm (the model's
+        fitted width); ``dtype`` (default float32) must match the dtype
+        real traffic will carry — jit caches are dtype-keyed. With the
+        scheduler disabled the op is an honest no-op (enabled: false)."""
+        name = str(req["model"])
+        with self._models_lock:
+            served = self._models.get(name)
+        if served is None:
+            raise KeyError(f"no such model {name!r}; ensure_model first")
+        if self._scheduler is None:
+            protocol.send_json(
+                conn,
+                {"ok": True, "enabled": False, "buckets": [], "compiled": 0},
+            )
+            return
+        n_cols = req.get("n_cols")
+        if n_cols is None:
+            raise ValueError("warmup needs n_cols (the model's feature width)")
+        kind = _opt(
+            req, "kind",
+            "kneighbors" if hasattr(served.model, "kneighbors")
+            else "transform",
+        )
+        if kind not in ("transform", "kneighbors"):
+            raise ValueError(
+                f"unknown warmup kind {kind!r} (transform|kneighbors)"
+            )
+        k = req.get("k")
+        info = self._scheduler.warmup(
+            name, served, int(n_cols), kind=str(kind),
+            k=_resolve_k(served, k) if kind == "kneighbors" else None,
+            dtype=str(_opt(req, "dtype", "float32")),
+        )
+        protocol.send_json(conn, {"ok": True, "enabled": True, **info})
 
     def _op_transform(self, conn, req: Dict[str, Any]) -> None:
         """Run a registered model over one Arrow batch; output arrays
@@ -2473,7 +2648,9 @@ class DataPlaneDaemon:
         x = table_column_to_matrix(
             table, _opt(req, "input_col", "features"), req.get("n_cols")
         )
-        outs = served.transform(x)
+        outs = self._serve_dispatch(conn, req, "transform", name, served, x)
+        if outs is None:
+            return  # shed with busy; the client retries
         _send_arrays_counted(
             conn, "transform", outs, {"ok": True, "rows": int(x.shape[0])}
         )
@@ -2501,8 +2678,13 @@ class DataPlaneDaemon:
         q = table_column_to_matrix(
             table, _opt(req, "input_col", "features"), req.get("n_cols")
         )
-        k = req.get("k")
-        dists, idx = served.kneighbors(q, None if k is None else int(k))
+        k = _resolve_k(served, req.get("k"))
+        res = self._serve_dispatch(
+            conn, req, "kneighbors", name, served, q, k=k,
+        )
+        if res is None:
+            return  # shed with busy; the client retries
+        dists, idx = res
         _send_arrays_counted(
             conn,
             "kneighbors",
@@ -2543,6 +2725,8 @@ class DataPlaneDaemon:
                 self._models[name] = _ServedModel.from_model(
                     algo, model, clock=self._clock, id_map=id_map
                 )
+                evicted = self._enforce_model_cap_locked(keep=name)
+            self._log_lru_evictions(evicted)
             self._discard_job_state(str(req.get("job")))  # before pop (see drop)
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)

@@ -16,6 +16,11 @@ Any change to frames, fields, or semantics of existing ops bumps the
 version; additive new ops keep it. ``tests/test_protocol_golden.py``
 replays a recorded v1 byte transcript against a live daemon — if that
 test fails, the frozen contract broke.
+
+The serving scheduler (serve/scheduler.py) is invisible at this layer by
+design: micro-batched ``transform``/``kneighbors`` responses are
+byte-identical to solo ones, and the additive ``warmup`` op is a plain
+JSON round-trip — no new framing shapes.
 """
 
 from __future__ import annotations

@@ -1,0 +1,586 @@
+"""Serving scheduler: cross-connection micro-batching for the inference plane.
+
+Without it, every ``transform``/``kneighbors`` request runs alone on its
+connection thread: N concurrent Spark tasks (or online callers) serialize
+on ``_DEVICE_LOCK`` with batch-size-1 device dispatches, and every novel
+row count jit-compiles a fresh program. This module is the missing layer
+between "correct daemon" and "heavy traffic" (ROADMAP north star): a
+per-daemon scheduler that COALESCES concurrent serving requests — across
+connections, per model — into padded micro-batches before the one device
+dispatch, the Podracer move of centralizing accelerator dispatch behind a
+batching actor (PAPERS.md, arXiv:2104.06272).
+
+Core pieces (docs/protocol.md "Serving scheduler"):
+
+* **Admission control** — a bounded per-model queue. Overflow, and
+  requests whose ``deadline_s`` the current backlog would already miss,
+  are shed with :class:`SchedulerBusy`, which the daemon answers with
+  the existing ``busy``/``retry_after_s`` contract — graceful shedding
+  beats queueing to death, and every existing client already retries.
+* **Shape bucketing** — coalesced rows are padded up to a small fixed
+  ladder of bucket sizes (config ``serve_batch_buckets``, env
+  ``SRML_SERVE_BATCH_BUCKETS``), so jit compilations are BOUNDED by the
+  ladder size and counted (``srml_scheduler_compile_misses_total``).
+  Padding is exact by construction: every model's serving path is
+  row-wise (``run_bucketed`` / the KNN query bucketer already pad), so
+  a padded row can never contaminate a real row's output — batched
+  results are bitwise-equal to solo requests (tested across bucket
+  boundaries in tests/test_serve_scheduler.py).
+* **Batching loop** — one dispatcher thread drains the queues: a batch
+  goes to the device when its oldest request has waited
+  ``serve_batch_window_ms`` or the coalesced rows reach
+  ``serve_max_batch_rows``, dispatches ONCE under the model lock +
+  ``_DEVICE_LOCK`` (via ``_ServedModel``), and scatters per-request row
+  slices back to the waiting connection threads.
+* **Warmup** — :meth:`RequestScheduler.warmup` pre-compiles the bucket
+  ladder for a served model (the additive ``warmup`` wire op), so
+  first-request latency is predictable instead of hiding a compile.
+
+Batches only ever mix requests with identical (model, kind, k, dtype,
+row width) — anything else would change numerics or shapes. A single
+request larger than the coalescing cap bypasses the scheduler entirely
+(``srml_scheduler_bypass_total``); its solo dispatch is one device
+program, and the model-side bucketer (``run_bucketed`` / the KNN query
+bucketer) keeps even bypass compiles bounded.
+
+Fault site ``daemon.scheduler`` (utils/faults.py): an injected fault at
+admission is translated into a shed — the chaos suite proves shed
+requests retry to exact results through the ordinary busy contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.scheduler")
+
+__all__ = ["RequestScheduler", "SchedulerBusy", "parse_buckets"]
+
+#: Scheduler telemetry (docs/observability.md catalogs all of these).
+_M_QUEUE_DEPTH = metrics_mod.gauge(
+    "srml_scheduler_queue_depth",
+    "Queued serving requests, by model (refreshed at scrape)",
+)
+_M_BATCHES = metrics_mod.counter(
+    "srml_scheduler_batches_total", "Micro-batches dispatched, by op"
+)
+_M_BATCHED_REQUESTS = metrics_mod.counter(
+    "srml_scheduler_batched_requests_total",
+    "Requests served through micro-batches, by op",
+)
+_M_BATCH_ROWS = metrics_mod.histogram(
+    "srml_scheduler_batch_rows",
+    "Real (unpadded) rows per dispatched micro-batch, by op — the "
+    "occupancy distribution; mean occupancy = sum/count",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+)
+_M_BATCH_SECONDS = metrics_mod.histogram(
+    "srml_scheduler_batch_seconds", "Micro-batch device dispatch latency, by op"
+)
+_M_PADDED_ROWS = metrics_mod.counter(
+    "srml_scheduler_padded_rows_total",
+    "Padding rows added to reach the bucket size, by op (waste ratio = "
+    "padded / (padded + batch_rows sum))",
+)
+_M_SHEDS = metrics_mod.counter(
+    "srml_scheduler_sheds_total",
+    "Requests shed at admission, by op and reason "
+    "(queue_full|deadline|fault|stopping)",
+)
+_M_COMPILE_MISSES = metrics_mod.counter(
+    "srml_scheduler_compile_misses_total",
+    "First dispatches of a novel (model, op, bucket, k, dtype) shape — "
+    "each one is at most one jit compile, bounded by the bucket ladder",
+)
+_M_COMPILE_HITS = metrics_mod.counter(
+    "srml_scheduler_compile_hits_total",
+    "Dispatches that reused an already-seen batch shape, by op",
+)
+_M_BYPASS = metrics_mod.counter(
+    "srml_scheduler_bypass_total",
+    "Requests larger than the coalescing cap (serve_max_batch_rows "
+    "floored to a bucket, at most the top bucket) served solo, by op",
+)
+
+#: Fallback ladder when the config string fails to parse — matches the
+#: config.py default so a typo degrades to the documented behavior.
+_DEFAULT_BUCKETS = (64, 256, 1024, 4096)
+
+
+class SchedulerBusy(RuntimeError):
+    """Admission shed the request; the daemon answers the existing
+    ``busy``/``retry_after_s`` contract and the client retries."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+def parse_buckets(spec) -> Tuple[int, ...]:
+    """``serve_batch_buckets`` value → ascending positive ints. Accepts a
+    comma-separated string or any int iterable; falls back to the default
+    ladder (with a warning) on garbage — a typo'd env var must degrade,
+    not kill the daemon."""
+    try:
+        if isinstance(spec, str):
+            vals = [int(p) for p in spec.replace(";", ",").split(",") if p.strip()]
+        else:
+            vals = [int(v) for v in spec]
+        vals = sorted(set(vals))
+        if not vals or vals[0] <= 0:
+            raise ValueError(f"buckets must be positive ints, got {spec!r}")
+        return tuple(vals)
+    except (TypeError, ValueError) as e:
+        logger.warning(
+            "bad serve_batch_buckets %r (%s); using default %s",
+            spec, e, _DEFAULT_BUCKETS,
+        )
+        return _DEFAULT_BUCKETS
+
+
+class _Request:
+    """One enqueued serving request: rows in, a slice of the batch out."""
+
+    __slots__ = ("x", "rows", "event", "result", "error", "enq_t")
+
+    def __init__(self, x: np.ndarray, enq_t: float):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.enq_t = enq_t
+
+
+class RequestScheduler:
+    """Cross-connection micro-batching for ``transform``/``kneighbors``.
+
+    Thread model: connection threads :meth:`submit` and block on their
+    request's event; ONE dispatcher thread owns every device dispatch
+    (batches from different models still single-file — the device set is
+    one resource, exactly what ``_DEVICE_LOCK`` enforces anyway — so one
+    loop thread costs no throughput and keeps the batching logic
+    race-free by construction). The loop never holds the queue lock
+    across a dispatch: queues keep filling while the device runs.
+    """
+
+    def __init__(
+        self,
+        window_ms: Optional[float] = None,
+        max_batch_rows: Optional[int] = None,
+        buckets=None,
+        queue_depth: Optional[int] = None,
+        retry_after_s: float = 1.0,
+    ):
+        from spark_rapids_ml_tpu import config
+
+        self._window_s = float(
+            config.get("serve_batch_window_ms") if window_ms is None
+            else window_ms
+        ) / 1000.0
+        self._max_rows = int(
+            config.get("serve_max_batch_rows") if max_batch_rows is None
+            else max_batch_rows
+        )
+        self._buckets = parse_buckets(
+            config.get("serve_batch_buckets") if buckets is None else buckets
+        )
+        self._queue_depth = int(
+            config.get("serve_queue_depth") if queue_depth is None
+            else queue_depth
+        )
+        self._retry_after_s = float(retry_after_s)
+        # Coalescing cap: a batch must fit the top bucket AND the row
+        # cap — floored to a bucket boundary, because a batch coalesced
+        # past one would pad UP to the next bucket, dispatching more
+        # device rows than the operator's cap (and at a shape warmup
+        # never compiled). A cap below the smallest bucket stands as-is:
+        # those batches pad to the smallest bucket, which warmup covers
+        # via _bucket_for.
+        cap = min(self._max_rows, self._buckets[-1])
+        for b in reversed(self._buckets):
+            if b <= cap:
+                cap = b
+                break
+        self._cap_rows = cap
+        self._cv = threading.Condition()
+        #: (model, kind, k, dtype, width, id(served)) → deque[_Request].
+        #: The full key guards numerics: mixing dtypes would promote,
+        #: mixing k would change output widths, and id(served) pins the
+        #: batch to ONE registered model instance even across a racing
+        #: drop_model + ensure_model under the same name.
+        self._queues: Dict[tuple, deque] = {}
+        #: served instance per key (the dispatch target).
+        self._served: Dict[tuple, Any] = {}
+        #: model name → queued request count (the admission bound).
+        self._depth: Dict[str, int] = {}
+        #: model name → queued rows (the deadline estimator's backlog).
+        self._qrows: Dict[str, int] = {}
+        #: queue key → queued rows: a running total, so the dispatcher's
+        #: due-scan is O(#keys), not O(#queued requests), under the lock.
+        self._krows: Dict[tuple, int] = {}
+        #: model names the queue-depth gauge was last refreshed with
+        #: (snapshot-thread only; pruned names get a final 0).
+        self._gauged: set = set()
+        #: EWMA of batch dispatch seconds (deadline admission input).
+        self._ewma_s = 0.0
+        self._batches = 0
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RequestScheduler":
+        self._thread = threading.Thread(
+            target=self._loop, name="srml-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Fail every pending request and stop the loop: a stopping
+        daemon must unblock its connection threads, not strand them."""
+        with self._cv:
+            self._stopping = True
+            pending = [r for q in self._queues.values() for r in q]
+            self._queues.clear()
+            self._served.clear()
+            self._depth.clear()
+            self._qrows.clear()
+            self._krows.clear()
+            self._cv.notify_all()
+        for r in pending:
+            r.error = SchedulerBusy("scheduler stopping", self._retry_after_s)
+            r.event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- admission + submit ------------------------------------------------
+
+    def eligible(self, n_rows: int) -> bool:
+        """Whether a request of this size belongs in a micro-batch: one
+        larger than the coalescing cap is already a full device dispatch
+        on its own (and would never fit a bucket)."""
+        return 0 < n_rows <= self._cap_rows
+
+    def submit(
+        self,
+        model: str,
+        served,
+        kind: str,
+        x: np.ndarray,
+        k: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ):
+        """Enqueue one request and block until its batch dispatched.
+
+        Returns the request's slice of the batch result: the role-keyed
+        output dict for ``transform``, a ``(distances, indices)`` pair
+        for ``kneighbors``. Raises :class:`SchedulerBusy` when admission
+        sheds it, or the dispatch's exception verbatim.
+        """
+        x = np.ascontiguousarray(x)
+        key = (model, kind, k, str(x.dtype), int(x.shape[1]), id(served))
+        # The chaos hook (before the lock: a latency rule must not stall
+        # every other submitter): an injected fault HERE models a
+        # scheduler under pressure — translated to a shed so the client
+        # walks the ordinary busy-retry path (and the chaos suite can
+        # assert retried results are exact).
+        try:
+            faults.checkpoint("daemon.scheduler")
+        except (ConnectionError, OSError) as e:
+            _M_SHEDS.inc(op=kind, reason="fault")
+            raise SchedulerBusy(
+                f"scheduler shed (injected fault: {e})",
+                self._retry_after_s,
+            ) from e
+        with self._cv:
+            if self._stopping:
+                _M_SHEDS.inc(op=kind, reason="stopping")
+                raise SchedulerBusy("scheduler stopping", self._retry_after_s)
+            depth = self._depth.get(model, 0)
+            if depth >= self._queue_depth:
+                _M_SHEDS.inc(op=kind, reason="queue_full")
+                raise SchedulerBusy(
+                    f"{depth} requests queued for model {model!r} "
+                    f"(cap {self._queue_depth})",
+                    self._retry_after_s,
+                )
+            if deadline_s is not None and self._ewma_s > 0.0:
+                # Backlog-aware estimate: the batches ahead of us plus
+                # our own, each costing ~EWMA seconds. Requests that
+                # would expire IN the queue are shed now — the client's
+                # wait is spent retrying, not queueing to death.
+                backlog = self._qrows.get(model, 0) / max(self._cap_rows, 1)
+                est = self._ewma_s * (1.0 + backlog)
+                if est > float(deadline_s):
+                    _M_SHEDS.inc(op=kind, reason="deadline")
+                    raise SchedulerBusy(
+                        f"estimated wait {est:.3f}s exceeds the request "
+                        f"deadline {float(deadline_s):.3f}s",
+                        self._retry_after_s,
+                    )
+            req = _Request(x, time.monotonic())
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = deque()
+            self._served[key] = served
+            q.append(req)
+            self._depth[model] = depth + 1
+            self._qrows[model] = self._qrows.get(model, 0) + req.rows
+            self._krows[key] = self._krows.get(key, 0) + req.rows
+            self._cv.notify_all()
+        # Block outside the lock. The dispatcher sets the event; the
+        # liveness check is a backstop for a dead loop thread (a bug,
+        # not a load condition) — requests must never hang a connection
+        # forever.
+        while not req.event.wait(timeout=1.0):
+            if self._thread is None or not self._thread.is_alive():
+                raise RuntimeError(
+                    "serving scheduler dispatcher died with requests "
+                    "in flight"
+                )
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def note_bypass(self, kind: str) -> None:
+        """Account a request too large for the ladder that the daemon
+        served solo (the scheduler never saw its rows)."""
+        _M_BYPASS.inc(op=kind)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(
+        self,
+        model: str,
+        served,
+        n_cols: int,
+        kind: str = "transform",
+        k: Optional[int] = None,
+        dtype: str = "float32",
+    ) -> Dict[str, Any]:
+        """Pre-compile the bucket ladder for one served model: dispatch a
+        full zero batch per bucket through the exact batched path, so the
+        jit caches (and the compile ledger) are primed before the first
+        real request. Only the REACHABLE ladder is warmed — buckets above
+        ``serve_max_batch_rows`` can never hold a coalesced batch
+        (oversize singles bypass the scheduler), so compiling them would
+        be pure dead weight. Returns ``{"buckets", "compiled"}`` —
+        ``compiled`` counts the shapes this call saw for the first
+        time."""
+        # The reachable ladder: every bucket some coalesced batch can
+        # map to, i.e. up to _bucket_for(cap) — covers a cap below the
+        # smallest bucket, where batches still pad to that bucket.
+        top = self._bucket_for(self._cap_rows)
+        ladder = [b for b in self._buckets if b <= top]
+        compiled = 0
+        for bucket in ladder:
+            x = np.zeros((bucket, int(n_cols)), dtype=np.dtype(dtype))
+            key = (model, kind, k, str(x.dtype), int(n_cols), id(served))
+            req = _Request(x, time.monotonic())
+            if self._dispatch(key, [req], served, record=False):
+                compiled += 1
+            if req.error is not None:
+                raise req.error
+        return {"buckets": ladder, "compiled": compiled}
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The `health` op's scheduler block (and the gauge refresher):
+        config echo + live queue depths (models with queued work only)
+        + dispatch totals."""
+        with self._cv:
+            models = {m: d for m, d in self._depth.items()}
+            batches = self._batches
+            # A model seen at the last scrape but pruned since must read
+            # 0, not freeze at its final queued value. All under the
+            # lock: health and metrics ops snapshot from concurrent
+            # connection threads.
+            for m in self._gauged - set(models):
+                _M_QUEUE_DEPTH.set(0, model=m)
+            self._gauged = set(models)
+            for m, d in models.items():
+                _M_QUEUE_DEPTH.set(d, model=m)
+        return {
+            "enabled": True,
+            "window_ms": self._window_s * 1000.0,
+            "max_batch_rows": self._max_rows,
+            "buckets": list(self._buckets),
+            "queue_depth_cap": self._queue_depth,
+            "queued": sum(models.values()),
+            "models": models,
+            "batches": batches,
+        }
+
+    # -- batching loop -----------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]  # unreachable: coalescing caps at top
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                key = self._next_due_locked()
+                while key is None:
+                    if self._stopping:
+                        return
+                    self._cv.wait(timeout=self._wait_s_locked())
+                    key = self._next_due_locked()
+                batch, served = self._pop_batch_locked(key)
+            if batch:
+                self._dispatch(key, batch, served)
+            # Loop locals must not pin the served model (or the batch
+            # payloads) across the next idle wait.
+            batch = served = None
+
+    def _wait_s_locked(self) -> Optional[float]:
+        """Sleep until the oldest pending request's window expires (None
+        = nothing pending, wait for a submit's notify)."""
+        oldest = None
+        for q in self._queues.values():
+            if q and (oldest is None or q[0].enq_t < oldest):
+                oldest = q[0].enq_t
+        if oldest is None:
+            return None
+        return max(oldest + self._window_s - time.monotonic(), 0.001)
+
+    def _next_due_locked(self) -> Optional[tuple]:
+        """The dispatchable key whose head request is oldest: due when
+        the window elapsed or the coalesced rows already fill a batch."""
+        now = time.monotonic()
+        due, due_t = None, None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            rows = self._krows.get(key, 0)
+            if now - q[0].enq_t >= self._window_s or rows >= self._cap_rows:
+                if due_t is None or q[0].enq_t < due_t:
+                    due, due_t = key, q[0].enq_t
+        return due
+
+    def _pop_batch_locked(self, key: tuple):
+        q = self._queues.get(key)
+        if not q:
+            return [], None
+        model = key[0]
+        batch = [q.popleft()]
+        total = batch[0].rows
+        while q and total + q[0].rows <= self._cap_rows:
+            r = q.popleft()
+            batch.append(r)
+            total += r.rows
+        served = self._served.get(key)
+        if not q:
+            # Drop the drained queue AND its served-model reference: the
+            # scheduler must never pin a dropped/evicted _ServedModel
+            # (daemon-built KNN indexes are dataset-sized) past its last
+            # queued request — submit() re-registers on the next one.
+            del self._queues[key]
+            self._served.pop(key, None)
+            self._krows.pop(key, None)
+        else:
+            self._krows[key] = self._krows.get(key, 0) - total
+        # Prune zeroed accounting entries: per-model dicts (and the
+        # health "models" map built from them) must not grow one dead
+        # key per model name ever served — snapshot() zeroes the gauge
+        # for names that vanish.
+        if self._depth.get(model, 0) - len(batch) <= 0:
+            self._depth.pop(model, None)
+            self._qrows.pop(model, None)
+        else:
+            self._depth[model] -= len(batch)
+            self._qrows[model] = self._qrows.get(model, 0) - total
+        return batch, served
+
+    def _dispatch(self, key: tuple, batch, served, record: bool = True) -> bool:
+        """Pad the coalesced rows to the bucket, run ONE device dispatch
+        through the served model (its lock + ``_DEVICE_LOCK``), scatter
+        per-request slices, wake the waiters. Never raises: a dispatch
+        failure lands on every request in the batch. Returns whether the
+        batch shape was novel (a compile miss). Shared-state mutations
+        (``_seen``, ``_ewma_s``, ``_batches``) take the lock — warmup
+        runs this on a connection thread concurrently with the loop."""
+        model, kind, k, dtype, width = key[0], key[1], key[2], key[3], key[4]
+        total = sum(r.rows for r in batch)
+        bucket = self._bucket_for(total)
+        shape_key = (kind, k, dtype, width, bucket)
+        with self._cv:
+            # The compile ledger lives ON the served instance: it dies
+            # with the model (no growth across model churn), and a
+            # re-registration under an old name correctly counts misses
+            # — its jit caches are fresh too.
+            ledger = getattr(served, "_sched_seen", None)
+            if ledger is None:
+                ledger = set()
+                served._sched_seen = ledger
+            fresh = shape_key not in ledger
+            if fresh:
+                ledger.add(shape_key)
+        if fresh:
+            _M_COMPILE_MISSES.inc(op=kind)
+        else:
+            _M_COMPILE_HITS.inc(op=kind)
+        xb = np.zeros((bucket, width), dtype=np.dtype(dtype))
+        offsets = []
+        off = 0
+        for r in batch:
+            xb[off:off + r.rows] = r.x
+            offsets.append(off)
+            off += r.rows
+        t0 = time.perf_counter()
+        try:
+            if kind == "transform":
+                outs = served.transform(xb)
+                for r, o in zip(batch, offsets):
+                    r.result = {
+                        name: np.asarray(v)[o:o + r.rows]
+                        for name, v in outs.items()
+                    }
+            elif kind == "kneighbors":
+                dists, idx = served.kneighbors(xb, k)
+                dists, idx = np.asarray(dists), np.asarray(idx)
+                for r, o in zip(batch, offsets):
+                    r.result = (dists[o:o + r.rows], idx[o:o + r.rows])
+            else:  # pragma: no cover - submit() only enqueues the two kinds
+                raise ValueError(f"unknown scheduler kind {kind!r}")
+        except BaseException as e:  # noqa: BLE001 - every waiter must wake
+            for r in batch:
+                r.error = e
+        finally:
+            dt = time.perf_counter() - t0
+            with self._cv:
+                # Decorrelated-enough smoothing for the deadline
+                # estimator. Fresh shapes are EXCLUDED: a first dispatch
+                # includes the jit compile (seconds), and an estimate
+                # poisoned by compile time would shed every deadline-
+                # carrying request forever — the EWMA only ever updates
+                # on a dispatch, so it could never decay back down.
+                if not fresh:
+                    self._ewma_s = dt if self._ewma_s == 0.0 else (
+                        0.8 * self._ewma_s + 0.2 * dt
+                    )
+                if record:
+                    self._batches += 1
+            if record:
+                _M_BATCHES.inc(op=kind)
+                _M_BATCHED_REQUESTS.inc(len(batch), op=kind)
+                _M_BATCH_ROWS.observe(total, op=kind)
+                _M_PADDED_ROWS.inc(bucket - total, op=kind)
+                _M_BATCH_SECONDS.observe(dt, op=kind)
+            for r in batch:
+                r.event.set()
+        return fresh

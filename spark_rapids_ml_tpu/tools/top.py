@@ -34,6 +34,13 @@ TX = "srml_daemon_tx_bytes_total"
 PHASES = "srml_phase_duration_seconds"
 RESTORES = "srml_daemon_job_restores_total"
 RECOVERIES = "srml_fit_recoveries_total"
+SCHED_QUEUE = "srml_scheduler_queue_depth"
+SCHED_BATCH_ROWS = "srml_scheduler_batch_rows"
+SCHED_BATCHED = "srml_scheduler_batched_requests_total"
+SCHED_PADDED = "srml_scheduler_padded_rows_total"
+SCHED_MISSES = "srml_scheduler_compile_misses_total"
+SCHED_HITS = "srml_scheduler_compile_hits_total"
+SCHED_SHEDS = "srml_scheduler_sheds_total"
 
 
 def quantile_from_buckets(buckets: Dict[str, int], q: float) -> Optional[float]:
@@ -166,6 +173,10 @@ def render(
             f"{_fmt_bytes(rx.get(op, 0.0)):>10}"
             f"{_fmt_bytes(tx.get(op, 0.0)):>10}"
         )
+    sched = _sched_lines(health, snap)
+    if sched:
+        lines.append("")
+        lines.extend(sched)
     phases = _hist_by_label(snap.get(PHASES), "phase")
     if phases:
         lines.append("")
@@ -179,6 +190,65 @@ def render(
                 f"{_fmt_secs(quantile_from_buckets(s.get('buckets', {}), 0.99)):>9}"
             )
     return "\n".join(lines)
+
+
+def _sched_lines(health: Dict[str, Any], snap: Dict[str, Any]) -> List[str]:
+    """The serving-scheduler panel (docs/protocol.md "Serving
+    scheduler"): per-model queue depth, batch-occupancy quantiles +
+    mean, padding-waste ratio, compile-cache hits/misses, sheds. Empty
+    when the daemon runs unbatched — top never renders a dead panel."""
+    sched_health = (health or {}).get("scheduler") or {}
+    occ = _hist_by_label(snap.get(SCHED_BATCH_ROWS), "op")
+    if not sched_health.get("enabled") and not occ:
+        return []
+    lines: List[str] = []
+    models = sched_health.get("models") or {
+        s["labels"].get("model", "?"): s.get("value", 0)
+        for s in (snap.get(SCHED_QUEUE) or {}).get("samples", [])
+    }
+    head = "scheduler"
+    if sched_health:
+        head += (
+            f"  window {float(sched_health.get('window_ms', 0.0)):.0f}ms"
+            f"  buckets {','.join(str(b) for b in sched_health.get('buckets', []))}"
+            f"  batches {int(sched_health.get('batches', 0))}"
+        )
+    if models:
+        head += "  queued " + " ".join(
+            f"{m}:{int(d)}" for m, d in sorted(models.items())
+        )
+    lines.append(head)
+    reqs = _sum_by_op(snap.get(SCHED_BATCHED))
+    padded = _sum_by_op(snap.get(SCHED_PADDED))
+    misses = _sum_by_op(snap.get(SCHED_MISSES))
+    hits = _sum_by_op(snap.get(SCHED_HITS))
+    sheds: Dict[str, float] = {}
+    for s in (snap.get(SCHED_SHEDS) or {}).get("samples", []):
+        op = s["labels"].get("op", "")
+        sheds[op] = sheds.get(op, 0.0) + float(s.get("value", 0.0))
+    if occ:
+        lines.append(
+            f"{'op':<14}{'reqs':>8}{'batches':>9}{'occ p50':>9}"
+            f"{'occ p99':>9}{'mean':>7}{'waste':>7}{'miss/hit':>10}{'sheds':>7}"
+        )
+        for op in sorted(occ):
+            s = occ[op]
+            count = int(s.get("count", 0))
+            total_rows = float(s.get("sum", 0.0))
+            mean = total_rows / count if count else 0.0
+            pad = padded.get(op, 0.0)
+            waste = pad / (pad + total_rows) if (pad + total_rows) else 0.0
+            p50 = quantile_from_buckets(s.get("buckets", {}), 0.50)
+            p99 = quantile_from_buckets(s.get("buckets", {}), 0.99)
+            lines.append(
+                f"{op:<14}{int(reqs.get(op, 0)):>8}{count:>9}"
+                f"{(p50 if p50 is not None else 0):>9.1f}"
+                f"{(p99 if p99 is not None else 0):>9.1f}"
+                f"{mean:>7.1f}{waste:>7.0%}"
+                f"{int(misses.get(op, 0)):>5}/{int(hits.get(op, 0)):<4}"
+                f"{int(sheds.get(op, 0)):>7}"
+            )
+    return lines
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -25,6 +25,10 @@ fires):
                           durable snapshot, when armed), before the ack —
                           a crash here is a daemon dying exactly between
                           two passes
+``daemon.scheduler``      serving-scheduler admission (serve/scheduler.py):
+                          a drop/refuse here is translated into a shed —
+                          the request is answered with the busy/
+                          retry_after_s contract, never queued
 ``wire.send_frame``       every outbound frame, both directions (partial/drop)
 ``bridge.to_matrix``      Arrow list column → matrix conversion
 ``bridge.to_ipc``         matrix/table → Arrow IPC encode (client feed path)

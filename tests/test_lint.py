@@ -54,6 +54,7 @@ def test_fault_checkpoints_exist_at_contract_sites():
         "serve/client.py": ["client.connect", "client.op"],
         "serve/daemon.py": ["daemon.conn", "daemon.op",
                             "daemon.pass_boundary"],
+        "serve/scheduler.py": ["daemon.scheduler"],
         "serve/protocol.py": ["wire.send_frame"],
         "bridge/arrow.py": ["bridge.to_matrix", "bridge.to_ipc"],
     }
@@ -138,7 +139,7 @@ def test_metric_names_follow_the_convention():
             elif kind == "counter" and not name.endswith("_total"):
                 offenders.append(f"{where} (counter must end _total)")
             elif kind == "histogram" and not name.endswith(
-                ("_seconds", "_bytes")
+                ("_seconds", "_bytes", "_rows")
             ):
                 offenders.append(f"{where} (histogram must end in a unit)")
             elif kind == "gauge" and name.endswith("_total"):
@@ -178,6 +179,35 @@ def test_wire_ops_are_clamped_and_documented():
     assert undocumented == [], (
         "ops dispatched by the daemon but absent from docs/protocol.md "
         f"(the frozen contract): {undocumented}"
+    )
+
+
+def test_serve_config_keys_have_env_alias_and_docs():
+    """Every ``serve_*`` scheduler config key is an operator API: it must
+    have its deployment-facing ``SRML_<KEY>`` env alias wired in
+    config.py AND appear in docs/protocol.md's "Serving scheduler"
+    contract (the mirror of the wire-op clamp+docs gate) — a knob cannot
+    be added silently, without an env spelling or documentation."""
+    text = (PKG / "config.py").read_text()
+    keys = sorted(set(re.findall(r'^\s+"(serve_[a-z0-9_]+)"\s*:', text, re.M)))
+    assert len(keys) >= 5, (
+        f"only {len(keys)} serve_* config keys found — the scheduler "
+        "config block or this regex regressed"
+    )
+    docs = (PKG.parent / "docs" / "protocol.md").read_text()
+    missing_env = [k for k in keys if f"SRML_{k.upper()}" not in text]
+    assert missing_env == [], (
+        "serve_* config keys without an SRML_ env alias in config.py: "
+        + ", ".join(missing_env)
+    )
+    undocumented = [
+        k for k in keys
+        if not (re.search(rf"\b{k}\b", docs)
+                and re.search(rf"\bSRML_{k.upper()}\b", docs))
+    ]
+    assert undocumented == [], (
+        "serve_* config keys (or their SRML_ env aliases) absent from "
+        "docs/protocol.md: " + ", ".join(undocumented)
     )
 
 

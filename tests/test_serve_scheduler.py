@@ -1,0 +1,491 @@
+"""Serving-scheduler tests: cross-connection micro-batching exactness,
+bounded recompiles, admission control, warmup, chaos, model LRU.
+
+The load-bearing claim is EXACTNESS: a batched request's output must be
+bitwise-identical to the same request served alone. The scheduler earns
+that by construction — every serving path is row-wise and already pads
+through a bucketer, so a co-batched (or padding) row can never reach
+another row's output — and these tests enforce it across bucket
+boundaries (sizes 1, bucket−1, bucket, bucket+1) with np.array_equal,
+not allclose.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.serve import (
+    DataPlaneClient,
+    DataPlaneDaemon,
+    RequestScheduler,
+    SchedulerBusy,
+)
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+
+#: The test ladder: small buckets so boundary sizes stay cheap. Every
+#: size here still pads ≤ the model-side minimum bucket (run_bucketed's
+#: 256 / the KNN bucketer's 64), so solo and batched requests compile
+#: the SAME device program — the strongest form of the exactness claim.
+BUCKETS = "8,32,128"
+BUCKET = 8
+
+D = 24
+
+
+@pytest.fixture
+def data(rng):
+    basis = rng.normal(size=(D, D)) * np.logspace(0, -1.5, D)
+    return rng.normal(size=(500, D)) @ basis
+
+
+@pytest.fixture
+def pca_arrays(data, mesh8):
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    return PCA(mesh=mesh8).setK(3).fit({"features": data})._model_data()
+
+
+def _batched_daemon(mesh, **over):
+    opts = {
+        "serve_batching": True,
+        "serve_batch_buckets": BUCKETS,
+        "serve_batch_window_ms": 30.0,
+        "daemon_retry_after_s": 0.05,
+    }
+    opts.update(over)
+    ctxs = [config.option(k, v) for k, v in opts.items()]
+    for c in ctxs:
+        c.__enter__()
+    daemon = DataPlaneDaemon(mesh=mesh).start()
+
+    def close():
+        daemon.stop()
+        for c in reversed(ctxs):
+            c.__exit__()
+
+    return daemon, close
+
+
+def _concurrent(n, fn):
+    """Run fn(i) on n threads behind a barrier; re-raise the first error."""
+    outs = [None] * n
+    errs = []
+    barrier = threading.Barrier(n)
+
+    def worker(i):
+        try:
+            barrier.wait()
+            outs[i] = fn(i)
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+    return outs
+
+
+@pytest.mark.serving
+@pytest.mark.parametrize("size", [1, BUCKET - 1, BUCKET, BUCKET + 1])
+def test_batched_transform_bitwise_equals_solo(mesh8, data, pca_arrays, size):
+    """8 concurrent clients, one model: coalesced dispatch, every client
+    gets bits identical to the scheduler-off daemon's answer. Sizes
+    straddle a bucket boundary so both the within-bucket and the
+    next-bucket-up paths are exercised."""
+    slices = [data[i * size:(i + 1) * size] for i in range(8)]
+    with DataPlaneDaemon(mesh=mesh8) as solo:
+        with DataPlaneClient(*solo.address) as c:
+            c.ensure_model("m", "pca", pca_arrays)
+            ref = [c.transform("m", s)["output"] for s in slices]
+    daemon, close = _batched_daemon(mesh8)
+    try:
+        host, port = daemon.address
+        with DataPlaneClient(host, port) as c0:
+            c0.ensure_model("m", "pca", pca_arrays)
+
+        def one(i):
+            with DataPlaneClient(host, port) as c:
+                return c.transform("m", slices[i])["output"]
+
+        metrics_mod.reset()
+        outs = _concurrent(8, one)
+        snap = metrics_mod.snapshot()
+    finally:
+        close()
+    for i in range(8):
+        assert np.array_equal(outs[i], ref[i]), (
+            f"client {i} (size {size}) batched != solo"
+        )
+    # The scheduler actually coalesced: fewer batches than requests.
+    batches = snap["srml_scheduler_batches_total"]["samples"][0]["value"]
+    served = snap["srml_scheduler_batched_requests_total"]["samples"][0]["value"]
+    assert served == 8
+    assert batches < 8
+
+
+@pytest.mark.serving
+def test_batched_kneighbors_bitwise_equals_solo(mesh8, rng):
+    """Same exactness contract for the KNN serving path, queries batched
+    across connections (sizes straddling the first bucket)."""
+    db = rng.normal(size=(200, D))
+    queries = rng.normal(size=(40, D))
+    sizes = [1, BUCKET - 1, BUCKET, BUCKET + 1]
+    offs = np.cumsum([0] + sizes)
+    slices = [queries[offs[i]:offs[i + 1]] for i in range(len(sizes))]
+
+    def build(daemon):
+        with DataPlaneClient(*daemon.address) as c:
+            c.feed("knn-job", db, algo="knn", params={"k": 5})
+            c.finalize_knn("knn-job", register_as="idx", mode="exact")
+
+    with DataPlaneDaemon(mesh=mesh8) as solo:
+        build(solo)
+        with DataPlaneClient(*solo.address) as c:
+            ref = [c.kneighbors("idx", s, k=5) for s in slices]
+    daemon, close = _batched_daemon(mesh8)
+    try:
+        host, port = daemon.address
+        build(daemon)
+
+        def one(i):
+            # Client 0 omits k: the daemon resolves it to the fitted
+            # k=5, so it co-batches with (and answers identically to)
+            # the explicit-k callers.
+            with DataPlaneClient(host, port) as c:
+                return c.kneighbors("idx", slices[i], k=None if i == 0 else 5)
+
+        outs = _concurrent(len(sizes), one)
+    finally:
+        close()
+    for i in range(len(sizes)):
+        assert np.array_equal(outs[i][0], ref[i][0]), f"distances {i} differ"
+        assert np.array_equal(outs[i][1], ref[i][1]), f"indices {i} differ"
+
+
+@pytest.mark.serving
+def test_warmup_bounds_recompiles_to_the_ladder(mesh8, data, pca_arrays, rng):
+    """After a warmup, the compile ledger holds exactly the ladder; a
+    storm of random-sized concurrent requests adds ZERO new shapes —
+    the acceptance claim that jit recompiles are bounded by the bucket
+    ladder, asserted via the recompile counter."""
+    daemon, close = _batched_daemon(mesh8)
+    try:
+        host, port = daemon.address
+        metrics_mod.reset()
+        with DataPlaneClient(host, port) as c:
+            c.ensure_model("m", "pca", pca_arrays)
+            info = c.warmup("m", n_cols=D, dtype="float64")
+        assert info["enabled"] is True
+        assert info["buckets"] == [8, 32, 128]
+        assert info["compiled"] == 3
+        misses = metrics_mod.REGISTRY.counter(
+            "srml_scheduler_compile_misses_total"
+        )
+        assert misses.value(op="transform") == 3.0
+        sizes = rng.integers(1, 129, size=12)
+
+        def one(i):
+            with DataPlaneClient(host, port) as c:
+                return c.transform("m", data[: int(sizes[i])])["output"]
+
+        _concurrent(12, one)
+        # Every post-warmup dispatch reused a warmed shape.
+        assert misses.value(op="transform") == 3.0
+        hits = metrics_mod.REGISTRY.counter(
+            "srml_scheduler_compile_hits_total"
+        )
+        assert hits.value(op="transform") >= 1.0
+    finally:
+        close()
+
+
+def test_warmup_without_scheduler_is_honest_noop(mesh8, pca_arrays):
+    with DataPlaneDaemon(mesh=mesh8) as daemon:
+        with DataPlaneClient(*daemon.address) as c:
+            c.ensure_model("m", "pca", pca_arrays)
+            info = c.warmup("m", n_cols=D)
+            assert info == {"enabled": False, "buckets": [], "compiled": 0}
+            with pytest.raises(RuntimeError, match="no such model"):
+                c.warmup("ghost", n_cols=D)
+
+
+def test_health_reports_scheduler_state(mesh8, pca_arrays, data):
+    daemon, close = _batched_daemon(mesh8)
+    try:
+        with DataPlaneClient(*daemon.address) as c:
+            sched = c.health()["scheduler"]
+            assert sched["enabled"] is True
+            assert sched["buckets"] == [8, 32, 128]
+            assert sched["queued"] == 0
+            c.ensure_model("m", "pca", pca_arrays)
+            c.transform("m", data[:5])
+            sched = c.health()["scheduler"]
+            assert sched["batches"] >= 1
+            # Drained queues are pruned: health lists only models with
+            # queued work, so the map stays bounded under model churn.
+            assert sched["models"] == {}
+    finally:
+        close()
+    with DataPlaneDaemon(mesh=mesh8) as plain:
+        with DataPlaneClient(*plain.address) as c:
+            assert c.health()["scheduler"] == {"enabled": False}
+
+
+class _StubServed:
+    """Scheduler-unit stand-in for _ServedModel: row-wise transform with
+    a configurable service time (no device, no daemon)."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def transform(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return {"output": np.asarray(x) * 2.0}
+
+
+@pytest.mark.serving
+def test_admission_queue_overflow_sheds(monkeypatch):
+    """A per-model queue bounded at 2 under a slow model: a 10-thread
+    burst must shed some requests with SchedulerBusy (reason
+    queue_full) while every admitted one completes correctly."""
+    served = _StubServed(delay_s=0.05)
+    sched = RequestScheduler(
+        window_ms=1.0, max_batch_rows=64, buckets=(8, 32),
+        queue_depth=2, retry_after_s=0.01,
+    ).start()
+    try:
+        metrics_mod.reset()
+        results, sheds = [], []
+
+        def one(i):
+            x = np.full((4, 3), float(i))
+            try:
+                results.append((i, sched.submit("m", served, "transform", x)))
+            except SchedulerBusy as e:
+                sheds.append(e)
+
+        _concurrent(10, one)
+        assert sheds, "no request was shed at queue_depth=2 under a burst"
+        assert results, "every request shed — admission is over-eager"
+        for i, out in results:
+            np.testing.assert_array_equal(out["output"], np.full((4, 3), 2.0 * i))
+        shed_counter = metrics_mod.REGISTRY.counter(
+            "srml_scheduler_sheds_total"
+        )
+        assert shed_counter.value(op="transform", reason="queue_full") == len(sheds)
+    finally:
+        sched.stop()
+
+
+@pytest.mark.serving
+def test_admission_deadline_sheds_after_ewma_primes():
+    """Once a batch has trained the service-time estimate, a request
+    whose deadline the backlog would already miss is shed immediately
+    (reason deadline) instead of expiring in the queue."""
+    served = _StubServed(delay_s=0.05)
+    sched = RequestScheduler(
+        window_ms=1.0, max_batch_rows=64, buckets=(8, 32),
+        queue_depth=64, retry_after_s=0.01,
+    ).start()
+    try:
+        x = np.ones((2, 3))
+        # No estimate yet: a tiny deadline is admitted (never shed blind).
+        # The FIRST dispatch of a shape carries the jit compile and is
+        # excluded from the estimator — a compile-poisoned estimate
+        # would shed every deadline request forever (the EWMA only
+        # updates on a dispatch, so it could never decay back down).
+        sched.submit("m", served, "transform", x, deadline_s=1e-9)
+        sched.submit("m", served, "transform", x, deadline_s=1e-9)
+        with pytest.raises(SchedulerBusy, match="deadline"):
+            sched.submit("m", served, "transform", x, deadline_s=1e-9)
+        # A generous deadline still passes.
+        out = sched.submit("m", served, "transform", x, deadline_s=30.0)
+        np.testing.assert_array_equal(out["output"], x * 2.0)
+    finally:
+        sched.stop()
+
+
+@pytest.mark.serving
+def test_drained_queue_releases_served_reference():
+    """The scheduler must not pin a served model past its last queued
+    request: once the queue drains, the registry's LRU/TTL eviction is
+    the only owner left — verified with a weakref across a gc."""
+    import gc
+    import weakref
+
+    served = _StubServed()
+    ref = weakref.ref(served)
+    sched = RequestScheduler(
+        window_ms=1.0, max_batch_rows=64, buckets=(8, 32),
+        queue_depth=8, retry_after_s=0.01,
+    ).start()
+    try:
+        out = sched.submit("m", served, "transform", np.ones((2, 3)))
+        np.testing.assert_array_equal(out["output"], np.ones((2, 3)) * 2.0)
+        with sched._cv:
+            assert sched._served == {} and sched._queues == {}
+        del served, out
+        gc.collect()
+        assert ref() is None, "scheduler still pins the served model"
+    finally:
+        sched.stop()
+
+
+@pytest.mark.serving
+@pytest.mark.parametrize(
+    "max_rows,expect",
+    [
+        (32, [8, 32]),    # cap ON a bucket: everything above is dead
+        (100, [8, 32]),   # cap BETWEEN buckets floors to 32 — a batch
+                          # can never pad past the cap into bucket 128
+        (4, [8]),         # cap below the smallest bucket: batches of
+                          # ≤4 rows still pad to (and need) bucket 8
+    ],
+)
+def test_warmup_compiles_only_the_reachable_ladder(max_rows, expect):
+    """Warmup compiles exactly the buckets the coalescing cap can
+    reach; the cap itself floors to a bucket boundary so no coalesced
+    batch dispatches at an un-warmed (or over-cap) shape."""
+    served = _StubServed()
+    sched = RequestScheduler(
+        window_ms=1.0, max_batch_rows=max_rows, buckets=(8, 32, 128),
+        queue_depth=8, retry_after_s=0.01,
+    ).start()
+    try:
+        info = sched.warmup("m", served, n_cols=3)
+        assert info == {"buckets": expect, "compiled": len(expect)}
+        assert sched._bucket_for(sched._cap_rows) == expect[-1]
+    finally:
+        sched.stop()
+
+
+@pytest.mark.serving
+@pytest.mark.chaos
+def test_scheduler_fault_site_sheds_and_retries_to_exact_results(
+    mesh8, data, pca_arrays
+):
+    """Seeded chaos at the daemon.scheduler site: the first submissions
+    are shed as busy; the self-healing client honors retry_after_s and
+    the retried results are EXACT — a scheduler fault costs latency,
+    never correctness."""
+    with DataPlaneDaemon(mesh=mesh8) as solo:
+        with DataPlaneClient(*solo.address) as c:
+            c.ensure_model("m", "pca", pca_arrays)
+            ref = [c.transform("m", data[i * 5:(i + 1) * 5])["output"]
+                   for i in range(4)]
+    daemon, close = _batched_daemon(mesh8)
+    try:
+        host, port = daemon.address
+        with DataPlaneClient(host, port) as c0:
+            c0.ensure_model("m", "pca", pca_arrays)
+        plan = faults.FaultPlan(seed=11).rule(
+            "daemon.scheduler", "drop", times=3
+        )
+        with faults.active(plan):
+
+            def one(i):
+                with DataPlaneClient(host, port) as c:
+                    out = c.transform("m", data[i * 5:(i + 1) * 5])["output"]
+                    return out, dict(c.stats)
+
+            outs = _concurrent(4, one)
+        assert plan.fired.get("daemon.scheduler", 0) >= 1
+        assert sum(s["busy_waits"] for _, s in outs) >= 1
+    finally:
+        close()
+    for i in range(4):
+        assert np.array_equal(outs[i][0], ref[i]), f"retried result {i} drifted"
+
+
+def test_oversized_request_bypasses_the_scheduler(mesh8, data, pca_arrays):
+    """A request above the top bucket is served solo (it is already a
+    full device dispatch) and counted as a bypass — exact either way."""
+    daemon, close = _batched_daemon(mesh8)
+    try:
+        metrics_mod.reset()
+        with DataPlaneClient(*daemon.address) as c:
+            c.ensure_model("m", "pca", pca_arrays)
+            out = c.transform("m", data[:300])["output"]  # > top bucket 128
+        assert out.shape == (300, 3)
+        bypass = metrics_mod.REGISTRY.counter("srml_scheduler_bypass_total")
+        assert bypass.value(op="transform") == 1.0
+    finally:
+        close()
+
+
+def test_model_registry_lru_cap_evicts_recreatable_first(mesh8, pca_arrays):
+    """daemon_max_models bounds the served-model registry: the least-
+    recently-touched re-creatable registration is evicted (counted under
+    reason=lru), newest and recently-touched ones survive."""
+    metrics_mod.reset()
+    with DataPlaneDaemon(mesh=mesh8, max_models=2) as daemon:
+        with DataPlaneClient(*daemon.address) as c:
+            c.ensure_model("a", "pca", pca_arrays)
+            c.ensure_model("b", "pca", pca_arrays)
+            # Touch "a" so "b" is the LRU when "c" lands.
+            assert c.model_exists("a")
+            c.ensure_model("a", "pca", pca_arrays)
+            c.ensure_model("c", "pca", pca_arrays)
+            assert c.model_exists("a")
+            assert c.model_exists("c")
+            assert not c.model_exists("b")
+    evictions = metrics_mod.REGISTRY.counter(
+        "srml_daemon_model_evictions_total"
+    )
+    assert evictions.value(reason="lru") == 1.0
+
+
+def test_top_renders_scheduler_panel():
+    """The tools.top scheduler panel: occupancy quantiles, waste ratio,
+    compile hits/misses — rendered from a health + snapshot pair, absent
+    on an unbatched daemon."""
+    from spark_rapids_ml_tpu.tools.top import render
+
+    health = {
+        "id": "abc", "uptime_s": 5.0, "queue_depth": 1,
+        "staged_bytes": 0, "active_jobs": 0, "served_models": 1,
+        "scheduler": {
+            "enabled": True, "window_ms": 2.0, "max_batch_rows": 4096,
+            "buckets": [8, 32], "queue_depth_cap": 256, "queued": 3,
+            "models": {"m": 3}, "batches": 7,
+        },
+    }
+    snap = {
+        "srml_scheduler_batch_rows": {"type": "histogram", "samples": [{
+            "labels": {"op": "transform"},
+            "buckets": {"1": 0, "2": 1, "4": 4, "8": 7, "+Inf": 7},
+            "sum": 30.0, "count": 7,
+        }]},
+        "srml_scheduler_batched_requests_total": {"type": "counter", "samples": [
+            {"labels": {"op": "transform"}, "value": 20.0}
+        ]},
+        "srml_scheduler_padded_rows_total": {"type": "counter", "samples": [
+            {"labels": {"op": "transform"}, "value": 10.0}
+        ]},
+        "srml_scheduler_compile_misses_total": {"type": "counter", "samples": [
+            {"labels": {"op": "transform"}, "value": 2.0}
+        ]},
+        "srml_scheduler_compile_hits_total": {"type": "counter", "samples": [
+            {"labels": {"op": "transform"}, "value": 5.0}
+        ]},
+    }
+    body = render(health, snap)
+    assert "scheduler" in body
+    assert "m:3" in body  # per-model queue depth
+    assert "batches 7" in body
+    assert "2/5" in body.replace(" ", "")  # miss/hit
+    # waste = 10 / (10 + 30) = 25%
+    assert "25%" in body
+    plain = render({"id": "abc", "scheduler": {"enabled": False}}, {})
+    assert "scheduler" not in plain.splitlines()[-1]
