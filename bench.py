@@ -120,7 +120,9 @@ def main() -> None:
         mesh, compute_dtype="bfloat16", accum_dtype="float32"
     )
 
-    @jax.jit
+    from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
+
+    @ledgered_jit("bench.eig_finalize")
     def finalize(count, colsum, g):
         g, mean = gram_ops.finalize_gram(count, colsum, g, mean_center=True)
         return pca_from_gram_randomized(g, K)
@@ -151,8 +153,15 @@ def main() -> None:
             pc, ev, _ = finalize(*state)
             return jax.device_get((pc, ev))  # (d, k) + (k,) — tiny
 
+    from spark_rapids_ml_tpu.utils import xprof
+
     fit(2)  # warmup / compile
+    # The warmup's ledger snapshot is the COMPILE story (every jit in the
+    # fit compiles exactly here); the post-reset snapshot is the steady
+    # state, where any compile at all is a storm tools/perfcheck.py flags.
+    xla_warmup = _ledger_breakdown(xprof.snapshot())
     metrics.reset()  # the recorded snapshot covers ONLY the timed fit
+    xprof.reset()
 
     t0 = time.perf_counter()
     pc, ev = fit(N_BATCHES)
@@ -166,6 +175,11 @@ def main() -> None:
         "unit": "rows/s/chip",
         "vs_baseline": round(rows_per_sec_per_chip / A100_CUML_ROWS_PER_SEC, 4),
         "metrics": _metrics_breakdown(metrics.snapshot()),
+        "xla": {
+            "warmup": xla_warmup,
+            "steady": _ledger_breakdown(xprof.snapshot()),
+            "device_timing": bool(config.get("device_timing")),
+        },
     }
     if os.environ.get("SRML_BENCH_INGEST", "") in ("1", "true"):
         line.update(_ingest_inclusive(update))
@@ -188,6 +202,35 @@ def _metrics_breakdown(snap: dict) -> dict:
         "phases": phases,
         "fed_bytes": int(fed[0]["value"]) if fed else 0,
     }
+
+
+def _ledger_breakdown(snap: dict) -> dict:
+    """Jit-ledger snapshot (utils/xprof.py) → the per-fn device-cost
+    attribution each BENCH record embeds: compile s vs execute s, model
+    flops/bytes (XLA cost analysis), achieved flops/s and bytes/s in
+    SRML_DEVICE_TIMING runs. This is the breakdown tools/perfcheck.py
+    gates on — a regression record says WHICH jit slowed or started
+    compile-storming, not just that the headline moved."""
+    out = {}
+    for fn, a in snap.items():
+        out[fn] = {
+            "calls": a["calls"],
+            "compiles": a["compiles"],
+            "compile_s": round(a["compile_s"], 4),
+            "cache_misses": a["cache_misses"],
+            "execute_s": round(a["execute_s"], 4),
+            "flops": sum(
+                r["flops"] * r["calls"]
+                for r in a["signatures"] if r["flops"] is not None
+            ),
+            "bytes": sum(
+                r["bytes_accessed"] * r["calls"]
+                for r in a["signatures"] if r["bytes_accessed"] is not None
+            ),
+            "flops_per_s": a["flops_per_s"],
+            "bytes_per_s": a["bytes_per_s"],
+        }
+    return out
 
 
 def _ingest_inclusive(update):

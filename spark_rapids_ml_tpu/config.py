@@ -117,6 +117,14 @@ _DEFAULTS: Dict[str, Any] = {
     # no I/O). Env key is SRML_RUN_JOURNAL — deployment-facing like
     # SRML_DAEMON_ADDRESS / SRML_FAULT_PLAN, hence no SRML_TPU_ prefix.
     "run_journal": os.environ.get("SRML_RUN_JOURNAL") or None,
+    # Jit-ledger device timing mode (utils/xprof.py): every ledgered jit
+    # call is bracketed with block_until_ready so per-call execution
+    # wall-clock (and thus achieved flops/s and bytes/s) is measurable.
+    # OFF by default — it serializes async dispatch, a measurement mode,
+    # not a production state. Env key is SRML_DEVICE_TIMING:
+    # deployment-facing (an operator flips it on a live daemon host to
+    # diagnose), hence no SRML_TPU_ prefix.
+    "device_timing": _env_named("SRML_DEVICE_TIMING", False, _as_bool),
     # Use Pallas kernels for hot ops (Gram, pairwise distance) on TPU.
     # "auto" (default) = on iff the backend is a real TPU (the per-kernel
     # shape/dtype gates still apply — see _pallas_backend_ok and friends).

@@ -55,6 +55,7 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 
 class KMeansSolution(NamedTuple):
@@ -284,7 +285,7 @@ def _lloyd_fn(
         # pallas_call outputs carry no VMA annotation (same as ops/gram.py).
         check_vma=False,
     )
-    return jax.jit(f)
+    return ledgered_jit("kmeans.lloyd", f)
 
 
 def fit_kmeans(
@@ -386,7 +387,7 @@ def _stream_step_fn(mesh: Mesh, k: int, cd: str, ad: str):
         out_specs=(P(), P(), P()),
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(ledgered_jit, "kmeans.streaming_update", donate_argnums=(0,))
     def update(state, centers, x, mask):
         return f(state[0], state[1], state[2], centers, x, mask)
 
@@ -701,7 +702,7 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
             centers_dev = jnp.asarray(self.centers, dtype=jnp.dtype(key[0]))
             accum = jnp.dtype(key[1])
 
-            @jax.jit
+            @ledgered_jit("kmeans.predict")
             def predict(x):
                 d2 = sq_euclidean(x.astype(centers_dev.dtype), centers_dev, accum_dtype=accum)
                 return jnp.argmin(d2, axis=1).astype(jnp.int32)

@@ -55,6 +55,7 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 
 # ---------------------------------------------------------------------------
@@ -116,7 +117,7 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
         out_specs=(P(), P()),
         check_vma=False,  # gathered candidates are value-replicated
     )
-    return jax.jit(f)
+    return ledgered_jit("knn.exact_topk", f)
 
 
 # APPEND-ONLY: ANN model payloads persist the fit metric as an ordinal into
@@ -141,7 +142,13 @@ def merge_topk(
     shard smaller than k contributes all its rows). ``descending`` for
     similarity metrics (inner_product). Invalid entries (id −1, distance
     +inf ascending / −inf descending) sort last; ties break toward the
-    smaller row id."""
+    smaller row id.
+
+    Merged distances come back in the shards' common dtype (f32 shards →
+    f32 out, the single-daemon dtype — ADVICE r5(c)): the merge itself
+    runs in f64 only so that comparisons are exact, and the selected
+    values are bit-identical to the shard's own answer after the cast."""
+    out_dtype = np.result_type(*[np.asarray(d).dtype for d in dists])
     D = np.concatenate([np.asarray(d, np.float64) for d in dists], axis=1)
     I = np.concatenate([np.asarray(i, np.int64) for i in ids], axis=1)
     if D.shape[1] < k:
@@ -153,7 +160,10 @@ def merge_topk(
     # Row-wise lexsort: last key is primary (distance), id breaks ties;
     # a shard's not-found tail (±inf) keys sort past every real candidate.
     order = np.lexsort((I, key), axis=-1)[:, :k]
-    return np.take_along_axis(D, order, axis=1), np.take_along_axis(I, order, axis=1)
+    return (
+        np.take_along_axis(D, order, axis=1).astype(out_dtype, copy=False),
+        np.take_along_axis(I, order, axis=1),
+    )
 
 
 def _normalized_rows(
@@ -443,6 +453,7 @@ def build_ivf_flat(
     mesh: Optional[Mesh] = None,
     train_rows: int = 2_000_000,
     centroids: Optional[np.ndarray] = None,
+    train_data: Optional[np.ndarray] = None,
 ) -> IVFFlatIndex:
     """Train the coarse quantizer and bucket the database into padded lists.
 
@@ -461,6 +472,14 @@ def build_ivf_flat(
     candidate set). The provided quantizer is FROZEN: capacity balancing
     may still spill rows to their next-nearest list, but never recenters —
     recentering would diverge the shards' quantizers.
+
+    ``train_data``: an explicit quantizer training set that REPLACES the
+    local sample — the cross-shard fix for sharded builds (ADVICE
+    r5(b)): training on this shard's rows alone skews the shared
+    centroids toward whatever locality-sticky routing parked here, so
+    the driver samples every daemon (``sample_rows`` op) and hands the
+    union to the quantizer-owning build. Ignored when ``centroids`` is
+    given (a pretrained quantizer never retrains).
     """
     from spark_rapids_ml_tpu.models.kmeans import fit_kmeans
 
@@ -479,16 +498,28 @@ def build_ivf_flat(
                 f"train_rows = {train_rows} must be >= nlist = {nlist} "
                 f"(the quantizer needs at least one training row per list)"
             )
-        if x.shape[0] > train_rows:
+        pool = x if train_data is None else np.asarray(train_data, x.dtype)
+        if train_data is not None:
+            if pool.ndim != 2 or pool.shape[1] != x.shape[1]:
+                raise ValueError(
+                    f"train_data shape {pool.shape} does not match the "
+                    f"database width {x.shape[1]}"
+                )
+            if pool.shape[0] < nlist:
+                raise ValueError(
+                    f"train_data has {pool.shape[0]} rows < nlist = "
+                    f"{nlist} (one training row per list minimum)"
+                )
+        if pool.shape[0] > train_rows:
             # shuffle=False: Floyd's O(train_rows) sampling — the default
             # shuffles a full O(n) permutation, ~800 MB at 100M rows, for an
             # ordering k-means training doesn't care about.
             pick = np.random.default_rng(seed).choice(
-                x.shape[0], train_rows, replace=False, shuffle=False
+                pool.shape[0], train_rows, replace=False, shuffle=False
             )
-            sample = x[pick]
+            sample = pool[pick]
         else:
-            sample = x
+            sample = pool
         sol = fit_kmeans(
             sample, k=nlist, max_iter=10, seed=seed, init="random", mesh=mesh
         )
@@ -500,12 +531,12 @@ def build_ivf_flat(
     T = min(_IVF_SPILL_CANDIDATES, nlist)
     cdev = jnp.asarray(centroids, jnp.float32)
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_assign")
     def _argmin_chunk(chunk, cdev):
         d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
         return jnp.argmin(d2, axis=1).astype(jnp.int32)
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_candidates")
     def _cand_chunk(chunk, cdev):
         d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
         # approx_min_k, not top_k: exact top-k lowers to a full per-row
@@ -524,7 +555,7 @@ def build_ivf_flat(
             out[i : i + step] = np.asarray(fn(chunk, cdev))
         return out
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_recenter")
     def _recenter_chunk(xc, ac, sums, cnt):
         onehot = jax.nn.one_hot(ac, nlist, dtype=jnp.bfloat16)
         sums = sums + jax.lax.dot_general(
@@ -593,6 +624,7 @@ def build_ivf_flat_device(
     seed: int = 0,
     train_rows: int = 2_000_000,
     centroids=None,
+    train_data=None,
 ) -> IVFFlatIndex:
     """Device-side IVF-Flat build for data already resident on device.
 
@@ -623,16 +655,25 @@ def build_ivf_flat_device(
                 f"pretrained centroids shape {centroids.shape} != ({nlist}, {d})"
             )
     else:
-        n_train = min(n, train_rows)
+        # train_data: explicit cross-shard training set (see
+        # build_ivf_flat — ADVICE r5(b)); replaces the local sample.
+        pool = x if train_data is None else jnp.asarray(train_data, jnp.float32)
+        if train_data is not None and (pool.ndim != 2 or pool.shape[1] != d):
+            raise ValueError(
+                f"train_data shape {pool.shape} does not match the "
+                f"database width {d}"
+            )
+        n_pool = pool.shape[0]
+        n_train = min(n_pool, train_rows)
         if n_train < nlist:
             raise ValueError(
                 f"effective train rows = {n_train} must be >= nlist = {nlist} "
                 f"(the quantizer needs at least one training row per list)"
             )
         sample = (
-            x[jax.random.choice(k_samp, n, (n_train,), replace=False)]
-            if n > train_rows
-            else x
+            pool[jax.random.choice(k_samp, n_pool, (n_train,), replace=False)]
+            if n_pool > train_rows
+            else pool
         )
         centers0 = sample[
             jax.random.choice(k_init, n_train, (nlist,), replace=False)
@@ -648,12 +689,12 @@ def build_ivf_flat_device(
 
     T = min(_IVF_SPILL_CANDIDATES, nlist)
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_assign")
     def _argmin_chunk(chunk, centroids):
         d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
         return jnp.argmin(d2, axis=1).astype(jnp.int32)
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_candidates")
     def _cand_chunk(chunk, centroids):
         d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
         # approx_min_k (not top_k: that is a full per-row sort — minutes
@@ -679,7 +720,7 @@ def build_ivf_flat_device(
             else fn(x, centroids)
         )
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_recenter")
     def _recenter_chunk(xc, ac, sums, cnt):
         # One-hot MXU matmul, not scatter-add: the (chunk, nlist) one-hot
         # GEMM is milliseconds where a 1M-row scatter is minutes.
@@ -731,7 +772,9 @@ def build_ivf_flat_device(
     else:
         maxlen = max(natural_max, 1)  # static for the jit below
 
-    @functools.partial(jax.jit, static_argnames=("maxlen",))
+    @functools.partial(
+        ledgered_jit, "knn.ivf_bucketize", static_argnames=("maxlen",)
+    )
     def _bucketize(x, assign, counts, key, maxlen):
         # Same sort-based scatter as the host build, including the random
         # tiebreak shuffle that spreads near-neighbors across row slots.
@@ -1261,7 +1304,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
     accum_dtype = jnp.dtype(ad)
     LIST_BLOCK = 32
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_query_dense")
     def query_dense(centroids, lists, list_ids, list_mask, queries):
         q = queries.shape[0]
         nlist, maxlen, d = lists.shape
@@ -1323,7 +1366,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         (dists, ids), _ = jax.lax.scan(body, init, jnp.arange(nblk))
         return dists, ids
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_probe")
     def probe_bucketed(centroids, queries):
         # Fused probe kernel (same gate family as the scan kernel): f32
         # centroid GEMM + EXACT packed-key top-nprobe per query in one
@@ -1371,7 +1414,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
         probe_d2, probe = jax.lax.approx_min_k(cd2, nprobe, recall_target=0.95)
         return probe.astype(jnp.int32), probe_d2
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_query_bucketed")
     def core_bucketed(queries, probe, probe_d2, centroids, lists, list_ids,
                       list_mask, n_valid, resid_norms, lists_lo):
         q = queries.shape[0]
@@ -1399,7 +1442,7 @@ def _ivf_query_fn(k: int, nprobe: int, cd: str, ad: str, mode: str = "auto",
             _debug_stage=_debug_stage,
         )
 
-    @jax.jit
+    @ledgered_jit("knn.ivf_probe_trivial")
     def _probe_trivial(centroids, queries):
         # Profiling stand-in for probe_bucketed (_debug_stage="dispatch"):
         # data-dependent but ~zero compute, so the two-jit pipeline's
@@ -1541,7 +1584,7 @@ def _ivf_query_fn_sharded(
         out_specs=(P(), P()),
         check_vma=False,  # gathered candidates are value-replicated
     )
-    jitted = jax.jit(f)
+    jitted = ledgered_jit("knn.ivf_query_sharded", f)
 
     def query(centroids, lists, list_ids, list_mask, queries,
               n_valid=None, resid_norms=None, lists_lo=None):

@@ -50,6 +50,7 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 
 class LinearRegressionTrainingSummary(NamedTuple):
@@ -138,7 +139,7 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str, use_pallas: Optional[bool]
         out_specs=(P(), P(), P(), P(), P(), P()),
         check_vma=False,  # pallas_call out_shapes carry no vma annotation
     )
-    return jax.jit(f)
+    return ledgered_jit("linreg.normal_eq_stats", f)
 
 
 def init_normal_eq_stats(n_cols: int, accum_dtype=None):
@@ -180,7 +181,7 @@ def _streaming_normal_eq_update(mesh: Mesh, cd: str, ad: str, use_pallas: bool =
     # long-lived daemon.
     stats = _normal_eq_stats_fn(mesh, cd, ad, use_pallas)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(ledgered_jit, "linreg.streaming_update", donate_argnums=(0,))
     def update(state, x, y, mask):
         part = stats(x, y, mask)
         return tuple(s + p for s, p in zip(state, part))
@@ -267,7 +268,7 @@ def _solve_fn(
             intercept = jnp.zeros((), a.dtype)
         return w, intercept
 
-    return jax.jit(solve)
+    return ledgered_jit("linreg.solve", solve)
 
 
 def fit_linear_regression(
@@ -484,7 +485,7 @@ class LinearRegressionModel(Model, _LinearRegressionParams, MLWritable, MLReadab
             accum = jnp.dtype(key[1])
             b = float(self.intercept)
 
-            @jax.jit
+            @ledgered_jit("linreg.predict")
             def predict(x):
                 with mm_precision(w_dev.dtype):
                     z = jax.lax.dot_general(

@@ -48,6 +48,7 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 
 class LogisticTrainingSummary(NamedTuple):
@@ -154,6 +155,54 @@ def _pallas_newton_applicable(shape, cd, ad, use_pallas: Optional[bool] = None) 
     )
 
 
+def _solve_newton_system(h_ww, h_wb, h_bb, grad_w, grad_b, reg, fit_intercept,
+                         accum):
+    """Direct solve of the (optionally bordered) Newton system → (dw, db).
+
+    reg > 0: h_ww is symmetric PD — block elimination with LU solves,
+    kept bit-identical to the historical path. reg == 0: the Hessian is
+    only PSD — collinear/one-hot/constant columns make h_ww singular,
+    and one-hot features plus an intercept add a shift-invariance null
+    direction that lives in the BORDERED [w; b] system (its Schur
+    complement is exactly 0), so flooring h_ww alone still lets the
+    intercept step blow up (ADVICE r5(a) — the multinomial finding; the
+    binomial Newton shares the failure class). Floor the diagonal of the
+    whole system being solved: the floor must clear the accumulation
+    noise of the summed statistics — measured negative eigenvalues reach
+    a few ulps of the trace — so scale machine epsilon by a 1e3 margin.
+    Still a minimum-norm-direction tiebreak, orders of magnitude below
+    any statistically meaningful curvature."""
+    d = h_ww.shape[0]
+    if reg > 0.0:
+        if fit_intercept:
+            hinv_hwb = jnp.linalg.solve(h_ww, h_wb)
+            hinv_gw = jnp.linalg.solve(h_ww, grad_w)
+            schur = jnp.maximum(h_bb - h_wb @ hinv_hwb, 1e-12)
+            db = (grad_b - h_wb @ hinv_gw) / schur
+            dw = hinv_gw - hinv_hwb * db
+            return dw, db
+        return jnp.linalg.solve(h_ww, grad_w), jnp.zeros((), accum)
+    noise = 1e3 * jnp.finfo(accum).eps
+    if fit_intercept:
+        joint = jnp.concatenate([
+            jnp.concatenate([h_ww, h_wb[:, None]], axis=1),
+            jnp.concatenate([h_wb, h_bb[None]])[None, :],
+        ])
+        eps = noise * jnp.trace(joint) / (d + 1) + 1e-12
+        cho = jax.scipy.linalg.cho_factor(
+            joint + eps * jnp.eye(d + 1, dtype=accum), lower=True
+        )
+        sol = jax.scipy.linalg.cho_solve(
+            cho, jnp.concatenate([grad_w, grad_b[None]])
+        )
+        return sol[:d], sol[d]
+    eps = noise * jnp.trace(h_ww) / d + 1e-12
+    cho = jax.scipy.linalg.cho_factor(
+        h_ww + eps * jnp.eye(d, dtype=accum), lower=True
+    )
+    return jax.scipy.linalg.cho_solve(cho, grad_w), jnp.zeros((), accum)
+
+
 def _newton_fn(mesh: Mesh, reg: float, fit_intercept: bool, max_iter: int, tol: float, ad: str):
     # use_pallas / compute_dtype are read at build time so they participate
     # in the cache key (same snapshot pattern as ops/gram._streaming_update).
@@ -242,31 +291,40 @@ def _newton_fn_cached(
         def body(carry):
             w, b, _, it, prev_dir = carry
             grad_w, grad_b, h_ww, h_wb, h_bb = grad_hess(w, b)
-            if direct_solve and fit_intercept:
-                # Bordered (d+1) system via block elimination:
+            if direct_solve:
+                # Bordered (d+1) system via block elimination (reg > 0)
+                # or floored joint Cholesky (reg == 0, singular-safe):
                 # [H_ww h_wb][dw]   [g_w]
                 # [h_wbᵀ h_bb][db] = [g_b]
-                hinv_hwb = jnp.linalg.solve(h_ww, h_wb)
-                hinv_gw = jnp.linalg.solve(h_ww, grad_w)
-                schur = jnp.maximum(h_bb - h_wb @ hinv_hwb, 1e-12)
-                db = (grad_b - h_wb @ hinv_gw) / schur
-                dw = hinv_gw - hinv_hwb * db
-                sol = jnp.concatenate([dw, db[None]])
-            elif direct_solve:
-                dw = jnp.linalg.solve(h_ww, grad_w)
-                db = jnp.zeros((), accum)
-                sol = dw
+                dw, db = _solve_newton_system(
+                    h_ww, h_wb, h_bb, grad_w, grad_b, reg, fit_intercept,
+                    accum,
+                )
+                sol = jnp.concatenate([dw, db[None]]) if fit_intercept else dw
             elif fit_intercept:
-                # The same bordered SPD system, solved whole by CG.
+                # The same bordered SPD system, solved whole by CG. At
+                # reg == 0 it is only PSD (the same null directions the
+                # direct path floors — _solve_newton_system): floor the
+                # diagonal identically, or CG diverges along the null
+                # space on exactly the inputs the Cholesky path survives.
                 hfull = jnp.pad(h_ww, ((0, 1), (0, 1)))
                 hfull = (
                     hfull.at[d, :d].set(h_wb).at[:d, d].set(h_wb).at[d, d].set(h_bb)
                 )
+                if reg <= 0.0:
+                    eps = (1e3 * jnp.finfo(accum).eps
+                           * jnp.trace(hfull) / (d + 1) + 1e-12)
+                    hfull = hfull + eps * jnp.eye(d + 1, dtype=accum)
                 gfull = jnp.concatenate([grad_w, grad_b[None]])
                 sol = _pcg_solve(hfull, gfull, prev_dir)
                 dw, db = sol[:d], sol[d]
             else:
-                sol = _pcg_solve(h_ww, grad_w, prev_dir)
+                hmat = h_ww
+                if reg <= 0.0:
+                    eps = (1e3 * jnp.finfo(accum).eps
+                           * jnp.trace(h_ww) / d + 1e-12)
+                    hmat = h_ww + eps * jnp.eye(d, dtype=accum)
+                sol = _pcg_solve(hmat, grad_w, prev_dir)
                 dw, db = sol, jnp.zeros((), accum)
             new_w = w - dw
             new_b = b - db
@@ -307,7 +365,7 @@ def _newton_fn_cached(
         out_specs=(P(), P(), P(), P()),
         check_vma=False,  # pallas_call out_shapes carry no vma annotation
     )
-    return jax.jit(f)
+    return ledgered_jit("logreg.newton_stats", f)
 
 
 def fit_logistic_regression(
@@ -446,7 +504,7 @@ def _stream_grad_hess_fn(mesh: Mesh, ad: str):
         out_specs=(P(),) * 7,
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(ledgered_jit, "logreg.streaming_update", donate_argnums=(0,))
     def update(state, w, b, x, y, mask):
         return f(*state, w, b, x, y, mask)
 
@@ -466,21 +524,15 @@ def _stream_newton_step_fn(reg: float, fit_intercept: bool, ad: str):
         h_ww = hww / n + reg * jnp.eye(d, dtype=accum)
         h_wb = hwb / n
         h_bb = hbb / n
-        if fit_intercept:
-            # Bordered (d+1) system via block elimination — same math as
-            # the in-memory _newton_fn body.
-            hinv_hwb = jnp.linalg.solve(h_ww, h_wb)
-            hinv_gw = jnp.linalg.solve(h_ww, grad_w)
-            schur = jnp.maximum(h_bb - h_wb @ hinv_hwb, 1e-12)
-            db = (grad_b - h_wb @ hinv_gw) / schur
-            dw = hinv_gw - hinv_hwb * db
-        else:
-            dw = jnp.linalg.solve(h_ww, grad_w)
-            db = jnp.zeros((), accum)
+        # Block elimination (reg > 0) or floored joint Cholesky (reg ==
+        # 0, singular-safe) — same math as the in-memory _newton_fn body.
+        dw, db = _solve_newton_system(
+            h_ww, h_wb, h_bb, grad_w, grad_b, reg, fit_intercept, accum
+        )
         delta = jnp.sqrt(jnp.sum(dw * dw) + db * db)
         return w - dw, b - db, delta
 
-    return jax.jit(step)
+    return ledgered_jit("logreg.newton_step", step)
 
 
 def _stream_softmax_stats_fn(mesh: Mesh, n_classes: int, ad: str):
@@ -625,7 +677,7 @@ def _stream_softmax_stats_cached(
         check_vma=False,  # pallas_call out_shapes carry no vma annotation
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(ledgered_jit, "logreg.softmax_streaming_update", donate_argnums=(0,))
     def update(state, W, b, x, y, mask):
         return f(*state, W, b, x, y, mask)
 
@@ -649,22 +701,31 @@ def _stream_multinomial_step_fn(reg: float, fit_intercept: bool, ad: str):
         h_bb = hbb / n  # (C,)
 
         def solve_c(hww_c, hwb_c, hbb_c, gwc, gbc):
-            # h_ww is Xᵀdiag(p)X/n + reg·I — symmetric PD (PSD + the MM
-            # floor; n ≫ d in every streaming fit): ONE Cholesky per class
-            # with both right-hand sides back-substituted together, where
-            # two jnp.linalg.solve calls paid two LU factorizations
-            # (measured 35.9 → ~9 ms for the C=32, d=1024 step).
-            cho = jax.scipy.linalg.cho_factor(hww_c, lower=True)
-            if fit_intercept:
-                sol = jax.scipy.linalg.cho_solve(
-                    cho, jnp.stack([hwb_c, gwc], axis=1)
+            # h_ww is Xᵀdiag(p)X/n + reg·I — symmetric PD when reg > 0:
+            # ONE Cholesky per class with both right-hand sides
+            # back-substituted together, where two jnp.linalg.solve calls
+            # paid two LU factorizations (measured 35.9 → ~9 ms for the
+            # C=32, d=1024 step).
+            if reg > 0.0:
+                cho = jax.scipy.linalg.cho_factor(hww_c, lower=True)
+                if fit_intercept:
+                    sol = jax.scipy.linalg.cho_solve(
+                        cho, jnp.stack([hwb_c, gwc], axis=1)
+                    )
+                    hinv_hwb, hinv_gw = sol[:, 0], sol[:, 1]
+                    schur = jnp.maximum(hbb_c - hwb_c @ hinv_hwb, 1e-12)
+                    db = (gbc - hwb_c @ hinv_gw) / schur
+                    dw = hinv_gw - hinv_hwb * db
+                    return dw, db
+                return (
+                    jax.scipy.linalg.cho_solve(cho, gwc),
+                    jnp.zeros((), accum),
                 )
-                hinv_hwb, hinv_gw = sol[:, 0], sol[:, 1]
-                schur = jnp.maximum(hbb_c - hwb_c @ hinv_hwb, 1e-12)
-                db = (gbc - hwb_c @ hinv_gw) / schur
-                dw = hinv_gw - hinv_hwb * db
-                return dw, db
-            return jax.scipy.linalg.cho_solve(cho, gwc), jnp.zeros((), accum)
+            # reg == 0: only PSD — the floored singular-safe solve
+            # (_solve_newton_system; ADVICE r5(a)).
+            return _solve_newton_system(
+                hww_c, hwb_c, hbb_c, gwc, gbc, reg, fit_intercept, accum
+            )
 
         dw, db = jax.vmap(solve_c)(h_w, h_wb, h_bb, grad_w.T, grad_b)
         new_W = W - dw.T
@@ -672,7 +733,7 @@ def _stream_multinomial_step_fn(reg: float, fit_intercept: bool, ad: str):
         delta = jnp.sqrt(jnp.sum(dw * dw) + jnp.sum(db * db))
         return new_W, new_b, delta
 
-    return jax.jit(step)
+    return ledgered_jit("logreg.softmax_newton_step", step)
 
 
 def stream_softmax_zero_state(n_cols: int, n_classes: int, accum_dtype) -> tuple:
@@ -1166,7 +1227,7 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
             w_dev = jnp.asarray(W, dtype=cd)
             b_dev = jnp.asarray(np.atleast_1d(self.intercept), accum)
 
-            @jax.jit
+            @ledgered_jit("logreg.raw_scores")
             def raw(x):
                 with mm_precision(cd):
                     z = jax.lax.dot_general(

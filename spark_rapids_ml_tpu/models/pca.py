@@ -58,6 +58,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding, shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 
 class PCASolution(NamedTuple):
@@ -152,7 +153,7 @@ def _fit_fn(
             pc, ev, s = pca_from_gram(g, k)
         return pc, ev, s, mean, count
 
-    return jax.jit(fit)
+    return ledgered_jit("pca.fit", fit)
 
 
 _SOLVERS = ("full", "randomized")
@@ -360,7 +361,8 @@ def finalize_pca_stats(
             finalize_fn = (
                 pca_from_gram_randomized if solver == "randomized" else pca_from_gram
             )
-            finalize = jax.jit(
+            finalize = ledgered_jit(
+                "pca.finalize",
                 lambda c, cs, gg: finalize_fn(
                     gram_ops.finalize_gram(c, cs, gg, mean_center)[0], k
                 )
@@ -539,7 +541,7 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
 
             from spark_rapids_ml_tpu.ops.gram import mm_precision
 
-            @jax.jit
+            @ledgered_jit("pca.project")
             def project(x):
                 with mm_precision(pc_dev.dtype):
                     return jax.lax.dot_general(

@@ -40,6 +40,7 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 
 @functools.lru_cache(maxsize=32)
@@ -64,7 +65,7 @@ def _moments_fn(mesh: Mesh, ad: str):
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(), P(), P()),
     )
-    return jax.jit(f)
+    return ledgered_jit("scaler.stats", f)
 
 
 class _ScalerParams(HasInputCol, HasOutputCol):

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_rapids_ml_tpu import config
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 Stats = Tuple[jax.Array, jax.Array, jax.Array]  # (count, colsum, gram)
 
@@ -149,7 +150,7 @@ def sharded_stats(mesh: Mesh, compute_dtype=None, accum_dtype=None):
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(), P(), P()),
     )
-    return jax.jit(f)
+    return ledgered_jit("gram.sharded_stats", f)
 
 
 def _stats_shard_2d(x, mask, compute_dtype, accum_dtype):
@@ -189,7 +190,7 @@ def sharded_stats_2d(mesh: Mesh, compute_dtype=None, accum_dtype=None):
         # all_gather, which VMA inference can't prove statically.
         check_vma=False,
     )
-    return jax.jit(f)
+    return ledgered_jit("gram.sharded_stats_2d", f)
 
 
 def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
@@ -255,7 +256,7 @@ def sharded_stats_ring(mesh: Mesh, compute_dtype=None, accum_dtype=None):
         out_specs=(P(), P(), P(MODEL_AXIS, None)),
         check_vma=False,
     )
-    return jax.jit(f)
+    return ledgered_jit("gram.sharded_stats_ring", f)
 
 
 def streaming_update(mesh: Mesh, compute_dtype=None, accum_dtype=None):
@@ -292,7 +293,7 @@ def _streaming_update_cached(mesh: Mesh, compute_dtype, accum_dtype, use_pallas:
         out_specs=(P(), P(), P()),
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(ledgered_jit, "gram.streaming_update", donate_argnums=(0,))
     def update(state, x, mask):
         return f(state[0], state[1], state[2], x, mask)
 
@@ -387,7 +388,9 @@ def _streaming_update_rows_cached(
         check_vma=False,
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(
+        ledgered_jit, "gram.streaming_update_rows", donate_argnums=(0,)
+    )
     def update(state, x, n_valid):
         return f(state[0], state[1], state[2], x, jnp.asarray(n_valid, jnp.int32))
 

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
+
 
 def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
@@ -70,7 +72,9 @@ def _gram_kernel(x_i_ref, x_j_ref, mask_ref, o_ref):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.gram_pallas", static_argnames=("block_n", "block_d", "interpret")
+)
 def gram_pallas(
     x: jax.Array,
     mask: jax.Array,
@@ -146,7 +150,9 @@ def _gram_colsum_kernel(nvalid_ref, x_ref, g_ref, cs_ref, *, block_n):
         cs_ref[:] += jnp.sum(xb.astype(jnp.float32), axis=0, keepdims=True)
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.gram_colsum_pallas", static_argnames=("block_n", "interpret")
+)
 def gram_colsum_pallas(
     x: jax.Array,
     n_valid: jax.Array,
@@ -257,7 +263,9 @@ LLOYD_PAD_D2 = 1e30  # finite sentinel: padded centers never win the argmin
 LLOYD_STEP_BLOCK_N = 4096
 
 
-@functools.partial(jax.jit, static_argnames=("k", "block_n", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.lloyd_step_pallas", static_argnames=("k", "block_n", "interpret")
+)
 def lloyd_step_pallas(
     x: jax.Array,
     centers: jax.Array,
@@ -392,7 +400,9 @@ def _newton_stats_kernel(b_ref, x_ref, y_ref, m_ref, w_ref, gw_ref, h_ref, s_ref
     )
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.newton_stats_pallas", static_argnames=("block_n", "interpret")
+)
 def newton_stats_pallas(
     x: jax.Array,
     y: jax.Array,
@@ -500,7 +510,9 @@ def _assign_kernel(x_ref, c_ref, c2_ref, best_d_ref, best_i_ref):
     best_d_ref[:] = jnp.where(improved, local_best, best_d_ref[:])
 
 
-@functools.partial(jax.jit, static_argnames=("block_m", "block_k", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.assign_min_dist_pallas", static_argnames=("block_m", "block_k", "interpret")
+)
 def assign_min_dist_pallas(
     x: jax.Array,
     centers: jax.Array,
@@ -643,7 +655,9 @@ def _ivf_scan_select_kernel(
     _packed_extract(_packed_keys(scores, pos_bits), d_ref, p_ref, blk_k, pos_bits)
 
 
-@functools.partial(jax.jit, static_argnames=("blk_k", "keep_pad", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.ivf_scan_select_pallas", static_argnames=("blk_k", "keep_pad", "interpret")
+)
 def ivf_scan_select_pallas(
     qv: jax.Array,
     rows: jax.Array,
@@ -765,7 +779,9 @@ def _probe_select_kernel(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("nprobe", "block_q", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.probe_select_pallas", static_argnames=("nprobe", "block_q", "interpret")
+)
 def probe_select_pallas(
     centroids: jax.Array,
     queries: jax.Array,
@@ -914,7 +930,9 @@ def _softmax_curv_kernel(x_ref, p_ref, hw_ref, hwb_ref, *, block_c):
         )
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "block_c", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.softmax_curvature_pallas", static_argnames=("block_n", "block_c", "interpret")
+)
 def softmax_curvature_pallas(
     x: jax.Array,
     p: jax.Array,
@@ -987,7 +1005,9 @@ def softmax_curvature_pallas(
     return jnp.concatenate(hw_parts), jnp.concatenate(hwb_parts)
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+@functools.partial(
+    ledgered_jit, "pallas.linreg_stats_pallas", static_argnames=("block_n", "interpret")
+)
 def linreg_stats_pallas(
     x: jax.Array,
     y: jax.Array,

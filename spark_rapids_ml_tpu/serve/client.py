@@ -34,6 +34,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import journal
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 from spark_rapids_ml_tpu.utils.retry import decorrelated_jitter
@@ -103,12 +104,22 @@ class DataPlaneClient:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         max_busy_wait_s: float = 60.0,
+        trace_ctx: Optional[Dict[str, str]] = None,
     ):
         """``timeout`` bounds one socket syscall; ``op_deadline_s`` bounds
         one whole op including every reconnect/replay/busy-wait (None =
         attempts alone bound it); ``max_op_attempts`` counts connection
         failures per op; ``max_busy_wait_s`` caps cumulative busy-shed
-        waiting per op when no deadline is set."""
+        waiting per op when no deadline is set.
+
+        ``trace_ctx``: a fixed ``{"run", "span"}`` distributed-tracing
+        context stamped on every request (additive wire field,
+        docs/protocol.md) — how an executor-side client, whose process
+        never opened the driver's journal run, still parents the
+        daemon's spans into it. None (default): each op stamps the
+        calling thread's CURRENT journal frame, so driver-side clients
+        trace for free; with the journal off nothing is stamped and the
+        wire bytes are exactly the pre-tracing ones."""
         self._addr = (host, int(port))
         self._timeout = timeout
         self._token = token
@@ -118,6 +129,7 @@ class DataPlaneClient:
         self._backoff_base = backoff_base_s
         self._backoff_max = backoff_max_s
         self._max_busy_wait = max_busy_wait_s
+        self._trace_ctx = trace_ctx
         self._rng = random.Random()
         # Feed/step idempotency nonce: replayed ops carry the same id, so
         # the daemon can discard a duplicate whose first ack was lost.
@@ -249,6 +261,13 @@ class DataPlaneClient:
         want_arrays: bool = False,
     ):
         """Run one op through the self-healing loop (module docstring)."""
+        # Distributed tracing (additive): stamp the op with the fixed
+        # ctor context or the calling thread's current journal frame.
+        # Stamped ONCE per op, outside the retry loop, so a replayed
+        # request carries the same ctx as its first attempt.
+        tc = self._trace_ctx or journal.trace_ctx()
+        if tc:
+            req = {**req, "trace_ctx": tc}
         start = time.monotonic()
         deadline = None if self._op_deadline is None else start + self._op_deadline
         attempt = 0
@@ -623,6 +642,18 @@ class DataPlaneClient:
         )
         return int(resp["rows"])
 
+    def sample_rows(self, job: str, n: int, seed: int = 0) -> np.ndarray:
+        """Seeded uniform sample of a knn job's committed rows (additive
+        op; read-only). The cross-daemon quantizer-training primitive:
+        the driver samples every daemon's shard in proportion to its
+        rows and hands the union to the quantizer-owning IVF build, so
+        shared centroids cover the whole dataset (ADVICE r5(b))."""
+        _, arrays = self._op(
+            {"op": "sample_rows", "job": job, "n": int(n), "seed": int(seed)},
+            want_arrays=True,
+        )
+        return arrays["rows"]
+
     def get_iterate(self, job: str) -> Tuple[Dict[str, np.ndarray], int]:
         """(iterate arrays, iteration) of an iterative job — kmeans
         {"centers"}; logreg {"w", "b"}."""
@@ -758,6 +789,7 @@ class DataPlaneClient:
         row_id_base: Optional[Dict[Any, int]] = None,
         centroids: Optional[np.ndarray] = None,
         return_centroids: bool = False,
+        train_rows_sample: Optional[np.ndarray] = None,
     ) -> Dict[str, np.ndarray]:
         """Build the index from a knn job's accumulated rows ON the daemon
         and register it as ``register_as`` for :meth:`kneighbors` serving.
@@ -768,7 +800,9 @@ class DataPlaneClient:
         this daemon committed to its global row base (served ids become
         global partition-major positions); ``centroids`` ships a shared
         pretrained quantizer; ``return_centroids`` asks the build to hand
-        its trained quantizer back (the driver forwards it to the peers).
+        its trained quantizer back (the driver forwards it to the peers);
+        ``train_rows_sample`` ships an explicit quantizer training set
+        (the driver's cross-shard ``sample_rows`` union — ADVICE r5(b)).
         """
         params: Dict[str, Any] = {
             "mode": mode, "register_as": register_as, "seed": seed,
@@ -782,11 +816,12 @@ class DataPlaneClient:
             params["row_id_base"] = {str(p): int(b) for p, b in row_id_base.items()}
         if return_centroids:
             params["return_centroids"] = True
-        arrays, _ = self.finalize(
-            job, params,
-            arrays=None if centroids is None
-            else {"centroids": np.asarray(centroids, np.float32)},
-        )
+        extra: Dict[str, np.ndarray] = {}
+        if centroids is not None:
+            extra["centroids"] = np.asarray(centroids, np.float32)
+        if train_rows_sample is not None:
+            extra["train_rows"] = np.asarray(train_rows_sample)
+        arrays, _ = self.finalize(job, params, arrays=extra or None)
         return arrays
 
     def kneighbors(

@@ -65,6 +65,7 @@ incarnation — the recovery unit is the pass, replayed by the estimator.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import hmac
 import json
@@ -86,6 +87,7 @@ from spark_rapids_ml_tpu.parallel.sharding import row_sharding
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.serve import scheduler as scheduler_mod
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import journal
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
@@ -183,13 +185,42 @@ _KNOWN_OPS = frozenset((
     "ping", "health", "metrics", "status", "feed", "feed_raw", "seed",
     "commit", "step", "finalize", "drop", "export_state", "merge_state",
     "get_iterate", "set_iterate", "ensure_model", "transform",
-    "kneighbors", "model_status", "drop_model", "warmup",
+    "kneighbors", "model_status", "drop_model", "warmup", "sample_rows",
 ))
 
 
 def _op_label(op) -> str:
     op = str(op)
     return op if op in _KNOWN_OPS else "unknown"
+
+
+#: Ops that never open a journal span even when the journal is on: O(1)
+#: control-plane chatter (liveness probes, scrapes) that would bury the
+#: fit tree under polling noise.
+_UNJOURNALED_OPS = frozenset(("ping", "health", "metrics", "model_status"))
+
+
+@contextlib.contextmanager
+def _op_trace(op: str, req: Dict[str, Any]):
+    """Distributed-tracing shell around one dispatched op: adopt the
+    request's additive ``trace_ctx`` (docs/protocol.md) so this
+    connection thread's journal lines — the op span opened here plus
+    every ``trace_span`` the op's model code runs — parent into the
+    CALLER's run. One fit then journals a single tree spanning driver +
+    executors + N daemons, mergeable by ``tools/trace.py``. Without a
+    ctx the span roots itself (the PR 3 standalone-daemon behavior);
+    with the journal off everything here is an early return."""
+    tc = req.get("trace_ctx")
+    tc = tc if isinstance(tc, dict) else {}
+    with journal.adopt(tc.get("run"), tc.get("span")):
+        if op not in _UNJOURNALED_OPS and journal.enabled():
+            fields = {
+                k: req[k] for k in ("job", "model") if req.get(k) is not None
+            }
+            with journal.span(f"daemon.{op}", **fields):
+                yield
+        else:
+            yield
 
 
 #: Cap on a request's declared raw-array frame count (_recv_arrays_aligned):
@@ -933,6 +964,50 @@ class _Job:
             self.touched = self._clock()  # exit stamp (device_get can be slow)
             return arrays, meta
 
+    def sample_rows(self, n: int, seed: int = 0) -> np.ndarray:
+        """Seeded uniform sample of this knn job's COMMITTED rows
+        (read-only; the job keeps accumulating). The cross-daemon
+        quantizer-training op: a sharded IVF fit samples EVERY daemon's
+        shard in proportion to its rows, so the shared quantizer's
+        centroids cover the whole dataset instead of whichever slice
+        locality-sticky routing parked on the primary (ADVICE r5(b))."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            if self.algo != "knn":
+                raise ValueError(
+                    "sample_rows is a knn-job op (other algos hold O(d²) "
+                    "statistics, not rows)"
+                )
+            self.touched = self._clock()
+            blocks = list(self.state)
+            for pid in sorted(self.part_rows):
+                blocks.extend(self.part_rows[pid])
+            total = sum(b.shape[0] for b in blocks)
+            if total == 0:
+                raise ValueError("sample_rows before any committed feed")
+            if int(n) <= 0:
+                raise ValueError(f"sample_rows n must be positive, got {n}")
+            n = min(int(n), total)
+            # shuffle=False: Floyd's O(n) sampling (same rationale as
+            # build_ivf_flat's training pick).
+            pick = np.sort(
+                np.random.default_rng(int(seed)).choice(
+                    total, n, replace=False, shuffle=False
+                )
+            )
+            out = np.empty((n, blocks[0].shape[1]), blocks[0].dtype)
+            base = 0
+            taken = 0
+            for b in blocks:
+                hi = base + b.shape[0]
+                j = np.searchsorted(pick, hi, side="left")
+                if j > taken:
+                    out[taken:j] = b[pick[taken:j] - base]
+                    taken = j
+                base = hi
+            return out
+
     def merge_remote(
         self, arrays: Dict[str, np.ndarray], rows: int,
         merge_id: Optional[str] = None,
@@ -1158,6 +1233,11 @@ class _Job:
           (trained by the first daemon's build, O(nlist·d) on the wire) —
           every daemon buckets against identical centroids, making the
           union of per-daemon probes equal the single-index candidate set.
+        * ``extra_arrays["train_rows"]``: an explicit quantizer training
+          set — the driver's cross-shard sample (``sample_rows`` op per
+          daemon, ADVICE r5(b)) so the trained quantizer covers the WHOLE
+          dataset, not just the shard this daemon happens to hold.
+          Ignored when ``centroids`` is supplied (nothing trains).
         * ``params["return_centroids"]``: ship the quantizer back in the
           info arrays (what the driver forwards to the peer builds).
         """
@@ -1227,6 +1307,13 @@ class _Job:
                 cent_in = extra_arrays.get("centroids")
                 if cent_in is not None:
                     cent_in = np.asarray(cent_in, np.float32)
+                train_in = extra_arrays.get("train_rows")
+                if train_in is not None:
+                    train_in = np.asarray(train_in)
+                    if metric == "cosine":
+                        # Train in the same embedded space the index rows
+                        # were just normalized into.
+                        train_in = _normalized_rows(train_in, zero_slot=0)
                 # Build-path choice (docs/ann-capacity.md): the device
                 # build materializes the FULL (n, d) matrix on one chip —
                 # fast, but capped by single-chip HBM. Past the cap
@@ -1241,12 +1328,13 @@ class _Job:
                     if build == "device" or (build == "auto" and device_ok):
                         index = build_ivf_flat_device(
                             jnp.asarray(rows), nlist=nlist, seed=seed,
-                            centroids=cent_in,
+                            centroids=cent_in, train_data=train_in,
                         )
                     elif build in ("host", "auto"):
                         index = build_ivf_flat(rows, nlist=nlist, seed=seed,
                                                mesh=self.mesh,
-                                               centroids=cent_in)
+                                               centroids=cent_in,
+                                               train_data=train_in)
                     else:
                         raise ValueError(
                             f"unknown build {build!r} (auto|device|host)"
@@ -1966,7 +2054,8 @@ class DataPlaneDaemon:
                 t0 = time.perf_counter()
                 outcome = "ok"
                 try:
-                    self._dispatch(conn, req)
+                    with _op_trace(op, req):
+                        self._dispatch(conn, req)
                 except (ConnectionError, TimeoutError):
                     # A transport-level failure (peer died mid-frame,
                     # injected drop) means the CONNECTION is broken, not
@@ -2092,6 +2181,14 @@ class DataPlaneDaemon:
             job = self._get_job(req)
             arrays, meta = job.export_state()
             _send_arrays_counted(conn, "export_state", arrays, {"ok": True, **meta})
+        elif op == "sample_rows":
+            job = self._get_job(req)
+            rows = job.sample_rows(
+                int(_opt(req, "n", 1024)), int(_opt(req, "seed", 0) or 0)
+            )
+            _send_arrays_counted(
+                conn, "sample_rows", {"rows": rows}, {"ok": True}
+            )
         elif op == "merge_state":
             self._op_merge_state(conn, req)
         elif op == "get_iterate":

@@ -155,6 +155,12 @@ class _FeedTask:
         self.job, self.algo = job, algo
         self.input_col, self.label_col = input_col, label_col
         self.params, self.pass_id = params, pass_id
+        # Distributed tracing: the driver's journal frame at task
+        # construction rides the closure to the executor, whose client
+        # stamps it on every wire op — the daemon's spans then parent
+        # into THIS fit's run even though the executor process never
+        # opened it (docs/protocol.md "trace_ctx").
+        self.trace_ctx = journal.trace_ctx()
 
     def __call__(self, batches):
         import pyarrow as pa
@@ -168,7 +174,9 @@ class _FeedTask:
         # client_kwargs(): executor-env resilience tuning — per-op healing
         # deadline, socket timeout — so a daemon hiccup or busy-shed is
         # absorbed by the client before it ever costs a Spark task retry.
-        with DataPlaneClient(h, p, token=self.token, **ds.client_kwargs()) as c:
+        with DataPlaneClient(h, p, token=self.token,
+                             trace_ctx=self.trace_ctx,
+                             **ds.client_kwargs()) as c:
             # The daemon's self-reported identity: the driver keys its
             # merge/reconcile on this, never on the address spelling (an
             # alias of the primary must not look like a peer).
@@ -513,10 +521,18 @@ class _SparkAdapter:
         name = f"knnidx-{job}"
         # Primary first (deterministic quantizer owner), then peers by id.
         daemon_ids = sorted(fed, key=lambda d: (d != primary_id, d))
+        # The concurrent shard builds/samples below run on POOL threads,
+        # whose journal stack is empty — capture the driver's fit frame
+        # here so their clients still stamp it (trace_ctx ctor arg) and
+        # the daemons' heaviest spans (index builds, sampling) parent
+        # into the fit tree instead of orphaning.
+        fit_ctx = journal.trace_ctx()
 
-        def _finalize_shard(did, centroids=None, first=False):
+        def _finalize_shard(did, centroids=None, first=False,
+                            train_rows_sample=None):
             ah, ap = daemon_session._parse_addr(addr_of[did])
-            with DataPlaneClient(ah, ap, token=token, **ckw) as client:
+            with DataPlaneClient(ah, ap, token=token, trace_ctx=fit_ctx,
+                                 **ckw) as client:
                 if ivf:
                     info = client.finalize_knn(
                         job, register_as=name, mode="ivf",
@@ -525,6 +541,7 @@ class _SparkAdapter:
                         row_id_base=id_base if multi else None,
                         centroids=centroids,
                         return_centroids=multi and first,
+                        train_rows_sample=train_rows_sample,
                     )
                 else:
                     info = client.finalize_knn(
@@ -546,12 +563,52 @@ class _SparkAdapter:
 
             with trace_span("knn build"):
                 if ivf and multi:
+                    # The quantizer owner must not train on its OWN shard
+                    # alone: locality-sticky routing makes that shard a
+                    # non-random slice, skewing the shared centroids away
+                    # from the peers' regions (ADVICE r5(b)). Sample every
+                    # daemon in proportion to its committed rows and hand
+                    # the union to the owning build — O(sample·d) on the
+                    # wire, never the dataset.
+                    with trace_span("quantizer sample"):
+                        want = min(
+                            total, max(64 * core.getNlist(), 4096), 65536
+                        )
+
+                        def _sample_shard(i, did):
+                            # Ceil split: the union never rounds below
+                            # ``want`` (the build's >= nlist floor).
+                            n_d = (want * fed[did] + total - 1) // total
+                            ah, ap = daemon_session._parse_addr(addr_of[did])
+                            with DataPlaneClient(
+                                ah, ap, token=token, trace_ctx=fit_ctx,
+                                **ckw
+                            ) as dc:
+                                return dc.sample_rows(
+                                    job, n_d, seed=core.getSeed() + i
+                                )
+
+                        # Independent per-daemon reads: pay the max RTT,
+                        # not the sum (same pattern as the peer builds
+                        # below). Ordered futures keep the union — and
+                        # therefore the trained quantizer — deterministic.
+                        with ThreadPoolExecutor(
+                            max_workers=min(len(daemon_ids), 16)
+                        ) as ex:
+                            futs = [
+                                ex.submit(_sample_shard, i, did)
+                                for i, did in enumerate(daemon_ids)
+                            ]
+                            train_sample = np.concatenate(
+                                [f.result() for f in futs], axis=0
+                            )
                     # The first build is the quantizer owner — it must run
                     # before the peers; the peers' dataset-sized builds are
                     # then independent and run CONCURRENTLY (fit wall-clock =
                     # first + max of the rest, not the sum over daemons).
                     first_info, first_shard = _finalize_shard(
-                        daemon_ids[0], first=True
+                        daemon_ids[0], first=True,
+                        train_rows_sample=train_sample,
                     )
                     shards.append(first_shard)
                     cent = first_info["centroids"]

@@ -1,0 +1,208 @@
+"""Perf regression gate: a fresh BENCH record vs the recorded trajectory.
+
+Five rounds of BENCH_r*.json gave the repo a throughput history; this
+tool makes that history a GATE instead of a graph. It compares one fresh
+``bench.py`` record against the trajectory and exits nonzero when:
+
+* **throughput regressed**: the fresh value is more than
+  ``--max-regression`` (default 15%) below the MEDIAN of the matching
+  history records (median, not max: one lucky round must not ratchet the
+  gate above what the hardware repeatably does);
+* **steady-state compile storm**: the record's jit-ledger breakdown
+  (``xla.steady`` — everything after the warmup fit) shows ANY ledgered
+  entry compiling during the timed region. A compile in steady state
+  means a shape leaked into the hot loop; it silently eats device time
+  that the host-side clock attributes to "compute". ``--allow-compile
+  FN`` exempts a named entry (for a PR that knowingly adds a shape).
+
+Only history records whose ``metric`` matches the fresh record's are
+compared (the metric name embeds the workload shape, e.g.
+``..._d2048_k32``): a smoke run at toy shapes gates ONLY on the compile
+storm, with a note that no comparable history exists.
+
+Usage::
+
+    python bench.py > fresh.json
+    python -m spark_rapids_ml_tpu.tools.perfcheck fresh.json \
+        [--history 'BENCH_r*.json'] [--max-regression 0.15]
+
+``-`` reads the fresh record from stdin (pipe bench straight in).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_mod
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_MAX_REGRESSION = 0.15
+
+
+def parse_record(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize either record shape to {metric, value, ...}: the raw
+    ``bench.py`` JSON line, or the driver-side BENCH_r*.json wrapper
+    that nests it under ``parsed``."""
+    if "parsed" in obj and isinstance(obj["parsed"], dict):
+        inner = dict(obj["parsed"])
+        # The wrapper keeps the ledger outside `parsed` on some rounds;
+        # carry whichever copy exists.
+        if "xla" not in inner and isinstance(obj.get("xla"), dict):
+            inner["xla"] = obj["xla"]
+        return inner
+    return obj
+
+
+def load_history(patterns: Iterable[str]) -> List[Dict[str, Any]]:
+    recs = []
+    for pat in patterns:
+        for path in sorted(glob_mod.glob(pat)):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    recs.append(parse_record(json.load(f)))
+            except (OSError, ValueError) as e:
+                print(f"perfcheck: skipping unreadable {path}: {e}",
+                      file=sys.stderr)
+    return recs
+
+
+def _median(values: List[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def check(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+    allow_compiles: Tuple[str, ...] = (),
+) -> Tuple[bool, List[str]]:
+    """(ok, report lines). ``fresh``/``history`` are parse_record output."""
+    lines: List[str] = []
+    ok = True
+
+    metric = fresh.get("metric")
+    value = fresh.get("value")
+    if metric is None or value is None:
+        return False, ["fresh record has no metric/value — not a BENCH "
+                       "record?"]
+    value = float(value)
+    matching = [
+        float(h["value"]) for h in history
+        if h.get("metric") == metric and h.get("value") is not None
+    ]
+    if matching:
+        base = _median(matching)
+        floor = (1.0 - max_regression) * base
+        delta = (value - base) / base if base else 0.0
+        verdict = "OK" if value >= floor else "REGRESSION"
+        lines.append(
+            f"throughput [{verdict}] {metric}: {value:,.1f} vs median "
+            f"{base:,.1f} over {len(matching)} record(s) "
+            f"({delta:+.1%}; gate at -{max_regression:.0%})"
+        )
+        if value < floor:
+            ok = False
+    else:
+        lines.append(
+            f"throughput [SKIP] no history records match metric {metric!r} "
+            f"({len(history)} record(s) examined) — compile gate only"
+        )
+
+    xla = fresh.get("xla")
+    steady = (xla or {}).get("steady")
+    if not isinstance(steady, dict) or not steady:
+        # An EMPTY steady dict means the ledger measured nothing (bench
+        # run with metrics off) — that must read as "not checked", never
+        # as a clean pass.
+        lines.append(
+            "compile storm [SKIP] record embeds no xla.steady ledger "
+            "breakdown (pre-jit-ledger bench, or metrics were off)"
+        )
+        return ok, lines
+    storms = {
+        fn: a for fn, a in steady.items()
+        if a.get("compiles", 0) > 0 and fn not in allow_compiles
+    }
+    if storms:
+        ok = False
+        for fn, a in sorted(storms.items()):
+            lines.append(
+                f"compile storm [FAIL] {fn}: {a['compiles']} steady-state "
+                f"compile(s), {a.get('compile_s', 0.0):.3f}s — a shape "
+                "leaked into the timed hot loop (or pass --allow-compile "
+                f"{fn} with a reason in the PR)"
+            )
+    else:
+        total_warm = sum(
+            a.get("compile_s", 0.0)
+            for a in ((xla or {}).get("warmup") or {}).values()
+        )
+        lines.append(
+            f"compile storm [OK] 0 steady-state compiles across "
+            f"{len(steady)} ledgered fn(s) (warmup compiled "
+            f"{total_warm:.2f}s as expected)"
+        )
+    return ok, lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_ml_tpu.tools.perfcheck",
+        description="Gate a fresh bench.py record against the BENCH_r* "
+        "trajectory.",
+    )
+    ap.add_argument(
+        "record",
+        help="fresh bench.py JSON record (file path, or - for stdin)",
+    )
+    ap.add_argument(
+        "--history", action="append", default=None,
+        metavar="GLOB",
+        help="history record glob(s); default BENCH_r*.json",
+    )
+    ap.add_argument(
+        "--max-regression", type=float, default=DEFAULT_MAX_REGRESSION,
+        help="fail when fresh < (1 - this) x median(history); default 0.15",
+    )
+    ap.add_argument(
+        "--allow-compile", action="append", default=[], metavar="FN",
+        help="exempt a ledgered fn from the steady-state compile gate",
+    )
+    args = ap.parse_args(argv)
+
+    if args.record == "-":
+        raw = sys.stdin.read()
+    else:
+        with open(args.record, "r", encoding="utf-8") as f:
+            raw = f.read()
+    # bench.py prints exactly one JSON line, but a piped run may carry
+    # log noise around it — take the last parseable line.
+    fresh = None
+    for line in raw.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                fresh = parse_record(json.loads(line))
+            except ValueError:
+                continue
+    if fresh is None:
+        print("perfcheck: no JSON record found in input", file=sys.stderr)
+        return 2
+
+    history = load_history(args.history or ["BENCH_r*.json"])
+    ok, lines = check(
+        fresh, history,
+        max_regression=args.max_regression,
+        allow_compiles=tuple(args.allow_compile),
+    )
+    for line in lines:
+        print(line)
+    print("perfcheck:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,10 +16,13 @@ allocation of journal lines", the production state).
 
 Line schema (all events)::
 
-    {"ts": <unix seconds, event START>, "pid": int,
+    {"ts": <unix seconds, event START>, "pid": int, "tid": int,
      "event": "run_start" | "run_end" | "phase" | "mark",
      "run_id": hex, "span_id": hex, "parent_id": hex | null,
      "name": str, ...}
+
+``tid`` (additive) is the OS thread id — ``tools/trace.py`` lays spans
+out on (pid, tid) tracks when emitting Chrome-trace JSON.
 
 ``run_end`` and ``phase`` additionally carry ``duration_s``. Extra
 keyword fields pass through verbatim (estimator class, algo, job name).
@@ -41,7 +44,9 @@ import time
 import uuid
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-__all__ = ["enabled", "run", "span", "mark", "read", "close"]
+__all__ = [
+    "enabled", "run", "span", "mark", "read", "close", "adopt", "trace_ctx",
+]
 
 _lock = threading.Lock()
 _files: Dict[str, Any] = {}  # path -> open append handle
@@ -120,6 +125,7 @@ def _event(
     obj: Dict[str, Any] = {
         "ts": ts,
         "pid": os.getpid(),
+        "tid": threading.get_ident(),
         "event": event,
         "run_id": run_id,
         "span_id": span_id,
@@ -186,6 +192,41 @@ def span(name: str, **fields: Any) -> Iterator[Optional[str]]:
             path, "phase", name, run_id, span_id, parent, ts, fields,
             duration_s=time.perf_counter() - t0,
         )
+
+
+def trace_ctx() -> Optional[Dict[str, str]]:
+    """This thread's innermost open frame as an over-the-wire context:
+    ``{"run": run_id, "span": span_id}``, or None outside any run/span.
+    The data-plane client stamps it on every request (additive
+    ``trace_ctx`` field, docs/protocol.md) and the estimator captures it
+    into executor-side task closures — how one fit's journal lines from
+    driver, executors, and N daemons stitch into a single tree
+    (``tools/trace.py``)."""
+    run_id, span_id = current()
+    if run_id is None:
+        return None
+    return {"run": run_id, "span": span_id}
+
+
+@contextlib.contextmanager
+def adopt(
+    run_id: Optional[str], span_id: Optional[str] = None
+) -> Iterator[None]:
+    """Parent this thread's subsequent spans under a FOREIGN frame — a
+    ``trace_ctx`` that arrived over the wire (daemon side) or through a
+    task closure (executor side). Emits no event itself; spans opened
+    inside the block carry the adopted ``run_id`` and parent to
+    ``span_id``. No-op when ``run_id`` is falsy, so callers can pass a
+    request's (possibly absent) context straight through."""
+    if not run_id:
+        yield
+        return
+    stack = _stack()
+    stack.append((str(run_id), str(span_id) if span_id else None))
+    try:
+        yield
+    finally:
+        stack.pop()
 
 
 def mark(name: str, **fields: Any) -> None:

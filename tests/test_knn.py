@@ -772,3 +772,67 @@ def test_ann_metric_switch_after_load_rejected(rng, tmp_path):
     clone._set(metric="sqeuclidean")
     with pytest.raises(ValueError, match="built under"):
         clone.kneighbors(db[:4])
+
+
+def test_merge_topk_preserves_shard_dtype(rng):
+    """ADVICE r5(c) regression: f32 shard distances must merge to f32 —
+    the single-daemon path returns the query dtype, and a multi-daemon
+    kneighbors answer must not silently widen to f64 (schema-visible to
+    every Spark consumer). The merge still compares exactly (internally
+    f64) and the selected values are bit-identical to the shard's own
+    answer after the cast."""
+    from spark_rapids_ml_tpu.models.knn import merge_topk
+
+    k = 5
+    d_a = rng.random((7, k)).astype(np.float32)
+    d_b = rng.random((7, k)).astype(np.float32)
+    i_a = rng.integers(0, 100, (7, k)).astype(np.int64)
+    i_b = rng.integers(100, 200, (7, k)).astype(np.int64)
+    dists, ids = merge_topk([d_a, d_b], [i_a, i_b], k)
+    assert dists.dtype == np.float32
+    assert ids.dtype == np.int64
+    # Every merged distance is one of the shard values, bit-for-bit.
+    pool = np.concatenate([d_a, d_b], axis=1)
+    for r in range(dists.shape[0]):
+        assert np.isin(dists[r], pool[r]).all()
+    # f64 shards still merge to f64 (dtype follows the shards, not a cast
+    # hardcoded to f32).
+    dists64, _ = merge_topk(
+        [d_a.astype(np.float64), d_b.astype(np.float64)], [i_a, i_b], k
+    )
+    assert dists64.dtype == np.float64
+    np.testing.assert_array_equal(dists64.astype(np.float32), dists)
+
+
+def test_ivf_build_trains_on_explicit_cross_shard_sample(rng):
+    """ADVICE r5(b) unit: ``train_data`` replaces the local sample as the
+    quantizer training pool. A shard whose rows live in region A, handed
+    a training pool that also covers region B, must place centroids in
+    BOTH regions — the cross-daemon fix's core property (under the bug,
+    training on the local shard alone left region B uncovered)."""
+    from spark_rapids_ml_tpu.models.knn import (
+        build_ivf_flat,
+        build_ivf_flat_device,
+    )
+
+    region_a = rng.normal(size=(400, 6)).astype(np.float32)          # ~0
+    region_b = (rng.normal(size=(400, 6)) + 40.0).astype(np.float32)  # ~+40
+    pool = np.concatenate([region_a, region_b])
+
+    for build in (build_ivf_flat, build_ivf_flat_device):
+        index = build(region_a, nlist=8, seed=0, train_data=pool)
+        cent = np.asarray(index.centroids)
+        assert (cent.mean(axis=1) > 20).any(), (
+            f"{build.__name__}: no centroid covers region B — train_data "
+            "pool ignored"
+        )
+        assert (cent.mean(axis=1) < 20).any()  # region A still covered
+        # The DATABASE bucketed is still only this shard's rows.
+        assert int(index.list_mask.sum()) == len(region_a)
+
+    # Validation: a training pool narrower than the database is a hard
+    # error, not a silent mis-shaped quantizer.
+    with pytest.raises(ValueError, match="train_data"):
+        build_ivf_flat(region_a, nlist=8, seed=0, train_data=pool[:, :4])
+    with pytest.raises(ValueError, match="train_data"):
+        build_ivf_flat(region_a, nlist=8, seed=0, train_data=pool[:4])

@@ -232,3 +232,46 @@ def test_no_bare_print_in_package():
     assert offenders == [], (
         "bare print( in library code at: " + ", ".join(offenders)
     )
+
+
+def test_every_jit_in_ops_and_models_is_ledgered():
+    """Every jit entry point in ops/ and models/ must register with the
+    jit ledger (``ledgered_jit(name, ...)`` — utils/xprof.py), the
+    mirror of the hot-path-spanned gate above: a bare ``jax.jit`` is
+    invisible to the device-cost attribution (compile seconds, flops,
+    bytes) that every perf PR is judged with. Also pins ledger-name
+    hygiene: names are ``<area>.<fn>`` and unique ACROSS files — the
+    ledger is process-wide, and a cross-file collision silently merges
+    two unrelated entry points' accounting. Within one file, reuse is
+    deliberate and allowed: knn.py registers the host and device build
+    variants of the same logical op (ivf_assign/candidates/recenter)
+    under one name so their accounting pools."""
+    offenders = []
+    names = {}
+    # Both registration spellings: ledgered_jit("name", ...) and
+    # functools.partial(ledgered_jit, "name", ...).
+    name_re = re.compile(r"ledgered_jit\s*[(,]\s*\n?\s*[\"']([a-z0-9_.]+)[\"']")
+    for sub in ("ops", "models"):
+        for path in sorted((PKG / sub).glob("*.py")):
+            text = path.read_text()
+            for m in re.finditer(r"\bjax\.jit\s*\(", text):
+                line = text[: m.start()].count("\n") + 1
+                offenders.append(f"{path.relative_to(PKG.parent)}:{line}")
+            for m in name_re.finditer(text):
+                name = m.group(1)
+                where = f"{path.name}:{name}"
+                if not re.match(r"^[a-z0-9_]+\.[a-z0-9_]+$", name):
+                    offenders.append(f"{where} (ledger name not <area>.<fn>)")
+                prev = names.setdefault(name, path.name)
+                if prev != path.name:
+                    offenders.append(
+                        f"{where} (ledger name also registered in {prev})"
+                    )
+    assert len(names) >= 35, (
+        f"only {len(names)} ledgered entry points found in ops/ + models/ "
+        "— the registration pattern or this regex regressed"
+    )
+    assert offenders == [], (
+        "unledgered jax.jit (use utils.xprof.ledgered_jit) or bad ledger "
+        "names in ops//models/: " + ", ".join(offenders)
+    )

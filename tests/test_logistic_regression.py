@@ -316,3 +316,99 @@ def test_multinomial_stream_rejects_bad_labels(mesh8, rng):
     y = np.full((100,), 5.0)  # out of range for n_classes=3
     with pytest.raises(ValueError, match="labels"):
         fit_multinomial_stream(_batched(x, y), 4, 3, max_iter=2, mesh=mesh8)
+
+
+def test_multinomial_unregularized_one_hot_features_stay_finite(mesh8, rng):
+    """ADVICE r5(a) regression: regParam=0 with one-hot features makes
+    the per-class MM Hessian singular — one-hot columns plus the
+    intercept add an exact shift-invariance null direction to the
+    bordered [w; b] system, and a duplicated (collinear) or dead column
+    kills h_ww itself. A bare Cholesky then returns NaN coefficients on
+    the second step (the first step's curvature at W=0 is benign; the
+    fitted-probability curvature is not). The floored solve must keep
+    every iterate finite AND still separate the (perfectly predictable)
+    classes."""
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        fit_multinomial_stream,
+    )
+
+    n = 600
+    cat = rng.integers(0, 3, n)
+    x = np.zeros((n, 5), np.float64)
+    x[np.arange(n), cat] = 1.0       # one-hot: rows sum to 1 (= intercept)
+    x[:, 3] = x[:, 0]                # exactly collinear duplicate
+    x[:, 4] = 0.0                    # dead column: zero curvature row/col
+    y = cat.astype(np.float64)
+
+    sol = fit_multinomial_stream(
+        _batched(x, y), 5, 3, reg=0.0, max_iter=50, tol=1e-8, mesh=mesh8
+    )
+    assert np.isfinite(sol.coefficients).all(), "NaN coefficients at reg=0"
+    assert np.isfinite(sol.intercept).all()
+    pred = (x @ sol.coefficients.T + sol.intercept).argmax(axis=1)
+    assert (pred == cat).mean() == 1.0
+
+    # The intercept-free solve floors h_ww alone — same contract.
+    free = fit_multinomial_stream(
+        _batched(x, y), 5, 3, reg=0.0, max_iter=50, tol=1e-8, mesh=mesh8,
+        fit_intercept=False,
+    )
+    assert np.isfinite(free.coefficients).all()
+    assert ((x @ free.coefficients.T).argmax(axis=1) == cat).mean() == 1.0
+
+
+def test_binomial_unregularized_one_hot_features_stay_finite(mesh8, rng):
+    """The binomial Newton shares ADVICE r5(a)'s failure class one
+    function above the multinomial fix: same one-hot ⊕ intercept null
+    direction, same collinear/dead-column h_ww singularity, previously
+    an unfloored LU solve. Both binomial paths (in-memory direct solve,
+    streaming step) must stay finite and separate the classes."""
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        fit_logistic_stream,
+    )
+
+    n = 600
+    cat = rng.integers(0, 3, n)
+    x = np.zeros((n, 5), np.float64)
+    x[np.arange(n), cat] = 1.0
+    x[:, 3] = x[:, 0]
+    x[:, 4] = 0.0
+    y = (cat == 0).astype(np.float64)
+
+    sol = fit_logistic_regression(x, y, reg=0.0, max_iter=30, mesh=mesh8)
+    assert np.isfinite(sol.coefficients).all() and np.isfinite(sol.intercept)
+    pred = x @ sol.coefficients.ravel() + sol.intercept > 0
+    assert (pred == (y > 0.5)).mean() == 1.0
+
+    stream = fit_logistic_stream(
+        _batched(x, y), n_cols=5, reg=0.0, max_iter=30, mesh=mesh8
+    )
+    assert np.isfinite(stream.coefficients).all()
+    pred = x @ stream.coefficients.ravel() + stream.intercept > 0
+    assert (pred == (y > 0.5)).mean() == 1.0
+
+
+def test_binomial_unregularized_one_hot_cg_branch_stays_finite(mesh8, rng):
+    """The accelerator (non-CPU) in-memory Newton solves by CG, not
+    direct factorization — it needs the same reg=0 floor or it diverges
+    along the one-hot ⊕ intercept null direction on exactly the inputs
+    the Cholesky path survives. The branch choice reads
+    jax.default_backend() at closure-build time (a unique max_iter
+    defeats the lru_cache), so mock it to force the CG path on CPU."""
+    from unittest import mock
+
+    import jax
+
+    n = 600
+    cat = rng.integers(0, 3, n)
+    x = np.zeros((n, 5), np.float64)
+    x[np.arange(n), cat] = 1.0
+    x[:, 3] = x[:, 0]
+    x[:, 4] = 0.0
+    y = (cat == 0).astype(np.float64)
+
+    with mock.patch.object(jax, "default_backend", return_value="tpu"):
+        sol = fit_logistic_regression(x, y, reg=0.0, max_iter=29, mesh=mesh8)
+    assert np.isfinite(sol.coefficients).all() and np.isfinite(sol.intercept)
+    pred = x @ sol.coefficients.ravel() + sol.intercept > 0
+    assert (pred == (y > 0.5)).mean() == 1.0

@@ -507,3 +507,45 @@ def test_daemon_ivf_host_build_path(daemon, rng, monkeypatch):
     assert idx.shape == (16, k)
     # self is among the neighbors (exact within probed lists, probe-all)
     assert all(i in set(idx[i]) for i in range(16))
+
+
+def test_sample_rows_op(daemon, rng):
+    """The cross-daemon quantizer-training primitive (ADVICE r5(b)):
+    seeded, deterministic, uniform over every COMMITTED row across
+    partitions, clamped to the committed total — and refused for non-knn
+    jobs (they hold O(d²) statistics, not rows)."""
+    x = rng.normal(size=(300, 6)).astype(np.float64)
+    with _client(daemon) as c:
+        for pid, part in enumerate(np.array_split(x, 3)):
+            c.feed("samp", part, algo="knn", partition=pid)
+            c.commit("samp", partition=pid)
+        s1 = c.sample_rows("samp", 50, seed=7)
+        s2 = c.sample_rows("samp", 50, seed=7)
+        assert s1.shape == (50, 6)
+        np.testing.assert_array_equal(s1, s2)  # seeded replay
+        assert not np.array_equal(s1, c.sample_rows("samp", 50, seed=8))
+        # Every sampled row is one of the committed rows, and distinct
+        # (sampling is without replacement).
+        fed = {row.tobytes() for row in np.asarray(x, s1.dtype)}
+        got = [row.tobytes() for row in s1]
+        assert set(got) <= fed
+        assert len(set(got)) == len(got)
+        # n past the committed total clamps (never errors, never pads).
+        assert c.sample_rows("samp", 10_000, seed=0).shape[0] == 300
+        # Sampling is read-only: the job still finalizes with every row.
+        info = c.finalize_knn("samp", register_as="samp-idx", mode="exact")
+        assert int(info["n_rows"][0]) == 300
+        # Non-knn jobs refuse.
+        c.feed("samp-pca", x, algo="pca")
+        with pytest.raises(RuntimeError, match="knn"):
+            c.sample_rows("samp-pca", 10)
+
+
+def test_sample_rows_rejects_nonpositive_n(daemon, rng):
+    with _client(daemon) as c:
+        c.feed("sampz", rng.normal(size=(32, 4)), algo="knn", partition=0)
+        c.commit("sampz", partition=0)
+        with pytest.raises(RuntimeError, match="positive"):
+            c.sample_rows("sampz", 0)
+        with pytest.raises(RuntimeError, match="positive"):
+            c.sample_rows("sampz", -5)

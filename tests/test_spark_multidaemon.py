@@ -703,3 +703,50 @@ def test_two_daemon_processes_end_to_end(rng, mesh8):
                 proc.wait(timeout=10)
             except Exception:
                 proc.kill()
+
+
+def test_ivf_quantizer_trains_on_cross_daemon_sample(rng, mesh8, two_daemons):
+    """ADVICE r5(b) end-to-end: locality-sticky routing parks ALL of
+    region B on the peer daemon, so the quantizer-owning primary never
+    holds a single region-B row. The shared quantizer must still place
+    centroids in both regions — the driver samples every daemon
+    (``sample_rows``) and ships the union to the owning build. Under the
+    bug (train on the primary's shard alone) region B had no centroid and
+    every B query funneled through the nearest region-A list."""
+    from spark_rapids_ml_tpu.spark.estimator import (
+        SparkApproximateNearestNeighbors,
+    )
+
+    a, b = two_daemons
+    d, nlist, k = 8, 8, 5
+    region_a = rng.normal(size=(240, d))           # around 0
+    region_b = rng.normal(size=(240, d)) + 40.0    # far away
+    # Partition-ordered concat: partitions 0,1 (region A) stay on the
+    # primary, 2,3 (region B) route to the peer via the env plan.
+    x = np.concatenate([region_a, region_b])
+    session, env_plan = _split_session(a, b)
+    split = simdf_from_numpy(x, n_partitions=4, session=session,
+                             env_plan=env_plan)
+    model = (
+        SparkApproximateNearestNeighbors()
+        .setK(k).setNlist(nlist).setNprobe(nlist)
+        .fit(split)
+    )
+    cen_a = np.asarray(a._models[model.daemon_model_name].model.index.centroids)
+    cen_b = np.asarray(b._models[model.daemon_model_name].model.index.centroids)
+    np.testing.assert_array_equal(cen_a, cen_b)  # still ONE shared quantizer
+    covers_b = (cen_a.mean(axis=1) > 20).sum()
+    covers_a = (cen_a.mean(axis=1) < 20).sum()
+    assert covers_b >= 1, (
+        "no centroid covers the peer daemon's region — the quantizer "
+        "trained on the primary's shard alone"
+    )
+    assert covers_a >= 1
+    # Region-B queries resolve to region-B neighbors with sane distances.
+    q = region_b[:16]
+    dists, idx = model.kneighbors(q)
+    assert (idx >= len(region_a)).all(), "B queries matched region-A rows"
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    np.testing.assert_array_equal(np.sort(idx, 1), np.sort(want, 1))
+    model.release()

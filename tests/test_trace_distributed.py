@@ -1,0 +1,272 @@
+"""Distributed tracing: the additive ``trace_ctx`` wire field end-to-end.
+
+The PR 3 journal gave each PROCESS its own span trees; this suite pins
+the cross-process stitch (docs/protocol.md "trace_ctx"): the client
+stamps its innermost journal frame on every request, the daemon adopts
+it around the dispatched op, and one fit — driver + executors + N
+daemons — journals a SINGLE tree that ``tools/trace.py`` merges into a
+Chrome-trace JSON. The flagship here is the acceptance criterion: a
+sparksim two-daemon fit whose daemon-side spans are children of the
+driver's fit span in the merged trace.
+
+The field is additive: a pre-tracing client never sends it (the byte
+streams in tests/fixtures/*.bin replay unchanged — test_protocol_golden
+is the authority), and a daemon receiving it with the journal off does
+nothing.
+"""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.spark import estimator as spark_est
+from spark_rapids_ml_tpu.spark.estimator import SparkPCA
+from spark_rapids_ml_tpu.tools import trace
+from spark_rapids_ml_tpu.utils import journal
+
+from sparksim import SimDataFrame, SimSparkSession, simdf_from_numpy
+
+spark_est.register_dataframe_type(SimDataFrame)
+
+
+@pytest.fixture(autouse=True)
+def _closed_journal():
+    """Every test starts and ends with the journal file handles closed
+    (reads see complete lines; no cross-test handle reuse)."""
+    journal.close()
+    yield
+    journal.close()
+
+
+def _addr(daemon) -> str:
+    return f"{daemon.address[0]}:{daemon.address[1]}"
+
+
+# ---------------------------------------------------------------------------
+# wire-level: the field is additive
+# ---------------------------------------------------------------------------
+
+
+def test_raw_request_with_trace_ctx_is_accepted(mesh8):
+    """A v1 request carrying the additive field is served normally even
+    with the journal off — unknown-to-the-op extra keys must never
+    reject (the additive-field contract every PR 2–5 op relies on)."""
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with socket.create_connection(d.address, timeout=5.0) as s:
+            protocol.send_json(s, {
+                "v": 1, "op": "ping",
+                "trace_ctx": {"run": "ab" * 8, "span": "cd" * 8},
+            })
+            resp = protocol.recv_json(s)
+    assert resp["ok"] is True
+
+
+def test_client_outside_any_run_stamps_nothing(mesh8, tmp_path):
+    """No journal frame → no trace_ctx on the wire → the daemon's op
+    span roots itself (the PR 3 standalone behavior, and the reason the
+    golden transcripts replay byte-identically)."""
+    p = tmp_path / "daemon.jsonl"
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(p)):
+            with DataPlaneClient(*d.address) as c:
+                c.feed("solo", np.ones((8, 3)), algo="pca")
+    journal.close()
+    spans = [e for e in journal.read(str(p)) if e.get("event") == "phase"]
+    ops = [e for e in spans if e["name"] == "daemon.feed"]
+    assert ops, f"daemon.feed span missing from {spans}"
+    assert all(e["parent_id"] is None for e in ops)
+
+
+def test_daemon_op_span_parents_into_the_callers_frame(mesh8, tmp_path):
+    """The core stitch: a client op issued inside a driver-side span
+    lands the daemon's op span (and every model-phase span under it)
+    in the SAME run, parented to the caller's span."""
+    p = tmp_path / "both.jsonl"
+    ids = {}
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(p)):
+            with DataPlaneClient(*d.address) as c:
+                with journal.run("fit") as run_id:
+                    ids["run"] = run_id
+                    with journal.span("feed pass") as span_id:
+                        ids["span"] = span_id
+                        c.feed("job", np.ones((16, 4)), algo="pca")
+    journal.close()
+    events = journal.read(str(p))
+    (op_span,) = [
+        e for e in events
+        if e.get("event") == "phase" and e["name"] == "daemon.feed"
+    ]
+    assert op_span["run_id"] == ids["run"]
+    assert op_span["parent_id"] == ids["span"]
+    assert op_span["job"] == "job"
+
+
+def test_unjournaled_ops_stay_quiet(mesh8, tmp_path):
+    """Liveness probes and scrapes (ping/health/metrics/model_status)
+    must not bury the fit tree under polling noise."""
+    p = tmp_path / "quiet.jsonl"
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(p)):
+            with DataPlaneClient(*d.address) as c:
+                with journal.run("fit"):
+                    c.ping()
+                    c.health()
+    journal.close()
+    names = {
+        e["name"] for e in journal.read(str(p))
+        if e.get("event") == "phase"
+    }
+    assert not any(n.startswith("daemon.") for n in names), names
+
+
+def test_fixed_trace_ctx_ctor_arg_wins(mesh8, tmp_path):
+    """The executor path: a client constructed with an explicit
+    trace_ctx (the driver frame captured into the task closure) stamps
+    THAT context even though its own thread never opened a journal
+    run."""
+    p = tmp_path / "exec.jsonl"
+    ctx = {"run": "12" * 8, "span": "34" * 8}
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(p)):
+            with DataPlaneClient(*d.address, trace_ctx=ctx) as c:
+                c.feed("job", np.ones((8, 3)), algo="pca")
+    journal.close()
+    (op_span,) = [
+        e for e in journal.read(str(p))
+        if e.get("event") == "phase" and e["name"] == "daemon.feed"
+    ]
+    assert op_span["run_id"] == ctx["run"]
+    assert op_span["parent_id"] == ctx["span"]
+
+
+# ---------------------------------------------------------------------------
+# flagship: sparksim two-daemon fit → one merged Chrome trace
+# ---------------------------------------------------------------------------
+
+
+def test_two_daemon_fit_merges_into_one_chrome_trace(rng, mesh8, tmp_path):
+    """Acceptance criterion: a sparksim fit across TWO daemons journals
+    driver + daemon spans that ``tools.trace`` stitches into a single
+    tree — every daemon-side span a descendant of the driver's fit span
+    — and emits as Chrome-trace JSON."""
+    x = rng.integers(-8, 9, size=(800, 16)).astype(np.float64)
+    p = tmp_path / "fit.jsonl"
+    with DataPlaneDaemon(ttl=600.0) as a, DataPlaneDaemon(ttl=600.0) as b:
+        with config.option("run_journal", str(p)):
+            session = SimSparkSession(
+                {"spark.srml.daemon.address": _addr(a)}
+            )
+            env_plan = {
+                pid: {"SRML_DAEMON_ADDRESS": _addr(b)} for pid in (2, 3)
+            }
+            df = simdf_from_numpy(x, n_partitions=4, session=session,
+                                  env_plan=env_plan)
+            SparkPCA().setInputCol("features").setK(4).fit(df)
+    journal.close()
+
+    events = trace.load([str(p)])
+    fit_runs = [
+        e for e in events
+        if e.get("event") == "run_end" and e["name"] == "fit"
+    ]
+    assert len(fit_runs) == 1
+    run_id = fit_runs[0]["run_id"]
+
+    # Both daemons served ops, and every daemon span joined the fit run.
+    daemon_spans = [
+        e for e in events
+        if e.get("event") == "phase" and e["name"].startswith("daemon.")
+    ]
+    assert {e["name"] for e in daemon_spans} >= {"daemon.feed",
+                                                 "daemon.finalize"}
+    assert all(e["run_id"] == run_id for e in daemon_spans)
+
+    # The stitched tree has ONE root (the fit), with every daemon span a
+    # descendant of it.
+    (root,) = trace.tree(events)
+    assert root.name == "fit"
+
+    def collect(node, out):
+        for c in node.children:
+            out.append(c)
+            collect(c, out)
+        return out
+
+    names_in_tree = [n.name for n in collect(root, [])]
+    for e in daemon_spans:
+        assert e["name"] in names_in_tree
+    assert sum(1 for n in names_in_tree if n.startswith("daemon.")) == len(
+        daemon_spans
+    )
+
+    # And the CLI emits loadable Chrome-trace JSON carrying those spans.
+    out = tmp_path / "trace.json"
+    assert trace.main([str(p), "--out", str(out)]) == 0
+    obj = json.loads(out.read_text())
+    xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert {"fit", "daemon.feed", "daemon.finalize"} <= {e["name"] for e in xs}
+    for e in xs:
+        if e["name"].startswith("daemon."):
+            assert e["args"]["run_id"] == run_id
+
+    # The flame summary names both sides of the wire.
+    text = trace.flame(events)
+    assert "fit" in text and "daemon.feed" in text
+
+
+def test_knn_fit_pool_thread_clients_stay_in_the_fit_tree(rng, mesh8,
+                                                          tmp_path):
+    """The sharded-KNN build runs its per-daemon finalizes (and the
+    cross-shard quantizer sampling) on POOL threads whose journal stack
+    is empty — the estimator must hand them the driver's fit frame
+    explicitly, or the fit's heaviest daemon spans (index builds,
+    sample_rows) orphan out of the trace."""
+    from spark_rapids_ml_tpu.spark.estimator import (
+        SparkApproximateNearestNeighbors,
+    )
+
+    x = rng.normal(size=(400, 8))
+    p = tmp_path / "knn.jsonl"
+    with DataPlaneDaemon(ttl=600.0) as a, DataPlaneDaemon(ttl=600.0) as b:
+        with config.option("run_journal", str(p)):
+            session = SimSparkSession(
+                {"spark.srml.daemon.address": _addr(a)}
+            )
+            env_plan = {
+                pid: {"SRML_DAEMON_ADDRESS": _addr(b)} for pid in (2, 3)
+            }
+            df = simdf_from_numpy(x, n_partitions=4, session=session,
+                                  env_plan=env_plan)
+            model = (
+                SparkApproximateNearestNeighbors()
+                .setK(3).setNlist(4).setNprobe(4)
+                .fit(df)
+            )
+        # Outside the journal scope: release's drop_model ops are not
+        # part of the fit and must not appear in the trace at all.
+        model.release()
+    journal.close()
+    events = trace.load([str(p)])
+    fit_runs = [
+        e for e in events
+        if e.get("event") == "run_end" and e["name"] == "fit"
+    ]
+    assert len(fit_runs) == 1
+    run_id = fit_runs[0]["run_id"]
+    daemon_spans = [
+        e for e in events
+        if e.get("event") == "phase" and e["name"].startswith("daemon.")
+    ]
+    names = {e["name"] for e in daemon_spans}
+    assert {"daemon.feed", "daemon.sample_rows", "daemon.finalize"} <= names
+    strays = [
+        (e["name"], e["run_id"]) for e in daemon_spans
+        if e["run_id"] != run_id
+    ]
+    assert strays == [], f"daemon spans outside the fit run: {strays}"
