@@ -102,6 +102,22 @@ _DEFAULTS: Dict[str, Any] = {
     # Default mesh axis sizes; None = use all local devices on the data axis.
     "mesh_data_axis": _env("MESH_DATA_AXIS", None, int),
     "mesh_model_axis": _env("MESH_MODEL_AXIS", 1, int),
+    # On-mesh collective reduce for multi-daemon fits (docs/mesh.md): when
+    # every daemon a pass fed is a co-resident mesh member (one JAX
+    # runtime), per-shard partials fold on the device plane via the
+    # `reduce_mesh` op instead of the driver export/merge hub. False
+    # forces the hub path everywhere (the degraded mode the parity tests
+    # pin against the collective path bitwise).
+    "mesh_collectives": _env("MESH_COLLECTIVES", True, _as_bool),
+    # Persistent XLA compilation cache directory (ROADMAP 2b): wired to
+    # jax.config.compilation_cache_dir at package init, so identical
+    # programs compiled by an earlier process (a restarted daemon, the
+    # next bench round, a fleet twin) are disk hits instead of
+    # recompiles. None = off. Env key is SRML_COMPILE_CACHE_DIR —
+    # deployment-facing like SRML_DAEMON_STATE_DIR, hence no SRML_TPU_
+    # prefix. Persistent-cache hits are counted by
+    # srml_xla_persistent_cache_hits_total (utils/xprof.py).
+    "compile_cache_dir": os.environ.get("SRML_COMPILE_CACHE_DIR") or None,
     # Max rows per device batch when streaming host data to device.
     "stream_batch_rows": _env("STREAM_BATCH_ROWS", 1 << 20, int),
     # Use the native C++ columnar bridge if the shared library is present.
@@ -217,6 +233,14 @@ _DEFAULTS: Dict[str, Any] = {
     # the scheduler and dispatch solo.
     "serve_batch_buckets": _env_named(
         "SRML_SERVE_BATCH_BUCKETS", "64,256,1024,4096", str
+    ),
+    # Run the scheduler's bucket-ladder warmup pre-compile AT model
+    # registration (ensure_model) instead of waiting for an explicit
+    # client `warmup` call: first-request compile leaves the latency
+    # path entirely. Only meaningful with serve_batching on; a warmup
+    # failure degrades to lazy compiles, never fails the registration.
+    "serve_warmup_on_register": _env_named(
+        "SRML_SERVE_WARMUP_ON_REGISTER", False, _as_bool
     ),
     # Admission bound: max queued requests per served model; overflow
     # (and requests whose deadline the backlog would miss) are shed with

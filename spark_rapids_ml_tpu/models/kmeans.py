@@ -52,6 +52,7 @@ from spark_rapids_ml_tpu.core.params import (
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops.distances import sq_euclidean
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
@@ -252,8 +253,8 @@ def _lloyd_fn(
 
         def update(centers):
             sums, counts = shard_stats(centers)
-            sums = jax.lax.psum(sums, DATA_AXIS)
-            counts = jax.lax.psum(counts, DATA_AXIS)
+            sums = mr.reduce_sum(sums, DATA_AXIS)
+            counts = mr.reduce_sum(counts, DATA_AXIS)
             return jnp.where(
                 (counts > 0)[:, None], sums / jnp.maximum(counts, 1)[:, None], centers
             )
@@ -274,7 +275,7 @@ def _lloyd_fn(
         # Final training cost at the converged centers (one assignment pass;
         # the in-loop fused kernel doesn't materialize distances at all).
         _, min_d2 = _assign_min(centers)
-        final_cost = jax.lax.psum(jnp.sum(min_d2 * maskc), DATA_AXIS)
+        final_cost = mr.reduce_sum(jnp.sum(min_d2 * maskc), DATA_AXIS)
         return centers, final_cost, n_iter
 
     f = shard_map(
@@ -375,9 +376,9 @@ def _stream_step_fn(mesh: Mesh, k: int, cd: str, ad: str):
         bc = jnp.sum(onehot.astype(accum_dtype), axis=0)
         bcost = jnp.sum(min_d2 * maskc)
         return (
-            sums + jax.lax.psum(bs, DATA_AXIS),
-            counts + jax.lax.psum(bc, DATA_AXIS),
-            cost + jax.lax.psum(bcost, DATA_AXIS),
+            sums + mr.reduce_sum(bs, DATA_AXIS),
+            counts + mr.reduce_sum(bc, DATA_AXIS),
+            cost + mr.reduce_sum(bcost, DATA_AXIS),
         )
 
     f = shard_map(

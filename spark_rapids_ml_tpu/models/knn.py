@@ -52,6 +52,7 @@ from spark_rapids_ml_tpu.ops.pallas_kernels import (
     probe_select_pallas,
 )
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
 from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
@@ -102,13 +103,9 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
         d2 = jnp.where(mask[None, :] > 0, d2, jnp.inf)
         neg, local_idx = jax.lax.top_k(-d2, kl)  # (q, kl)
         global_idx = row_ids[local_idx]
-        # Gather candidates from all shards: (q, kl·n_data) each; the pool
-        # holds >= k valid entries because padding is tail-only.
-        cand_d = jax.lax.all_gather(-neg, DATA_AXIS, axis=1, tiled=True)
-        cand_i = jax.lax.all_gather(global_idx, DATA_AXIS, axis=1, tiled=True)
-        neg2, pos = jax.lax.top_k(-cand_d, k)
-        final_idx = jnp.take_along_axis(cand_i, pos, axis=1)
-        return -neg2, final_idx
+        # Merge candidates from all shards: the pool holds >= k valid
+        # entries because padding is tail-only.
+        return mr.reduce_topk(-neg, global_idx, k, DATA_AXIS)
 
     f = shard_map(
         shard,
@@ -1562,10 +1559,7 @@ def _ivf_query_fn_sharded(
             rerank_width=rerank_width, extract=extract,
         )
         # Merge the per-device top-k: O(q·k·devices) over ICI.
-        cat_d = jax.lax.all_gather(dists, DATA_AXIS, axis=1, tiled=True)
-        cat_i = jax.lax.all_gather(ids, DATA_AXIS, axis=1, tiled=True)
-        neg, pos = jax.lax.top_k(-cat_d, k)
-        return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
+        return mr.reduce_topk(dists, ids, k, DATA_AXIS)
 
     f = shard_map(
         shard,

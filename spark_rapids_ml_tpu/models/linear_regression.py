@@ -47,6 +47,7 @@ from spark_rapids_ml_tpu.core.params import (
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.ops.linalg import solve_spd
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
@@ -110,7 +111,7 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str, use_pallas: Optional[bool]
                 interpret=jax.default_backend() != "tpu",
             )
             return tuple(
-                jax.lax.psum(v, DATA_AXIS)
+                mr.reduce_sum(v, DATA_AXIS)
                 for v in (xtx, xty, sx, sy, syy, n)
             )
         xc = x.astype(compute_dtype) * mask.astype(compute_dtype)[:, None]
@@ -129,7 +130,7 @@ def _normal_eq_stats_fn(mesh: Mesh, cd: str, ad: str, use_pallas: Optional[bool]
         # Integer sum: an f32 sum of ones saturates at 2^24 rows.
         n = jnp.sum(mask.astype(jnp.int32)).astype(accum_dtype)
         return tuple(
-            jax.lax.psum(v, DATA_AXIS) for v in (xtx, xty, sx, sy, syy, n)
+            mr.reduce_sum(v, DATA_AXIS) for v in (xtx, xty, sx, sy, syy, n)
         )
 
     f = shard_map(

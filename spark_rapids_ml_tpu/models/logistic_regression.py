@@ -45,6 +45,7 @@ from spark_rapids_ml_tpu.core.params import (
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
@@ -231,7 +232,7 @@ def _newton_fn_cached(
         yc = y.astype(accum)
         maskc = mask.astype(accum)
         # Integer sum: an f32 sum of ones saturates at 2^24 rows/shard.
-        n = jax.lax.psum(jnp.sum(maskc.astype(jnp.int32)).astype(accum), DATA_AXIS)
+        n = mr.reduce_sum(jnp.sum(maskc.astype(jnp.int32)).astype(accum), DATA_AXIS)
         d = x.shape[1]
         fused = _pallas_newton_applicable(x.shape, cd, ad, use_pallas)
         if fused:
@@ -249,38 +250,38 @@ def _newton_fn_cached(
                 from spark_rapids_ml_tpu.ops.pallas_kernels import newton_stats_pallas
 
                 gw, gb, hww, hwb, hbb = newton_stats_pallas(xb16, y2, m2, w, b)
-                grad_w = jax.lax.psum(gw, DATA_AXIS) / n + reg * w
-                grad_b = jax.lax.psum(gb, DATA_AXIS) / n
-                h_ww = jax.lax.psum(hww, DATA_AXIS) / n + reg * jnp.eye(d, dtype=accum)
-                h_wb = jax.lax.psum(hwb, DATA_AXIS) / n
-                h_bb = jax.lax.psum(hbb, DATA_AXIS) / n
+                grad_w = mr.reduce_sum(gw, DATA_AXIS) / n + reg * w
+                grad_b = mr.reduce_sum(gb, DATA_AXIS) / n
+                h_ww = mr.reduce_sum(hww, DATA_AXIS) / n + reg * jnp.eye(d, dtype=accum)
+                h_wb = mr.reduce_sum(hwb, DATA_AXIS) / n
+                h_bb = mr.reduce_sum(hbb, DATA_AXIS) / n
                 return grad_w, grad_b, h_ww, h_wb, h_bb
             z = xc @ w + b
             p = jax.nn.sigmoid(z)
             r = (p - yc) * maskc  # dL/dz, masked
-            grad_w = jax.lax.psum(xc.T @ r, DATA_AXIS) / n + reg * w
-            grad_b = jax.lax.psum(jnp.sum(r), DATA_AXIS) / n
+            grad_w = mr.reduce_sum(xc.T @ r, DATA_AXIS) / n + reg * w
+            grad_b = mr.reduce_sum(jnp.sum(r), DATA_AXIS) / n
             wgt = jnp.maximum(p * (1.0 - p), 1e-10) * maskc
             xw = xc * wgt[:, None]
             # The Hessian is a preconditioner, not the answer: inexact
             # Newton converges to the same optimum (the gradient sets the
             # fixed point), so the dominant n·d² GEMM runs at fast DEFAULT
             # precision; gradients keep the surrounding full-f32 scope.
-            h_ww = jax.lax.psum(
+            h_ww = mr.reduce_sum(
                 jax.lax.dot_general(xw, xc, (((0,), (0,)), ((), ())),
                                     preferred_element_type=accum,
                                     precision=jax.lax.Precision.DEFAULT),
                 DATA_AXIS,
             ) / n + reg * jnp.eye(d, dtype=accum)
-            h_wb = jax.lax.psum(jnp.sum(xw, axis=0), DATA_AXIS) / n
-            h_bb = jax.lax.psum(jnp.sum(wgt), DATA_AXIS) / n
+            h_wb = mr.reduce_sum(jnp.sum(xw, axis=0), DATA_AXIS) / n
+            h_bb = mr.reduce_sum(jnp.sum(wgt), DATA_AXIS) / n
             return grad_w, grad_b, h_ww, h_wb, h_bb
 
         def loss_of(w, b):
             z = xc @ w + b
             # log(1+e^-z) for y=1, log(1+e^z) for y=0, numerically stable.
             per = (jax.nn.softplus(z) - yc * z) * maskc
-            return jax.lax.psum(jnp.sum(per), DATA_AXIS) / n + 0.5 * reg * (w @ w)
+            return mr.reduce_sum(jnp.sum(per), DATA_AXIS) / n + 0.5 * reg * (w @ w)
 
         # Trace-time solver choice: XLA's sequential LU costs ~10 ms at
         # d=1024 on TPU (more than the whole stats pass), so accelerator
@@ -478,10 +479,10 @@ def _stream_grad_hess_fn(mesh: Mesh, ad: str):
             bloss = jnp.sum((jax.nn.softplus(z) - yc * z) * maskc)
             bn = jnp.sum(maskc.astype(jnp.int32)).astype(accum)
             return (
-                gw + jax.lax.psum(xc.T @ r, DATA_AXIS),
-                gb + jax.lax.psum(jnp.sum(r), DATA_AXIS),
+                gw + mr.reduce_sum(xc.T @ r, DATA_AXIS),
+                gb + mr.reduce_sum(jnp.sum(r), DATA_AXIS),
                 hww
-                + jax.lax.psum(
+                + mr.reduce_sum(
                     jax.lax.dot_general(
                         xw, xc, (((0,), (0,)), ((), ())),
                         preferred_element_type=accum,
@@ -490,10 +491,10 @@ def _stream_grad_hess_fn(mesh: Mesh, ad: str):
                     ),
                     DATA_AXIS,
                 ),
-                hwb + jax.lax.psum(jnp.sum(xw, axis=0), DATA_AXIS),
-                hbb + jax.lax.psum(jnp.sum(wgt), DATA_AXIS),
-                loss + jax.lax.psum(bloss, DATA_AXIS),
-                n + jax.lax.psum(bn, DATA_AXIS),
+                hwb + mr.reduce_sum(jnp.sum(xw, axis=0), DATA_AXIS),
+                hbb + mr.reduce_sum(jnp.sum(wgt), DATA_AXIS),
+                loss + mr.reduce_sum(bloss, DATA_AXIS),
+                n + mr.reduce_sum(bn, DATA_AXIS),
             )
 
     f = shard_map(
@@ -655,17 +656,17 @@ def _stream_softmax_stats_cached(
                 # from VMEM/HBM.
                 bhw, bhwb, bhbb = jax.lax.map(per_class, jnp.arange(C))
             return (
-                gw + jax.lax.psum(
+                gw + mr.reduce_sum(
                     jax.lax.dot_general(xc, r, (((0,), (0,)), ((), ())),
                                         preferred_element_type=accum),
                     DATA_AXIS,
                 ),
-                gb + jax.lax.psum(jnp.sum(r, axis=0), DATA_AXIS),
-                hw + jax.lax.psum(bhw, DATA_AXIS),
-                hwb + jax.lax.psum(bhwb, DATA_AXIS),
-                hbb + jax.lax.psum(bhbb, DATA_AXIS),
-                loss + jax.lax.psum(bloss, DATA_AXIS),
-                n + jax.lax.psum(bn, DATA_AXIS),
+                gb + mr.reduce_sum(jnp.sum(r, axis=0), DATA_AXIS),
+                hw + mr.reduce_sum(bhw, DATA_AXIS),
+                hwb + mr.reduce_sum(bhwb, DATA_AXIS),
+                hbb + mr.reduce_sum(bhbb, DATA_AXIS),
+                loss + mr.reduce_sum(bloss, DATA_AXIS),
+                n + mr.reduce_sum(bn, DATA_AXIS),
             )
 
     f = shard_map(

@@ -148,7 +148,18 @@ def _fit_fn(
             return count, colsum, g
         g, mean = gram_ops.finalize_gram(count, colsum, g, mean_center)
         if solver == "randomized":
-            pc, ev, s = pca_from_gram_randomized(g, k)
+            if two_d:
+                # Keep the Gram model-sharded through the eigensolve too
+                # (docs/mesh.md "Model-parallel Gram/eigh"): for widths
+                # over the per-device accumulator budget this is the only
+                # shape in which the finalize fits at all.
+                from spark_rapids_ml_tpu.ops.eigh import (
+                    pca_from_gram_model_sharded,
+                )
+
+                pc, ev, s = pca_from_gram_model_sharded(g, k, mesh)
+            else:
+                pc, ev, s = pca_from_gram_randomized(g, k)
         else:
             pc, ev, s = pca_from_gram(g, k)
         return pc, ev, s, mean, count
@@ -205,6 +216,17 @@ def fit_pca(
         # require(k > 0 && k <= n) — RapidsRowMatrix.scala:60
         raise ValueError(f"k = {k} out of range (0, n = {d}]")
     two_d = mesh.shape[MODEL_AXIS] > 1 and d % mesh.shape[MODEL_AXIS] == 0
+    # Capacity gate: a (d, d) accumulator over the per-device budget must
+    # stay model-sharded end to end (docs/mesh.md) — with a model axis the
+    # 2-D path + sharded eigensolve carries it; without one this raises
+    # GramCapacityError here instead of OOMing mid-fit.
+    must_shard = gram_ops.require_gram_capacity(d, mesh)
+    if must_shard and not two_d:
+        raise gram_ops.GramCapacityError(
+            f"d={d} needs the model-sharded Gram but is not divisible by "
+            f"the model axis ({mesh.shape[MODEL_AXIS]}); pick a divisor "
+            "mesh_model_axis (docs/mesh.md 'Model-parallel Gram/eigh')"
+        )
     with trace_span("compute cov"):  # phase names kept from the reference
         if two_d:
             from jax.sharding import NamedSharding
@@ -215,7 +237,14 @@ def fit_pca(
             mask = jax.device_put(mask_np, NamedSharding(mesh, P(DATA_AXIS)))
         else:
             xs, mask, n_true = shard_rows(x, mesh)
-        host_finalize = _use_host_finalize(mesh) and solver != "randomized"
+        # must_shard forces the exact ("full") eigh onto the HOST: a d×d
+        # on-device eigh would re-materialize the over-budget Gram on one
+        # device, while the host assembles it from the slabs comfortably.
+        # The randomized solver instead stays fused and model-sharded
+        # (pca_from_gram_model_sharded) — nothing full-width on any chip.
+        host_finalize = (
+            _use_host_finalize(mesh) or must_shard
+        ) and solver != "randomized"
         fit = _fit_fn(
             mesh,
             k,
@@ -286,6 +315,16 @@ def fit_pca_stream(
     from spark_rapids_ml_tpu.parallel.sharding import lockstep_batches, shard_rows
 
     mesh = mesh or default_mesh()
+    if gram_ops.require_gram_capacity(n_cols, mesh):
+        # The streaming accumulator is REPLICATED on every device (the
+        # donated P() state), so a model axis does not shelter it; the
+        # model-sharded accumulate is the in-memory fit's 2-D path.
+        raise gram_ops.GramCapacityError(
+            f"the ({n_cols}, {n_cols}) streaming accumulator is over the "
+            "per-device budget and the streaming path keeps it replicated; "
+            "use fit_pca with mesh_model_axis > 1 (docs/mesh.md) or raise "
+            "SRML_GRAM_DEVICE_BUDGET_MB"
+        )
     multiproc = jax.process_count() > 1
     update = gram_ops.streaming_update(mesh)
     state = gram_ops.init_stats(n_cols)

@@ -37,6 +37,7 @@ from spark_rapids_ml_tpu.core.params import (
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
 from spark_rapids_ml_tpu.parallel.sharding import shard_rows
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
@@ -52,11 +53,11 @@ def _moments_fn(mesh: Mesh, ad: str):
 
         with mm_precision(accum):
             xc = x.astype(accum) * mask.astype(accum)[:, None]
-            n = jax.lax.psum(
+            n = mr.reduce_sum(
                 jnp.sum(mask.astype(jnp.int32)).astype(accum), DATA_AXIS
             )
-            s1 = jax.lax.psum(jnp.sum(xc, axis=0), DATA_AXIS)
-            s2 = jax.lax.psum(jnp.sum(jnp.square(xc), axis=0), DATA_AXIS)
+            s1 = mr.reduce_sum(jnp.sum(xc, axis=0), DATA_AXIS)
+            s2 = mr.reduce_sum(jnp.sum(jnp.square(xc), axis=0), DATA_AXIS)
             return n, s1, s2
 
     f = shard_map(

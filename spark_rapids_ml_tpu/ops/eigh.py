@@ -156,6 +156,41 @@ def pca_from_gram_randomized(
     return u[:, :k], ev[:k], s_full
 
 
+def pca_from_gram_model_sharded(
+    gram: jax.Array,
+    k: int,
+    mesh,
+    oversample: int = 32,
+    iters: int = 12,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Model-parallel finalize (2112.09017-style distributed linear
+    algebra): the (d, d) Gram stays sharded over the ``model`` mesh axis
+    through the WHOLE eigensolve. Each device holds a (d/n_model, d)
+    horizontal slab (exactly what ``gram.sharded_stats_2d``/``_ring``
+    produce), ``G @ V`` runs as slab matmuls whose (d, k+p) results are
+    the only full-width panels ever replicated, and the Rayleigh–Ritz
+    system is m×m. This is how a d ≥ 8192 PCA fits where the replicated
+    accumulator busts the per-device budget
+    (:data:`~spark_rapids_ml_tpu.ops.gram.GRAM_DEVICE_BUDGET_BYTES`, the
+    fit-path generalization of the Pallas ``GRAM_COLSUM_VMEM_BUDGET``
+    ceiling) — sharding instead of rejection.
+
+    Must run under jit (the sharding constraint is a trace-time
+    annotation); same contract as :func:`pca_from_gram_randomized`.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import MODEL_AXIS
+
+    gram = jax.lax.with_sharding_constraint(
+        gram, NamedSharding(mesh, P(MODEL_AXIS, None))
+    )
+    return pca_from_gram_randomized(
+        gram, k, oversample=oversample, iters=iters, seed=seed
+    )
+
+
 def pca_from_gram_host(gram, k: int):
     """Host (NumPy/LAPACK, float64) version of :func:`pca_from_gram`.
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -31,9 +32,56 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_rapids_ml_tpu import config
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from spark_rapids_ml_tpu.parallel.compat import shard_map
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
 from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 
 Stats = Tuple[jax.Array, jax.Array, jax.Array]  # (count, colsum, gram)
+
+#: Per-device byte budget for a RESIDENT (d, d) Gram accumulator — the
+#: ops/pallas_kernels.GRAM_COLSUM_VMEM_BUDGET idea generalized from one
+#: kernel's VMEM tile to the fit path's device footprint: the accumulator
+#: lives on device for the whole fit (donated streaming state, fused fit
+#: program) alongside the row batches, so a width that blows this budget
+#: must be SHARDED over the ``model`` axis, not attempted and OOMed.
+#: Override via SRML_GRAM_DEVICE_BUDGET_MB (0 = unlimited).
+GRAM_DEVICE_BUDGET_BYTES = (
+    int(os.environ.get("SRML_GRAM_DEVICE_BUDGET_MB", 256)) << 20
+)
+
+
+class GramCapacityError(ValueError):
+    """A (d, d) accumulator does not fit the per-device budget on this
+    mesh — raised at fit entry instead of an opaque device OOM mid-pass."""
+
+
+def require_gram_capacity(n_cols: int, mesh: Mesh, accum_dtype=None) -> bool:
+    """Check the (d, d) accumulator against the per-device budget.
+
+    Returns True when the fit MUST keep the Gram model-sharded end to end
+    (the full matrix busts the budget but the per-device (d/n_model, d)
+    slab fits — the docs/mesh.md model-parallel path); False when a
+    replicated accumulator is fine. Raises :class:`GramCapacityError`
+    when even the sharded slab is too big (grow ``mesh_model_axis``)."""
+    _, ad = _dtypes()
+    ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
+    if not GRAM_DEVICE_BUDGET_BYTES:
+        return False
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+    full = n_cols * n_cols * ad.itemsize
+    if full <= GRAM_DEVICE_BUDGET_BYTES:
+        return False
+    slab = -(-n_cols // n_model) * n_cols * ad.itemsize
+    if slab > GRAM_DEVICE_BUDGET_BYTES:
+        need = -(-full // GRAM_DEVICE_BUDGET_BYTES)
+        raise GramCapacityError(
+            f"the ({n_cols}, {n_cols}) {ad.name} Gram accumulator is "
+            f"{full >> 20} MiB — over the {GRAM_DEVICE_BUDGET_BYTES >> 20} "
+            f"MiB per-device budget even sharded {n_model}-way over the "
+            f"'model' axis ({slab >> 20} MiB/device). Use a mesh with "
+            f"mesh_model_axis >= {need} (docs/mesh.md 'Model-parallel "
+            "Gram/eigh'), or raise SRML_GRAM_DEVICE_BUDGET_MB."
+        )
+    return True
 
 
 def mm_precision(*dtypes):
@@ -131,9 +179,9 @@ def _stats_shard(x, mask, compute_dtype, accum_dtype, use_pallas=None):
         accum_dtype=accum_dtype,
         use_pallas=use_pallas,
     )
-    count = jax.lax.psum(count, DATA_AXIS)
-    colsum = jax.lax.psum(colsum, DATA_AXIS)
-    gram = jax.lax.psum(gram, DATA_AXIS)
+    count = mr.reduce_sum(count, DATA_AXIS)
+    colsum = mr.reduce_sum(colsum, DATA_AXIS)
+    gram = mr.reduce_sum(gram, DATA_AXIS)
     return count, colsum, gram
 
 
@@ -166,14 +214,14 @@ def _stats_shard_2d(x, mask, compute_dtype, accum_dtype):
     ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
     xc = x.astype(cd) * mask.astype(cd)[:, None]
     # (m_local, d_full) — ICI all-gather of feature blocks.
-    x_full = jax.lax.all_gather(xc, MODEL_AXIS, axis=1, tiled=True)
-    count = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)).astype(ad), DATA_AXIS)
-    colsum = jax.lax.psum(jnp.sum(x_full.astype(ad), axis=0), DATA_AXIS)
+    x_full = mr.all_concat(xc, MODEL_AXIS, axis=1)
+    count = mr.reduce_sum(jnp.sum(mask.astype(jnp.int32)).astype(ad), DATA_AXIS)
+    colsum = mr.reduce_sum(jnp.sum(x_full.astype(ad), axis=0), DATA_AXIS)
     with mm_precision(cd):
         slab = jax.lax.dot_general(
             xc, x_full, (((0,), (0,)), ((), ())), preferred_element_type=ad
         )
-    gram_slab = jax.lax.psum(slab, DATA_AXIS)
+    gram_slab = mr.reduce_sum(slab, DATA_AXIS)
     return count, colsum, gram_slab
 
 
@@ -209,10 +257,10 @@ def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
     ad = jnp.dtype(accum_dtype) if accum_dtype is not None else ad
     xc = x.astype(cd) * mask.astype(cd)[:, None]
     d_local = x.shape[1]
-    count = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)).astype(ad), DATA_AXIS)
+    count = mr.reduce_sum(jnp.sum(mask.astype(jnp.int32)).astype(ad), DATA_AXIS)
     my_colsum = jnp.sum(xc.astype(ad), axis=0)  # (d_local,)
-    colsum = jax.lax.all_gather(my_colsum, MODEL_AXIS, axis=0, tiled=True)  # (d,) tiny
-    colsum = jax.lax.psum(colsum, DATA_AXIS)
+    colsum = mr.all_concat(my_colsum, MODEL_AXIS, axis=0)  # (d,) tiny
+    colsum = mr.reduce_sum(colsum, DATA_AXIS)
     idx = jax.lax.axis_index(MODEL_AXIS)
     perm = [(i, (i + 1) % n_model) for i in range(n_model)]
 
@@ -227,7 +275,7 @@ def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
     def body(s, carry):
         held, slab = carry
         slab = block_at(s, slab, held)
-        held = jax.lax.ppermute(held, MODEL_AXIS, perm)
+        held = mr.ring_shift(held, MODEL_AXIS, perm)
         return held, slab
 
     slab0 = jnp.zeros((d_local, n_model * d_local), dtype=ad)
@@ -236,7 +284,7 @@ def _stats_shard_ring(x, mask, compute_dtype, accum_dtype, n_model):
     # big (m_local, d_local) buffer this path exists to avoid moving.
     held, slab = jax.lax.fori_loop(0, n_model - 1, body, (xc, slab0))
     slab = block_at(n_model - 1, slab, held)
-    gram_slab = jax.lax.psum(slab, DATA_AXIS)
+    gram_slab = mr.reduce_sum(slab, DATA_AXIS)
     return count, colsum, gram_slab
 
 
@@ -372,9 +420,9 @@ def _streaming_update_rows_cached(
                 accum_dtype=accum_dtype,
                 use_pallas=use_pallas,
             )
-        c = jax.lax.psum(nv_local.astype(ad), DATA_AXIS)
-        cs = jax.lax.psum(cs, DATA_AXIS)
-        g = jax.lax.psum(g, DATA_AXIS)
+        c = mr.reduce_sum(nv_local.astype(ad), DATA_AXIS)
+        cs = mr.reduce_sum(cs, DATA_AXIS)
+        g = mr.reduce_sum(g, DATA_AXIS)
         return count + c, colsum + cs, gram + g
 
     f = shard_map(

@@ -18,6 +18,14 @@ from spark_rapids_ml_tpu.parallel.mesh import (
     make_mesh,
     mesh_shape,
 )
+from spark_rapids_ml_tpu.parallel.mapreduce import (
+    all_concat,
+    map_fn,
+    reduce_sum,
+    reduce_topk,
+    ring_shift,
+)
+from spark_rapids_ml_tpu.parallel.membership import MeshMembership, registry
 from spark_rapids_ml_tpu.parallel.sharding import (
     pad_rows,
     shard_rows,
@@ -28,11 +36,18 @@ from spark_rapids_ml_tpu.parallel.sharding import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "MeshMembership",
+    "all_concat",
     "default_mesh",
     "make_mesh",
+    "map_fn",
     "mesh_shape",
     "pad_rows",
-    "shard_rows",
+    "reduce_sum",
+    "reduce_topk",
+    "registry",
     "replicated",
+    "ring_shift",
     "row_sharding",
+    "shard_rows",
 ]

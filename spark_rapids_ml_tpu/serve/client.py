@@ -642,6 +642,49 @@ class DataPlaneClient:
         )
         return int(resp["rows"])
 
+    def mesh_info(self) -> Dict[str, Any]:
+        """Mesh membership snapshot (additive op; docs/mesh.md): the
+        daemons co-resident on the server's device plane — ``epoch``
+        (fencing counter: bumps on every join/leave/reboot), ``members``
+        (``[{"id", "boot_id"}]``) and ``n_devices``. Drivers read this
+        per pass to decide the collective reduce vs the export/merge
+        hub, and stamp the epoch on :meth:`reduce_mesh`."""
+        resp, _ = self._roundtrip({"op": "mesh_info"})
+        return {k: v for k, v in resp.items() if k != "ok"}
+
+    def reduce_mesh(
+        self,
+        job: str,
+        *,
+        epoch: int,
+        peers: Dict[str, Dict[str, Any]],
+        algo: str = "pca",
+        params: Optional[Dict[str, Any]] = None,
+        drop_peers: bool = False,
+    ) -> Dict[str, Any]:
+        """On-mesh collective reduce (additive op; docs/protocol.md
+        "reduce_mesh"): fold every named co-resident peer's committed
+        pass partials into ``job`` on the device plane — O(d²) arrays
+        never cross the wire. ``peers``: ``{peer_id: {"boot_id",
+        "rows", "partitions"}}`` — the driver's task-ack accounting the
+        daemon re-validates against live job state before anything
+        folds (the pre-reduce (boot_id, pass_rows) handshake). The
+        ``epoch`` must be the one :meth:`mesh_info` reported;
+        membership changes in between refuse the reduce. A retried
+        request replays safely (``reduce_id`` dedupe, like
+        merge_state's ``merge_id``)."""
+        resp, _ = self._op({
+            "op": "reduce_mesh",
+            "job": job,
+            "epoch": int(epoch),
+            "peers": peers,
+            "algo": algo,
+            "params": params or {},
+            "drop_peers": bool(drop_peers),
+            "reduce_id": self._op_id(),
+        })
+        return resp
+
     def sample_rows(self, job: str, n: int, seed: int = 0) -> np.ndarray:
         """Seeded uniform sample of a knn job's committed rows (additive
         op; read-only). The cross-daemon quantizer-training primitive:

@@ -82,6 +82,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.core import checkpoint as checkpoint_mod
 from spark_rapids_ml_tpu.ops import gram as gram_ops
+from spark_rapids_ml_tpu.parallel import membership as membership_mod
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import row_sharding
 from spark_rapids_ml_tpu.serve import protocol
@@ -142,6 +143,11 @@ _M_MODEL_EVICTIONS = metrics_mod.counter(
     "Served models evicted from the registry, by reason (lru = over the "
     "daemon_max_models cap; ttl = idle past the reaper's deadline)",
 )
+_M_MESH_REDUCES = metrics_mod.counter(
+    "srml_daemon_mesh_reduces_total",
+    "On-mesh collective reduces applied (reduce_mesh op: co-resident "
+    "peer partials folded on the device plane, no driver hub), by algo",
+)
 
 #: Device-build cap for daemon-side IVF (bytes of raw f32 rows): past
 #: this, the full (n, d) matrix would not fit one chip's HBM alongside
@@ -163,7 +169,7 @@ _PAYLOAD_OPS = ("feed", "seed", "transform", "kneighbors")
 #: O(1) control ops (ping, health, status, step) always pass.
 _SHEDDABLE_OPS = (
     "feed", "feed_raw", "seed", "transform", "kneighbors", "merge_state",
-    "ensure_model", "warmup",
+    "reduce_mesh", "ensure_model", "warmup",
 )
 
 #: Process-wide device-execution lock. One process owns the host's chips
@@ -186,6 +192,7 @@ _KNOWN_OPS = frozenset((
     "commit", "step", "finalize", "drop", "export_state", "merge_state",
     "get_iterate", "set_iterate", "ensure_model", "transform",
     "kneighbors", "model_status", "drop_model", "warmup", "sample_rows",
+    "mesh_info", "reduce_mesh",
 ))
 
 
@@ -450,6 +457,21 @@ class _Job:
         # immediately too — a replayed merge must not double-apply).
         self._seen_feed_ids = _FifoSet()
         self._seen_merge_ids = _FifoSet()
+        # Capacity gate (docs/mesh.md): daemon job state is REPLICATED
+        # on every device, so a (d, d)-block accumulator (pca Gram,
+        # linreg XᵀX, logreg Hessian) over the per-device budget must
+        # refuse at job creation — a clean first-feed error — never an
+        # opaque device OOM mid-pass. Widths past the budget belong on
+        # the in-memory model-sharded fit.
+        if algo in ("pca", "linreg", "logreg") and gram_ops.require_gram_capacity(
+            n_cols, mesh
+        ):
+            raise gram_ops.GramCapacityError(
+                f"the ({n_cols}, {n_cols}) job accumulator is over the "
+                "per-device budget and daemon job state is replicated; "
+                "use the in-memory fit with mesh_model_axis > 1 "
+                "(docs/mesh.md) or raise SRML_GRAM_DEVICE_BUDGET_MB"
+            )
         # Step idempotency: a replayed step (ack lost mid-connection)
         # carrying the step_id of the ALREADY-APPLIED step gets the
         # cached info back instead of double-advancing the iterate.
@@ -1008,6 +1030,90 @@ class _Job:
                 base = hi
             return out
 
+    def seen_reduce(self, reduce_id: Optional[str]) -> Optional[int]:
+        """Replay-dedupe probe for ``reduce_mesh`` (call BEFORE any peer
+        validation): an already-applied reduce_id returns the cached row
+        total — with ``drop_peers`` the first apply dropped the peer
+        jobs, so re-validating a replay against them would fail an op
+        that SUCCEEDED (the ack was merely lost). None = not seen."""
+        if reduce_id is None:
+            return None
+        with self.lock:
+            if self.dropped:
+                return None
+            if str(reduce_id) in self._seen_merge_ids:
+                _M_REPLAY_HITS.inc(kind="merge")
+                self.touched = self._clock()
+                return self.rows
+        return None
+
+    def peek_pass_state(self):
+        """Pre-reduce gather read (docs/protocol.md "reduce_mesh"): this
+        pass's committed device state + accounting, under the job lock —
+        ``(state ref, pass_rows, committed copy, iteration)``. The state
+        reference is the fold input for a co-resident collective reduce;
+        the driver only reduces after every commit of the pass acked, so
+        traffic after this read is next-pass (or fenced zombie) traffic."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            if self.algo == "knn":
+                raise ValueError(
+                    "knn job state is the dataset itself and does not "
+                    "reduce across daemons (build per-daemon shards "
+                    "instead; docs/protocol.md)"
+                )
+            self.touched = self._clock()
+            return self.state, self.pass_rows, dict(self.committed), self.iteration
+
+    def merge_mesh(self, contributions, reduce_id: Optional[str] = None) -> int:
+        """Fold co-resident peers' DEVICE states into this job — the
+        on-mesh twin of :meth:`merge_remote`, minus its device→host→wire→
+        device round-trip: the peer's accumulator arrays add directly on
+        the device plane. ``contributions``: ``[(peer_id, state, rows)]``
+        in the driver's (sorted-by-id) order — the same fold order the
+        export/merge hub uses, so the two paths are bitwise-identical.
+        ``reduce_id`` dedupes a self-healing client's replay exactly like
+        ``merge_id`` (at most one apply; same FIFO memory)."""
+        with self.lock:
+            if self.dropped:
+                raise KeyError("job was finalized/dropped")
+            self.touched = self._clock()
+            if reduce_id is not None and str(reduce_id) in self._seen_merge_ids:
+                _M_REPLAY_HITS.inc(kind="merge")
+                return self.rows
+            leaves, treedef = jax.tree_util.tree_flatten(self.state)
+            peer_leaves = []
+            for pid, state, _rows in contributions:
+                ol = jax.tree_util.tree_leaves(state)
+                if len(ol) != len(leaves):
+                    raise ValueError(
+                        f"peer {pid} state has {len(ol)} leaves; job state "
+                        f"has {len(leaves)} (algo/params mismatch between "
+                        "daemons?)"
+                    )
+                for a, b in zip(leaves, ol):
+                    if tuple(a.shape) != tuple(b.shape):
+                        raise ValueError(
+                            f"peer {pid} state shape {tuple(b.shape)} != "
+                            f"job state shape {tuple(a.shape)}"
+                        )
+                peer_leaves.append(ol)
+            with _DEVICE_LOCK:
+                for ol in peer_leaves:
+                    leaves = [a + b for a, b in zip(leaves, ol)]
+            self.state = jax.tree_util.tree_unflatten(treedef, leaves)
+            for _pid, _state, rows in contributions:
+                self.rows += int(rows)
+                self.pass_rows += int(rows)
+            if reduce_id is not None:
+                # Burned only after the fold APPLIED (same rule as
+                # merge_remote): a replay of a rejected reduce must not
+                # become a silent ack-without-apply.
+                self._seen_merge_ids.add(str(reduce_id))
+            self.touched = self._clock()  # exit stamp
+            return self.rows
+
     def merge_remote(
         self, arrays: Dict[str, np.ndarray], rows: int,
         merge_id: Optional[str] = None,
@@ -1560,6 +1666,28 @@ class _ServedModel:
             return dists, idx
 
 
+def _model_width(algo: str, arrays: Dict[str, np.ndarray]) -> Optional[int]:
+    """Fitted feature width of a registered model's arrays — what a
+    warmup-on-register pre-compile warms without the client having to
+    say. None when the algo's arrays don't carry an unambiguous width
+    (the registration then skips the eager warmup, never fails)."""
+    try:
+        if algo == "pca":
+            return int(np.asarray(arrays["pc"]).shape[0])
+        if algo == "scaler":
+            return int(np.asarray(arrays["mean"]).shape[0])
+        if algo == "linreg":
+            return int(np.asarray(arrays["coefficients"]).reshape(-1).shape[0])
+        if algo == "logreg":
+            c = np.asarray(arrays["coefficients"])
+            return int(c.shape[-1] if c.ndim == 2 else c.shape[0])
+        if algo == "kmeans":
+            return int(np.asarray(arrays["centers"]).shape[1])
+    except (KeyError, IndexError):
+        return None
+    return None
+
+
 def _resolve_k(served, k):
     """Canonical ``k`` for kneighbors dispatch and scheduler keying:
     ``None`` means the model's fitted k, resolved HERE so k-omitted and
@@ -1692,6 +1820,15 @@ class DataPlaneDaemon:
             self._scheduler = scheduler_mod.RequestScheduler(
                 retry_after_s=self._retry_after_s
             ).start()
+        # Mesh membership (docs/mesh.md): this daemon is now a peer on
+        # the process's device plane. Registration — including a
+        # re-registration of a durable identity after a restart — bumps
+        # the membership epoch, so any in-flight collective fit
+        # re-resolves instead of folding a rebooted daemon's (freshly
+        # zeroed) partials.
+        membership_mod.registry().register(
+            self.instance_id, self.boot_id, self
+        )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="srml-dataplane-accept", daemon=True
         )
@@ -1710,6 +1847,13 @@ class DataPlaneDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        # Leave the mesh FIRST (epoch bump): a reduce_mesh racing this
+        # stop fails the epoch fence instead of folding a dying daemon.
+        # Incarnation-scoped: a superseded object's late stop() must not
+        # deregister the successor holding the same durable id.
+        membership_mod.registry().unregister(
+            self.instance_id, boot_id=self.boot_id
+        )
         if self._scheduler is not None:
             # First: queued serving requests fail out and unblock their
             # connection threads before the sockets are torn down.
@@ -2163,20 +2307,8 @@ class DataPlaneDaemon:
                 conn, {"ok": True, "rows": job.rows, "algo": job.algo, "n_cols": job.n_cols}
             )
         elif op == "drop":
-            # Snapshot discard FIRST — unconditionally, even with no
-            # live job (drop is the abort op, and an orphan snapshot
-            # would resurrect the aborted job at its next mention), and
-            # BEFORE unregistration so a lazy restore racing this drop
-            # either finds the registry entry or finds no file; the
-            # restore path re-checks file existence after publishing to
-            # close the remaining load-in-flight window.
-            self._discard_job_state(str(req.get("job")))
-            with self._jobs_lock:
-                job = self._jobs.pop(str(req.get("job")), None)
-            if job is not None:
-                with job.lock:
-                    job.dropped = True
-            protocol.send_json(conn, {"ok": True, "dropped": job is not None})
+            dropped = self._drop_job(str(req.get("job")))
+            protocol.send_json(conn, {"ok": True, "dropped": dropped})
         elif op == "export_state":
             job = self._get_job(req)
             arrays, meta = job.export_state()
@@ -2191,6 +2323,10 @@ class DataPlaneDaemon:
             )
         elif op == "merge_state":
             self._op_merge_state(conn, req)
+        elif op == "mesh_info":
+            self._op_mesh_info(conn)
+        elif op == "reduce_mesh":
+            self._op_reduce_mesh(conn, req)
         elif op == "get_iterate":
             job = self._get_job(req)
             arrays, meta = job.get_iterate()
@@ -2272,6 +2408,7 @@ class DataPlaneDaemon:
             served_models = len(self._models)
         with self._conns_lock:
             queue_depth = self._active_conns
+        mesh_snap = membership_mod.registry().snapshot()
         resp = {
             "ok": True,
             "v": protocol.PROTOCOL_VERSION,
@@ -2291,6 +2428,13 @@ class DataPlaneDaemon:
                 {"enabled": False} if self._scheduler is None
                 else self._scheduler.snapshot()
             ),
+            # Additive: mesh membership (docs/mesh.md) — the epoch a
+            # driver fences reduce_mesh with and how many co-resident
+            # peers share this device plane (mesh_info has the roster).
+            "mesh": {
+                "epoch": mesh_snap["epoch"],
+                "members": len(mesh_snap["members"]),
+            },
         }
         if reason is not None:
             resp["retry_after_s"] = self._retry_after_s
@@ -2338,6 +2482,23 @@ class DataPlaneDaemon:
         if job is None:
             raise KeyError(f"no such job {name!r}")
         return job
+
+    def _drop_job(self, name: str) -> bool:
+        """Drop one job (the `drop` op's body, also run against peer
+        daemons by a single-pass ``reduce_mesh``). Snapshot discard
+        FIRST — unconditionally, even with no live job (drop is the
+        abort op, and an orphan snapshot would resurrect the aborted job
+        at its next mention), and BEFORE unregistration so a lazy
+        restore racing this drop either finds the registry entry or
+        finds no file; the restore path re-checks file existence after
+        publishing to close the remaining load-in-flight window."""
+        self._discard_job_state(name)
+        with self._jobs_lock:
+            job = self._jobs.pop(name, None)
+        if job is not None:
+            with job.lock:
+                job.dropped = True
+        return job is not None
 
     def _op_feed(self, conn, req: Dict[str, Any]) -> None:
         import pyarrow as pa
@@ -2554,6 +2715,174 @@ class DataPlaneDaemon:
         rows = job.merge_remote(arrays, contrib, merge_id=merge_id)
         protocol.send_json(conn, {"ok": True, "rows": rows})
 
+    def _op_mesh_info(self, conn) -> None:
+        """Additive op (docs/protocol.md "mesh_info"): the mesh
+        membership snapshot — which daemons are co-resident peers on
+        THIS device plane, their boot incarnations, and the fencing
+        epoch. The driver reads it per pass to decide collective-vs-hub
+        and stamps the epoch on ``reduce_mesh``."""
+        snap = membership_mod.registry().snapshot()
+        protocol.send_json(
+            conn,
+            {
+                "ok": True,
+                "v": protocol.PROTOCOL_VERSION,
+                **self._identity(),
+                "epoch": snap["epoch"],
+                "members": snap["members"],
+                "n_devices": (
+                    int(self._mesh.devices.size) if self._mesh is not None else 0
+                ),
+            },
+        )
+
+    def _op_reduce_mesh(self, conn, req: Dict[str, Any]) -> None:
+        """On-mesh collective reduce (docs/protocol.md "reduce_mesh"):
+        fold co-resident peer daemons' committed pass partials into the
+        named job directly on the device plane — the driver hub
+        (export_state → wire → merge_state) collapses to one op whose
+        data never leaves the devices. Safety order:
+
+        1. **epoch fence**: the request's ``epoch`` must equal the live
+           membership epoch — any join/leave/reboot since the driver's
+           ``mesh_info`` refuses the whole reduce;
+        2. **pre-reduce gather** of every peer's ``(boot_id, pass_rows,
+           committed partitions)`` — the split-brain row-accounting
+           checks the hub ran driver-side, now against live job state,
+           all validated BEFORE anything folds (all-or-nothing);
+        3. device fold in sorted-peer order (bitwise-identical to the
+           hub), then optional peer-job drop (``drop_peers``, the
+           single-pass algos' cleanup)."""
+        name = str(req["job"])
+        req_algo = str(_opt(req, "algo", "pca"))
+        peers_spec = req.get("peers") or {}
+        if not isinstance(peers_spec, dict) or not peers_spec:
+            raise ValueError("reduce_mesh needs a non-empty peers map")
+        # Replay dedupe FIRST — before the epoch fence and the peer
+        # gather: a replay of an applied drop_peers reduce finds the
+        # peer jobs gone (and possibly a changed epoch), and must get
+        # its cached ack back, not a spurious failure.
+        job = self._lookup_job(name)
+        if job is not None:
+            cached = job.seen_reduce(req.get("reduce_id"))
+            if cached is not None:
+                protocol.send_json(
+                    conn,
+                    {"ok": True, "rows": cached,
+                     "reduced": len(peers_spec), **self._identity()},
+                )
+                return
+        reg = membership_mod.registry()
+        snap = reg.snapshot()
+        if int(_opt(req, "epoch", -1)) != snap["epoch"]:
+            raise RuntimeError(
+                f"mesh membership changed (epoch {snap['epoch']} != "
+                f"driver's {req.get('epoch')}): a daemon joined, left, or "
+                "rebooted since mesh_info; replay the pass"
+            )
+        members = {m["id"]: m["boot_id"] for m in snap["members"]}
+        gathered = []
+        for pid in sorted(peers_spec):
+            spec = peers_spec[pid] or {}
+            boot = str(spec.get("boot_id"))
+            if pid == self.instance_id:
+                raise ValueError(
+                    "reduce_mesh peers must not include the target daemon"
+                )
+            if members.get(pid) != boot:
+                raise RuntimeError(
+                    f"peer daemon {pid} is not a co-resident mesh member "
+                    f"at boot {boot} (epoch {snap['epoch']}): it rebooted "
+                    "or left — rows acked to the old incarnation are gone; "
+                    "replay the pass"
+                )
+            peer = reg.get(pid, boot_id=boot)
+            if peer is None:
+                raise RuntimeError(f"peer daemon {pid} left the mesh")
+            pjob = peer._lookup_job(name)
+            if pjob is None:
+                raise KeyError(f"peer daemon {pid} has no job {name!r}")
+            state, pass_rows, committed, iteration = pjob.peek_pass_state()
+            want_rows = int(_opt(spec, "rows", -1))
+            if pass_rows != want_rows:
+                raise RuntimeError(
+                    f"daemon row-count mismatch at mesh reduce: tasks "
+                    f"acked {want_rows} rows on peer {pid} but its job "
+                    f"accounts {pass_rows} this pass; falling through "
+                    "would corrupt the model — replay or refit"
+                )
+            want_parts = {int(p) for p in (spec.get("partitions") or [])}
+            orphans = sorted(p for p in committed if p not in want_parts)
+            lost = sorted(p for p in want_parts if p not in committed)
+            if orphans or lost:
+                parts = []
+                if orphans:
+                    parts.append(
+                        f"partitions {orphans} committed on peer {pid} but "
+                        "acked elsewhere (cross-daemon retry orphans)"
+                    )
+                if lost:
+                    parts.append(
+                        f"partitions {lost} acked on peer {pid} but not "
+                        "committed"
+                    )
+                raise RuntimeError(
+                    "partition accounting mismatch at mesh reduce: "
+                    + "; ".join(parts)
+                )
+            gathered.append((pid, peer, pjob, state, pass_rows, iteration))
+        job = self._lookup_job(name)
+        if job is None:
+            # Every row may have been fed to peers: create the target
+            # like merge_state does, shaped from the first peer's job.
+            first = gathered[0][2]
+            job = _Job(
+                req_algo, first.n_cols, self._mesh, req.get("params"),
+                clock=self._clock,
+            )
+            self._attach_durability(name, job)
+            with self._jobs_lock:
+                current = self._jobs.get(name)
+                if current is None:
+                    self._jobs[name] = job
+                else:
+                    job = current  # raced a concurrent creation
+        if job.algo != req_algo:
+            raise ValueError(
+                f"job {name!r} is algo {job.algo!r}; reduce_mesh carried "
+                f"{req_algo!r}"
+            )
+        for pid, _peer, pjob, _state, _rows, iteration in gathered:
+            if pjob.algo != job.algo or pjob.n_cols != job.n_cols:
+                raise ValueError(
+                    f"peer {pid} job is ({pjob.algo}, n_cols="
+                    f"{pjob.n_cols}); target is ({job.algo}, n_cols="
+                    f"{job.n_cols})"
+                )
+            if iteration != job.iteration:
+                raise RuntimeError(
+                    f"peer {pid} is on pass {iteration}, target on "
+                    f"{job.iteration}: a daemon missed a pass boundary — "
+                    "replay the pass"
+                )
+        rows = job.merge_mesh(
+            [(pid, state, n) for pid, _p, _j, state, n, _i in gathered],
+            reduce_id=req.get("reduce_id"),
+        )
+        if _opt(req, "drop_peers", False):
+            for pid, peer, _pjob, _state, _rows, _i in gathered:
+                peer._drop_job(name)
+        _M_MESH_REDUCES.inc(algo=job.algo)
+        protocol.send_json(
+            conn,
+            {
+                "ok": True,
+                "rows": rows,
+                "reduced": len(gathered),
+                **self._identity(),
+            },
+        )
+
     def _op_set_iterate(self, conn, req: Dict[str, Any]) -> None:
         """Install a driver-pushed iterate. Additive recovery extension:
         when the job is unknown AND the request carries ``n_cols`` (plus
@@ -2650,7 +2979,53 @@ class DataPlaneDaemon:
                 created = False
                 evicted = []
         self._log_lru_evictions(evicted)
-        protocol.send_json(conn, {"ok": True, "created": created})
+        warmed = (
+            self._warmup_on_register(name, _model_width(algo, arrays))
+            if created else None
+        )
+        ack: Dict[str, Any] = {"ok": True, "created": created}
+        if warmed is not None:
+            ack["warmup"] = warmed
+        protocol.send_json(conn, ack)
+
+    def _warmup_on_register(
+        self, name: str, width: Optional[int]
+    ) -> Optional[Dict[str, Any]]:
+        """Optional eager warmup (ROADMAP 2b; config
+        ``serve_warmup_on_register``): run the PR-5 bucket-ladder
+        pre-compile AT registration — ensure_model payloads and
+        daemon-built KNN index shards alike — so the first real request
+        is a dispatch, not a jit compile. Synchronous on purpose: the
+        registering caller's ack means "servable at full speed". A
+        warmup failure degrades to lazy compiles (logged); it never
+        fails the registration. Returns the warmup info, or None when
+        not applicable (scheduler off, flag off, unknown width)."""
+        if self._scheduler is None or width is None:
+            return None
+        from spark_rapids_ml_tpu import config
+
+        if not bool(config.peek("serve_warmup_on_register")):
+            return None
+        with self._models_lock:
+            served = self._models.get(name)
+        if served is None:
+            return None
+        kind = (
+            "kneighbors" if hasattr(served.model, "kneighbors")
+            else "transform"
+        )
+        try:
+            return self._scheduler.warmup(
+                name, served, int(width), kind=kind,
+                k=_resolve_k(served, None) if kind == "kneighbors" else None,
+                dtype="float32",
+            )
+        except Exception as e:
+            logger.warning(
+                "warmup-on-register for %r failed (first requests will "
+                "compile lazily): %s", name, e,
+            )
+            return None
 
     def _serve_dispatch(
         self, conn, req: Dict[str, Any], kind: str, name: str, served, x,
@@ -2824,6 +3199,10 @@ class DataPlaneDaemon:
                 )
                 evicted = self._enforce_model_cap_locked(keep=name)
             self._log_lru_evictions(evicted)
+            # Same eager-warmup contract as ensure_model: the built index
+            # shard's kneighbors ladder pre-compiles before the finalize
+            # ack, so the first real query never pays the compile.
+            self._warmup_on_register(name, int(info["n_cols"][0]))
             self._discard_job_state(str(req.get("job")))  # before pop (see drop)
             with self._jobs_lock:
                 self._jobs.pop(str(req.get("job")), None)

@@ -36,6 +36,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu import config
 from spark_rapids_ml_tpu.spark import daemon_session
 from spark_rapids_ml_tpu.utils import journal
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
@@ -57,6 +58,11 @@ _M_DROP_ERRORS = metrics_mod.counter(
     "srml_client_drop_errors_total",
     "Cleanup drop() calls that failed (the daemon job leaks until its "
     "TTL), by stage",
+)
+_M_MESH_PATHS = metrics_mod.counter(
+    "srml_fit_mesh_reduce_paths_total",
+    "Multi-daemon pass reductions by path (collective = on-mesh "
+    "reduce_mesh; hub = driver-mediated export/merge fallback)",
 )
 
 
@@ -330,6 +336,94 @@ def _split_brain(context: str, expected: int, got: int, detail: str) -> RuntimeE
         f"rows ({detail}) but the daemon plane accounts {got}; {hint} "
         "Refit after fixing the cause."
     )
+
+
+def _reduce_on_mesh(
+    client, job, primary_id, per_daemon, addr_of, owner, boots,
+    wire_algo, feed_params, drop_peer, cache,
+):
+    """Collective-first pass reduction (docs/mesh.md): when the primary
+    and every row-holding peer are co-resident members of one mesh (one
+    JAX runtime — multichip single-host daemons, or a multi-host
+    jax.distributed plane), ONE ``reduce_mesh`` op folds all peer
+    partials on the device plane and the O(d²) arrays never cross the
+    wire. Returns True when the pass is reduced (or there was nothing to
+    reduce); False hands the pass to the export/merge hub
+    (:func:`_merge_peer_daemons`) — the degraded mode for daemons on
+    separate runtimes or predating the op.
+
+    The split-brain row accounting does not weaken on this path: the
+    driver ships its task-ack view (rows + owned partitions per peer)
+    and the daemon re-validates it against every peer's live
+    ``(boot_id, pass_rows)`` in a pre-reduce gather, refusing the whole
+    fold on any mismatch or on a membership-epoch change. A co-resident
+    peer that REBOOTED since the scan acked raises the incarnation
+    fence here — recovery (when enabled) replays the pass."""
+    peer_rows = {
+        d: n for d, n in per_daemon.items() if d != primary_id and n > 0
+    }
+    if not peer_rows:
+        return True  # single-daemon pass: nothing to reduce on any path
+    if "hub_only" not in cache:
+        cache["hub_only"] = not bool(config.get("mesh_collectives"))
+    if cache["hub_only"]:
+        _M_MESH_PATHS.inc(path="hub")
+        return False
+    # Two attempts: the daemon's epoch fence is process-global, so an
+    # UNRELATED daemon joining/leaving between our mesh_info and the
+    # reduce refuses it spuriously — one re-read revalidates every
+    # actual participant against the fresh epoch. A second mismatch
+    # (sustained churn) surfaces; recovery treats it like any daemon
+    # failure.
+    for attempt in range(2):
+        try:
+            info = client.mesh_info()
+        except Exception as e:
+            logger.debug(
+                "mesh_info unavailable on the primary (%s); this fit uses "
+                "the driver-hub merge", e,
+            )
+            cache["hub_only"] = True
+            _M_MESH_PATHS.inc(path="hub")
+            return False
+        members = {
+            str(m["id"]): str(m["boot_id"]) for m in info.get("members", [])
+        }
+        if primary_id not in members:
+            _M_MESH_PATHS.inc(path="hub")
+            return False
+        for did in sorted(peer_rows):
+            if did not in members:
+                # A genuinely remote daemon (its runtime is not this
+                # mesh): the hub is the correct path, not a failure.
+                _M_MESH_PATHS.inc(path="hub")
+                return False
+            ack_boot = next(iter(boots.get(did) or []), None)
+            if ack_boot is not None and members[did] != ack_boot:
+                raise _incarnation_change(
+                    addr_of.get(did, did), {ack_boot, members[did]}
+                )
+        peers = {
+            did: {
+                "boot_id": members[did],
+                "rows": int(n),
+                "partitions": sorted(
+                    int(p) for p, d in owner.items() if d == did
+                ),
+            }
+            for did, n in peer_rows.items()
+        }
+        try:
+            client.reduce_mesh(
+                job, epoch=int(info["epoch"]), peers=peers, algo=wire_algo,
+                params=feed_params, drop_peers=drop_peer,
+            )
+        except RuntimeError as e:
+            if attempt == 0 and "membership changed" in str(e):
+                continue
+            raise
+        _M_MESH_PATHS.inc(path="collective")
+        return True
 
 
 def _merge_peer_daemons(
@@ -711,6 +805,10 @@ class _SparkAdapter:
         # primary already has one): merges and iterate syncs happen every
         # pass, and per-op TCP connect churn would dominate small passes.
         peer_clients: dict = {}
+        # Per-fit collective-path memory (_reduce_on_mesh): remembers a
+        # "this plane has no mesh ops" verdict so a fit probes once, not
+        # every pass.
+        mesh_cache: dict = {}
 
         def peer_client(did, addr=None):
             c = peer_clients.get(did)
@@ -856,11 +954,20 @@ class _SparkAdapter:
                         raise _incarnation_change(addr_of.get(did, did), bs)
                 if merge:
                     with trace_span("merge peers"):
-                        _merge_peer_daemons(
+                        # Collective first (docs/mesh.md): co-resident
+                        # daemons reduce on the device plane; the
+                        # export/merge hub is the fallback for peers on
+                        # a different runtime (or predating the op).
+                        if not _reduce_on_mesh(
                             client, job, primary_id, per, addr_of, owner,
-                            peer_client, wire_algo, feed_params,
-                            drop_peer=drop_peer,
-                        )
+                            boots, wire_algo, feed_params, drop_peer,
+                            mesh_cache,
+                        ):
+                            _merge_peer_daemons(
+                                client, job, primary_id, per, addr_of,
+                                owner, peer_client, wire_algo, feed_params,
+                                drop_peer=drop_peer,
+                            )
                 total_fed += n
                 return n
 

@@ -39,6 +39,12 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 DEFAULT_MAX_REGRESSION = 0.15
 
+#: Absolute scaling-efficiency floor for multichip fit records — the
+#: acceptance bar of the pod-scale fit work (docs/mesh.md): below it the
+#: collective path is eating more than 20% of the hardware, regardless
+#: of what the trajectory once recorded.
+MULTICHIP_MIN_EFFICIENCY = 0.8
+
 
 def parse_record(obj: Dict[str, Any]) -> Dict[str, Any]:
     """Normalize either record shape to {metric, value, ...}: the raw
@@ -148,6 +154,89 @@ def check(
     return ok, lines
 
 
+def _is_dryrun(rec: Dict[str, Any]) -> bool:
+    """The MULTICHIP_r01–r05 era records are smoke dryruns ({n_devices,
+    rc, ok, tail}) with no measured value; a fresh record can also mark
+    itself ``dryrun``. Either way: nothing to gate on."""
+    return bool(rec.get("dryrun")) or (
+        rec.get("value") is None and "tail" in rec
+    )
+
+
+def check_multichip(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+    allow_compiles: Tuple[str, ...] = (),
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --multichip`` record: the SCALING-EFFICIENCY
+    floor (absolute ``MULTICHIP_MIN_EFFICIENCY``, plus the trajectory
+    median like the throughput gate), then throughput vs matching
+    history. Dryrun records — fresh or historical — SKIP, never pass:
+    a smoke run proves the plumbing, not the scaling."""
+    lines: List[str] = []
+    if _is_dryrun(fresh):
+        lines.append(
+            "multichip [SKIP] fresh record is a dryrun (no measured "
+            "scaling) — nothing gated, NOT a pass"
+        )
+        return True, lines
+    eff = fresh.get("scaling_efficiency")
+    if eff is None:
+        return False, [
+            "multichip record has no scaling_efficiency — not a "
+            "bench.py --multichip record?"
+        ]
+    ok = True
+    eff = float(eff)
+    dryruns = sum(1 for h in history if _is_dryrun(h))
+    if dryruns:
+        lines.append(
+            f"multichip [SKIP] {dryruns} dryrun history record(s) carry "
+            "no scaling number and are excluded from the trajectory"
+        )
+    # Like-for-like: simulated-mesh efficiencies and real-pod
+    # efficiencies are different quantities (docs/mesh.md).
+    matching = [
+        float(h["scaling_efficiency"]) for h in history
+        if not _is_dryrun(h)
+        and h.get("metric") == fresh.get("metric")
+        and h.get("scaling_efficiency") is not None
+        and bool(h.get("simulated")) == bool(fresh.get("simulated"))
+    ]
+    floor = MULTICHIP_MIN_EFFICIENCY
+    if matching:
+        floor = max(floor, (1.0 - max_regression) * _median(matching))
+    verdict = "OK" if eff >= floor else "REGRESSION"
+    lines.append(
+        f"scaling efficiency [{verdict}] {eff:.4f} at "
+        f"{fresh.get('n_devices')} device(s) "
+        f"({'simulated' if fresh.get('simulated') else 'real'} mesh) vs "
+        f"floor {floor:.4f} (abs {MULTICHIP_MIN_EFFICIENCY}, "
+        f"{len(matching)} trajectory record(s))"
+    )
+    if eff < floor:
+        ok = False
+    # Throughput gate on like-for-like history only: the metric name
+    # carries d/k but not the mesh, and a simulated-CPU rows/s is a
+    # different quantity from a real pod's (as is a different device
+    # count) — mixing them would fail good records or mask regressions.
+    t_ok, t_lines = check(
+        fresh,
+        [
+            h for h in history
+            if not _is_dryrun(h)
+            and bool(h.get("simulated")) == bool(fresh.get("simulated"))
+            and h.get("n_devices") == fresh.get("n_devices")
+        ],
+        max_regression=max_regression,
+        # Multichip steady keys are mesh-prefixed ("8dev:gram...") —
+        # pass the name exactly as the failure line prints it.
+        allow_compiles=allow_compiles,
+    )
+    return ok and t_ok, lines + t_lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.perfcheck",
@@ -179,25 +268,49 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(args.record, "r", encoding="utf-8") as f:
             raw = f.read()
     # bench.py prints exactly one JSON line, but a piped run may carry
-    # log noise around it — take the last parseable line.
+    # log noise around it — take the last parseable line. A whole-file
+    # JSON document (a driver-side MULTICHIP_r*/BENCH_r* wrapper, pretty-
+    # printed over many lines) parses first.
     fresh = None
-    for line in raw.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                fresh = parse_record(json.loads(line))
-            except ValueError:
-                continue
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        fresh = parse_record(doc)
+    else:
+        # Non-object documents (a JSON array, a bare scalar) are not
+        # records — fall through to the line scan, which skips them and
+        # exits with the graceful "no JSON record" message.
+        for line in raw.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    candidate = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(candidate, dict):
+                    fresh = parse_record(candidate)
     if fresh is None:
         print("perfcheck: no JSON record found in input", file=sys.stderr)
         return 2
 
-    history = load_history(args.history or ["BENCH_r*.json"])
-    ok, lines = check(
-        fresh, history,
-        max_regression=args.max_regression,
-        allow_compiles=tuple(args.allow_compile),
+    multichip = str(fresh.get("metric", "")).startswith("multichip_") or (
+        _is_dryrun(fresh) and "n_devices" in fresh
     )
+    default_glob = "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
+    history = load_history(args.history or [default_glob])
+    if multichip:
+        ok, lines = check_multichip(
+            fresh, history, max_regression=args.max_regression,
+            allow_compiles=tuple(args.allow_compile),
+        )
+    else:
+        ok, lines = check(
+            fresh, history,
+            max_regression=args.max_regression,
+            allow_compiles=tuple(args.allow_compile),
+        )
     for line in lines:
         print(line)
     print("perfcheck:", "PASS" if ok else "FAIL")

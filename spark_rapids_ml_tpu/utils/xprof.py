@@ -90,6 +90,11 @@ _M_BYTES = metrics_mod.counter(
     "Model bytes-accessed dispatched through ledgered calls "
     "(cost-analysis bytes x calls), by fn",
 )
+_M_PCACHE_HITS = metrics_mod.counter(
+    "srml_xla_persistent_cache_hits_total",
+    "XLA programs served from the persistent compilation cache (config "
+    "compile_cache_dir / SRML_COMPILE_CACHE_DIR) instead of recompiling",
+)
 
 _tls = threading.local()  # .current: (entry, sig) of the innermost call
 
@@ -127,7 +132,16 @@ def _ensure_listener() -> None:
         import jax.monitoring
 
         jax.monitoring.register_event_duration_secs_listener(_on_event)
+        # Plain (no-duration) events: the persistent compilation cache
+        # announces each disk hit here — the cheap half of ROADMAP 2b's
+        # "compile once, serve forever" measured by the same ledger.
+        jax.monitoring.register_event_listener(_on_plain_event)
         _listener_installed = True
+
+
+def _on_plain_event(event: str, **kw: Any) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _M_PCACHE_HITS.inc()
 
 
 def _on_event(event: str, duration: float, **kw: Any) -> None:

@@ -234,6 +234,32 @@ def test_no_bare_print_in_package():
     )
 
 
+def test_no_bare_collectives_outside_parallel():
+    """Every device collective must go through the mapreduce layer
+    (``parallel/mapreduce.py``: reduce_sum / all_concat / ring_shift /
+    reduce_topk) — the mirror of the bare-``jax.jit`` gate below: a
+    ``jax.lax.psum``/``all_gather`` call outside ``parallel/`` bypasses
+    the ``srml_parallel_collective_traces_total`` booking and hides what
+    a program moves over ICI/DCN from every audit (docs/mesh.md). Only
+    CALL sites are flagged; prose mentions in docstrings are fine."""
+    call_re = re.compile(
+        r"\blax\.(psum|pmean|all_gather|ppermute|psum_scatter|all_to_all)"
+        r"\s*\("
+    )
+    offenders = []
+    for path in _py_sources():
+        if path.parent.name == "parallel":
+            continue
+        text = path.read_text()
+        for m in call_re.finditer(text):
+            line = text[: m.start()].count("\n") + 1
+            offenders.append(f"{path.relative_to(PKG.parent)}:{line}")
+    assert offenders == [], (
+        "bare collective call outside parallel/ (route it through "
+        "parallel.mapreduce) at: " + ", ".join(offenders)
+    )
+
+
 def test_every_jit_in_ops_and_models_is_ledgered():
     """Every jit entry point in ops/ and models/ must register with the
     jit ledger (``ledgered_jit(name, ...)`` — utils/xprof.py), the

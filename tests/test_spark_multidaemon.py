@@ -290,8 +290,13 @@ def test_split_brain_guard_fails_loudly(rng, mesh8, monkeypatch):
 
 
 def test_peer_export_shortfall_fails_loudly(rng, mesh8, monkeypatch):
-    """The per-peer guard: a peer whose export accounts fewer rows than
-    its tasks acked fails the fit BEFORE its partials are folded in."""
+    """The per-peer guard on the driver-HUB path (mesh_collectives off —
+    the collective path never calls export_state; its equivalent guard
+    is pinned by test_mesh_collectives): a peer whose export accounts
+    fewer rows than its tasks acked fails the fit BEFORE its partials
+    are folded in."""
+    from spark_rapids_ml_tpu import config
+
     orig = _Job.export_state
 
     def short_export(self):
@@ -304,8 +309,9 @@ def test_peer_export_shortfall_fails_loudly(rng, mesh8, monkeypatch):
         session, env_plan = _split_session(a, b)
         df = simdf_from_numpy(_int_matrix(rng, 400, 8), n_partitions=4,
                               session=session, env_plan=env_plan)
-        with pytest.raises(RuntimeError, match="row-count mismatch"):
-            SparkPCA().setInputCol("features").setK(3).fit(df)
+        with config.option("mesh_collectives", False):
+            with pytest.raises(RuntimeError, match="row-count mismatch"):
+                SparkPCA().setInputCol("features").setK(3).fit(df)
 
 
 def test_merge_state_rejected_payload_leaves_no_orphan_job(rng, mesh8):
