@@ -211,11 +211,14 @@ _DEFAULTS: Dict[str, Any] = {
     "daemon_state_dir": os.environ.get("SRML_DAEMON_STATE_DIR") or None,
     # Serving scheduler (serve/scheduler.py; docs/protocol.md "Serving
     # scheduler"): cross-connection micro-batching for transform/
-    # kneighbors. OFF by default — the protocol goldens and every
-    # single-caller deployment behave byte-identically; flip on for
-    # concurrent serving traffic. Env keys are deployment-facing
+    # kneighbors. ON by default since the fleet PR — batched results are
+    # bitwise-identical to solo serving (the PR 5 matrix + the protocol
+    # goldens replayed as the burn-in), so the only observable change is
+    # higher QPS under concurrency. SRML_SERVE_BATCHING=0 is the
+    # documented opt-out for single-caller deployments that prefer zero
+    # batching-window latency. Env keys are deployment-facing
     # (SRML_SERVE_*), like SRML_DAEMON_STATE_DIR.
-    "serve_batching": _env_named("SRML_SERVE_BATCHING", False, _as_bool),
+    "serve_batching": _env_named("SRML_SERVE_BATCHING", True, _as_bool),
     # Max milliseconds a queued request waits for co-batchable traffic
     # before its micro-batch dispatches anyway.
     "serve_batch_window_ms": _env_named(
@@ -246,6 +249,35 @@ _DEFAULTS: Dict[str, Any] = {
     # (and requests whose deadline the backlog would miss) are shed with
     # the busy/retry_after_s contract instead of queueing to death.
     "serve_queue_depth": _env_named("SRML_SERVE_QUEUE_DEPTH", 256, int),
+    # Fleet serving (serve/fleet.py + serve/router.py; docs/protocol.md
+    # "Fleet & versioned serving"). Env keys are deployment-facing
+    # (SRML_FLEET_* / SRML_SERVE_*), like SRML_DAEMON_STATE_DIR.
+    # How stale a replica's polled `health` snapshot may be before the
+    # router re-polls it (also the dead-replica re-probe interval).
+    "fleet_health_poll_s": _env_named("SRML_FLEET_HEALTH_POLL_S", 1.0, float),
+    # Max replicas one request may try before it is declared unroutable
+    # (busy/dead replicas are skipped toward the next candidate).
+    # 0 = one attempt per fleet member.
+    "fleet_failover_attempts": _env_named(
+        "SRML_FLEET_FAILOVER_ATTEMPTS", 0, int
+    ),
+    # Virtual nodes per replica on the consistent-hash ring: more
+    # vnodes = smoother key spread, slightly larger ring.
+    "fleet_vnodes": _env_named("SRML_FLEET_VNODES", 64, int),
+    # How long a rollout waits for the retired version's in-flight
+    # requests to finish before dropping its registrations; a timeout
+    # leaves them registered (memory) rather than yanking arrays out
+    # from under a live request (correctness).
+    "fleet_drain_timeout_s": _env_named(
+        "SRML_FLEET_DRAIN_TIMEOUT_S", 30.0, float
+    ),
+    # Versioned-serving fence (serve/daemon.py): a serving request
+    # whose additive `version` field disagrees with the registration's
+    # pinned version is refused (True, default) or answered with a
+    # warning (False — debugging only; the answer is the WRONG model's).
+    "serve_version_strict": _env_named(
+        "SRML_SERVE_VERSION_STRICT", True, _as_bool
+    ),
     # Served-model registry cap (0 = unbounded): past it, the least-
     # recently-used re-creatable registration is evicted (clients
     # re-register on miss); daemon-built KNN indexes are evicted only

@@ -103,14 +103,19 @@ class DataPlaneClient:
         max_op_attempts: int = 5,
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
-        max_busy_wait_s: float = 60.0,
+        max_busy_wait_s: Optional[float] = None,
         trace_ctx: Optional[Dict[str, str]] = None,
     ):
         """``timeout`` bounds one socket syscall; ``op_deadline_s`` bounds
         one whole op including every reconnect/replay/busy-wait (None =
         attempts alone bound it); ``max_op_attempts`` counts connection
         failures per op; ``max_busy_wait_s`` caps cumulative busy-shed
-        waiting per op when no deadline is set.
+        waiting per op. Its default (None) resolves to 60 s when NO
+        deadline is set and to the deadline alone otherwise (a caller
+        who budgeted 300 s must not be silently capped at 60); an
+        EXPLICIT value is enforced alongside any deadline — a
+        fleet-routed client sets it to 0 so a shed surfaces to the
+        router immediately (serve/router.py).
 
         ``trace_ctx``: a fixed ``{"run", "span"}`` distributed-tracing
         context stamped on every request (additive wire field,
@@ -128,7 +133,12 @@ class DataPlaneClient:
         self._max_attempts = max(1, int(max_op_attempts))
         self._backoff_base = backoff_base_s
         self._backoff_max = backoff_max_s
-        self._max_busy_wait = max_busy_wait_s
+        # None = default policy: 60 s cap when no deadline bounds the op,
+        # deadline-only otherwise. Explicit values always enforce.
+        self._busy_wait_explicit = max_busy_wait_s is not None
+        self._max_busy_wait = (
+            60.0 if max_busy_wait_s is None else float(max_busy_wait_s)
+        )
         self._trace_ctx = trace_ctx
         self._rng = random.Random()
         # Feed/step idempotency nonce: replayed ops carry the same id, so
@@ -295,11 +305,19 @@ class DataPlaneClient:
                 self._reset()
                 wait = e.retry_after_s * (0.5 + self._rng.random())
                 now = time.monotonic()
-                if deadline is not None:
-                    if now + wait > deadline:
-                        _M_DEADLINE_EXPIRIES.inc(op=str(req.get("op")))
-                        raise
-                elif busy_waited + wait > self._max_busy_wait:
+                if deadline is not None and now + wait > deadline:
+                    _M_DEADLINE_EXPIRIES.inc(op=str(req.get("op")))
+                    raise
+                if (
+                    deadline is None or self._busy_wait_explicit
+                ) and busy_waited + wait > self._max_busy_wait:
+                    # The cap binds when it is the only bound (no
+                    # deadline) or the caller set it EXPLICITLY — a
+                    # fleet-routed client passes 0 so a shed surfaces
+                    # immediately and the ROUTER retries elsewhere,
+                    # deadline notwithstanding (serve/router.py). A
+                    # default-cap client with a 300 s deadline keeps its
+                    # full budget.
                     raise
                 self.stats["busy_waits"] += 1
                 busy_waited += wait
@@ -743,14 +761,19 @@ class DataPlaneClient:
         algo: str,
         arrays: Dict[str, np.ndarray],
         params: Optional[Dict[str, Any]] = None,
+        version: Optional[int] = None,
     ) -> bool:
         """Register a fitted model for serving (idempotent; first caller
         wins). ``arrays`` is the model's ``_model_data()`` payload; raw
         array frames follow the JSON header, mirroring the finalize
-        response framing. Returns True when this call created it."""
+        response framing. ``version`` (additive) pins the registration
+        to a fleet model version — immutable under the name; serving
+        requests carrying a different ``version`` are refused
+        (docs/protocol.md "Fleet & versioned serving"). Returns True
+        when this call created it."""
         resp = self._send_arrays_op(
             {"op": "ensure_model", "model": name, "algo": algo,
-             "params": params or {}},
+             "params": params or {}, "version": version},
             arrays,
         )
         return bool(resp["created"])
@@ -766,6 +789,9 @@ class DataPlaneClient:
         input_col: str = "features",
         n_cols: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        version: Optional[int] = None,
+        fleet_epoch: Optional[int] = None,
+        with_meta: bool = False,
     ) -> Dict[str, np.ndarray]:
         """Run a registered model over one batch on the daemon's devices.
         ``data``: Arrow Table/RecordBatch or (n, d) ndarray. Returns the
@@ -773,18 +799,29 @@ class DataPlaneClient:
         e.g. {"output": ...} for PCA, {"prediction": ...} for KMeans).
         ``deadline_s`` (additive): the request's latency budget hint —
         a batching daemon sheds it with `busy` when its backlog would
-        already miss it (docs/protocol.md "Serving scheduler")."""
-        _, arrays = self._op(
+        already miss it (docs/protocol.md "Serving scheduler").
+        ``version``/``fleet_epoch`` (additive): the fleet routing pin —
+        a versioned replica REFUSES a mismatched ``version`` instead of
+        answering from the wrong model, and echoes both fields on the
+        ack (docs/protocol.md "Fleet & versioned serving"). With
+        ``with_meta`` the return is ``(arrays, meta)`` where ``meta``
+        carries the ack's additive fields (``version``, ``fleet_epoch``)."""
+        resp, arrays = self._op(
             {
                 "op": "transform",
                 "model": name,
                 "input_col": input_col,
                 "n_cols": n_cols,
                 "deadline_s": deadline_s,
+                "version": version,
+                "fleet_epoch": fleet_epoch,
             },
             payload=self._to_ipc(data, input_col, "label"),
             want_arrays=True,
         )
+        if with_meta:
+            meta = {k: v for k, v in resp.items() if k not in ("ok", "arrays")}
+            return arrays, meta
         return arrays
 
     def warmup(
@@ -875,10 +912,13 @@ class DataPlaneClient:
         input_col: str = "features",
         n_cols: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        version: Optional[int] = None,
+        fleet_epoch: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Query a daemon-registered index: returns (distances (q, k),
         indices (q, k)) with global partition-major row ids.
-        ``deadline_s``: latency-budget hint, see :meth:`transform`."""
+        ``deadline_s``: latency-budget hint; ``version``/``fleet_epoch``:
+        the fleet routing pin — see :meth:`transform`."""
         _, arrays = self._op(
             {
                 "op": "kneighbors",
@@ -887,6 +927,8 @@ class DataPlaneClient:
                 "input_col": input_col,
                 "n_cols": n_cols,
                 "deadline_s": deadline_s,
+                "version": version,
+                "fleet_epoch": fleet_epoch,
             },
             payload=self._to_ipc(queries, input_col, "label"),
             want_arrays=True,

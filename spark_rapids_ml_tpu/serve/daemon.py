@@ -10,13 +10,20 @@ dispatch itself single-files through a process-wide ``_DEVICE_LOCK`` —
 one process owns the host's chips, concurrent sharded programs on one
 device set buy nothing and can deadlock the CPU backend outright.
 
-Serving plane: with ``serve_batching`` on, concurrent ``transform``/
-``kneighbors`` requests do NOT dispatch per connection — they queue into
-the micro-batching scheduler (serve/scheduler.py), which coalesces them
-across connections per model, pads to the bucket ladder, runs ONE device
-dispatch, and scatters per-request slices back. Admission overflow and
-deadline misses are shed with the existing busy/retry_after_s contract;
-the additive ``warmup`` op pre-compiles the ladder.
+Serving plane: with ``serve_batching`` on (the DEFAULT since the fleet
+PR — ``SRML_SERVE_BATCHING=0`` is the documented opt-out), concurrent
+``transform``/``kneighbors`` requests do NOT dispatch per connection —
+they queue into the micro-batching scheduler (serve/scheduler.py), which
+coalesces them across connections per model, pads to the bucket ladder,
+runs ONE device dispatch, and scatters per-request slices back.
+Admission overflow and deadline misses are shed with the existing
+busy/retry_after_s contract; the additive ``warmup`` op pre-compiles the
+ladder. Fleet deployments (serve/fleet.py) additionally register models
+under VERSIONED names and stamp requests with the expected
+``(version, fleet_epoch)``; this daemon enforces the version pin
+(``serve_version_strict``) and echoes it on every serving ack, so a
+replica that missed a rollout refuses instead of answering from the
+wrong arrays (docs/protocol.md "Fleet & versioned serving").
 
 Jobs: "pca" folds (count, Σx, XᵀX); "linreg" folds (XᵀX, Xᵀy, Σx, Σy,
 Σy², n). ``finalize`` runs the algorithm's shared finalize (eigensolve /
@@ -1615,6 +1622,11 @@ class _ServedModel:
         self.id_map = None
         # Re-creatable registration (client holds the arrays): plain TTL.
         self.ttl_scale = 1.0
+        # Fleet version pin (docs/protocol.md "Fleet & versioned
+        # serving"): None = unversioned (the pre-fleet registration).
+        # Immutable once set — a version under one name never changes;
+        # new versions get new names (the fleet's `model@vN` convention).
+        self.version: Optional[int] = None
 
     @classmethod
     def from_model(
@@ -1636,6 +1648,7 @@ class _ServedModel:
         obj.touched = clock()
         obj.id_map = None if id_map is None else np.asarray(id_map, np.int64)
         obj.ttl_scale = 8.0
+        obj.version = None
         return obj
 
     def transform(self, x: np.ndarray) -> Dict[str, np.ndarray]:
@@ -1682,7 +1695,13 @@ def _model_width(algo: str, arrays: Dict[str, np.ndarray]) -> Optional[int]:
             c = np.asarray(arrays["coefficients"])
             return int(c.shape[-1] if c.ndim == 2 else c.shape[0])
         if algo == "kmeans":
-            return int(np.asarray(arrays["centers"]).shape[1])
+            # The wire payload key is the Spark-facing "clusterCenters"
+            # (models/kmeans._model_data); "centers" kept as a fallback
+            # for hand-built payloads.
+            c = arrays.get("clusterCenters")
+            if c is None:
+                c = arrays["centers"]
+            return int(np.asarray(c).shape[1])
     except (KeyError, IndexError):
         return None
     return None
@@ -2586,54 +2605,86 @@ class DataPlaneDaemon:
                     f"first kmeans batch has {x.shape[0]} rows < k={k_req}; "
                     f"feed a larger first batch (it seeds the centers)"
                 )
-        created = False
-        if job is None:
-            with self._jobs_lock:
-                job = self._jobs.get(name)
-                created = job is None
-                if created:
-                    job = _Job(req_algo, x.shape[1], self._mesh,
-                               req.get("params"), clock=self._clock)
-                    self._attach_durability(name, job)
-                    self._jobs[name] = job
-        if job.algo != req_algo:
-            raise ValueError(
-                f"job {name!r} is algo {job.algo!r}; feed requested {req_algo!r}"
-            )
-        if req_algo == "logreg":
-            if req_classes != getattr(job, "n_classes", 2):
-                raise ValueError(
-                    f"job {name!r} has n_classes={job.n_classes}; "
-                    f"feed carried n_classes={req_classes}"
-                )
         part = req.get("partition")
-        try:
-            job.fold(
-                x,
-                y,
-                partition=None if part is None else int(part),
-                attempt=int(_opt(req, "attempt", 0)),
-                pass_id=req.get("pass_id"),
-                feed_id=req.get("feed_id"),
-            )
-        except ValueError:
-            if created:
-                # A job whose very FIRST fold was rejected (mid-fit pass_id
-                # on a daemon that never saw the job, label validation …)
-                # must not stay parked under the name until TTL — every
-                # Spark retry of that task would create-then-fail again
-                # against the orphan's pass-0 state (round-4 advisor).
+        for retry in (False, True):
+            created = False
+            if job is None:
                 with self._jobs_lock:
-                    if self._jobs.get(name) is job:
-                        with job.lock:
-                            if (
-                                job.rows == 0
-                                and not job.staged
-                                and not job.committed
-                            ):
-                                job.dropped = True
-                                del self._jobs[name]
-            raise
+                    job = self._jobs.get(name)
+                    created = job is None
+                    if created:
+                        job = _Job(req_algo, x.shape[1], self._mesh,
+                                   req.get("params"), clock=self._clock)
+                        self._attach_durability(name, job)
+                        self._jobs[name] = job
+            if job.algo != req_algo:
+                raise ValueError(
+                    f"job {name!r} is algo {job.algo!r}; feed requested "
+                    f"{req_algo!r}"
+                )
+            if req_algo == "logreg":
+                if req_classes != getattr(job, "n_classes", 2):
+                    raise ValueError(
+                        f"job {name!r} has n_classes={job.n_classes}; "
+                        f"feed carried n_classes={req_classes}"
+                    )
+            try:
+                job.fold(
+                    x,
+                    y,
+                    partition=None if part is None else int(part),
+                    attempt=int(_opt(req, "attempt", 0)),
+                    pass_id=req.get("pass_id"),
+                    feed_id=req.get("feed_id"),
+                )
+                break
+            except ValueError:
+                if created:
+                    # A job whose very FIRST fold was rejected (mid-fit
+                    # pass_id on a daemon that never saw the job, label
+                    # validation …) must not stay parked under the name
+                    # until TTL — every Spark retry of that task would
+                    # create-then-fail again against the orphan's pass-0
+                    # state (round-4 advisor).
+                    with self._jobs_lock:
+                        if self._jobs.get(name) is job:
+                            with job.lock:
+                                if (
+                                    job.rows == 0
+                                    and not job.staged
+                                    and not job.committed
+                                ):
+                                    job.dropped = True
+                                    del self._jobs[name]
+                raise
+            except KeyError:
+                # fold met dropped=True. Usually that is a legitimately
+                # finalized/aborted job — but the rejected-first-feed
+                # cleanup above can RACE a concurrent valid first feed
+                # (ADVICE r5): this thread fetched the job, a sibling's
+                # rejected first fold then dropped-and-deleted it while
+                # still empty, and our fold hit the tombstone. The
+                # victim is identifiable — the drop only ever fires on
+                # an EMPTY job that has also left the registry — so
+                # re-resolve against the live registry and retry once
+                # instead of failing a valid feed with a spurious error.
+                if retry or created:
+                    raise
+                with job.lock:
+                    empty = (
+                        job.rows == 0
+                        and not job.staged
+                        and not job.committed
+                    )
+                with self._jobs_lock:
+                    gone = self._jobs.get(name) is not job
+                if not (empty and gone):
+                    raise
+                logger.info(
+                    "feed into job %r raced a rejected-first-feed "
+                    "cleanup; retrying against the live registry", name,
+                )
+                job = None
         protocol.send_json(
             conn, {"ok": True, "rows": job.rows, **self._identity()}
         )
@@ -2962,11 +3013,17 @@ class DataPlaneDaemon:
         name = str(req["model"])
         algo = str(req["algo"])
         params = _opt(req, "params", {})
+        # Additive fleet field: the registration's immutable version pin
+        # (docs/protocol.md "Fleet & versioned serving").
+        version = req.get("version")
+        version = None if version is None else int(version)
         with self._models_lock:
             existing = self._models.get(name)
             if existing is None:
-                self._models[name] = _ServedModel(algo, arrays, params,
-                                                  clock=self._clock)
+                served = _ServedModel(algo, arrays, params,
+                                      clock=self._clock)
+                served.version = version
+                self._models[name] = served
                 created = True
                 evicted = self._enforce_model_cap_locked(keep=name)
             else:
@@ -2975,6 +3032,23 @@ class DataPlaneDaemon:
                         f"model {name!r} is algo {existing.algo!r}; "
                         f"ensure_model requested {algo!r}"
                     )
+                if (
+                    version is not None
+                    and existing.version is not None
+                    and existing.version != version
+                ):
+                    # A version is IMMUTABLE under a name: silently
+                    # accepting a re-register with different arrays
+                    # would let two fleets' flips race into serving
+                    # mixed versions under one key.
+                    raise ValueError(
+                        f"model {name!r} is registered at version "
+                        f"{existing.version}; ensure_model carried "
+                        f"version {version} — versions are immutable, "
+                        "register the new version under its own name"
+                    )
+                if existing.version is None and version is not None:
+                    existing.version = version  # adopt the late pin
                 existing.touched = existing._clock()
                 created = False
                 evicted = []
@@ -3027,6 +3101,41 @@ class DataPlaneDaemon:
             )
             return None
 
+    @staticmethod
+    def _version_fence(req: Dict[str, Any], name: str, served
+                       ) -> Dict[str, Any]:
+        """Fleet version pin (docs/protocol.md "Fleet & versioned
+        serving"): when the request carries the additive ``version``
+        field and this registration is versioned, a mismatch is refused
+        (``serve_version_strict``, default on) — the replica missed a
+        rollout or the router's table is stale; answering quietly would
+        hand back the WRONG MODEL's numbers. Returns the ack's echo
+        fields: the registration's version plus the request's
+        ``fleet_epoch``, so every response names the exact (model,
+        version, epoch) that produced it."""
+        from spark_rapids_ml_tpu import config
+
+        want = req.get("version")
+        if (
+            want is not None
+            and served.version is not None
+            and int(want) != served.version
+        ):
+            msg = (
+                f"version mismatch on model {name!r}: request expects "
+                f"v{int(want)}, this replica serves v{served.version} — "
+                "a missed rollout or a stale routing table"
+            )
+            if bool(config.peek("serve_version_strict")):
+                raise ValueError(msg)
+            logger.warning("%s (serve_version_strict off: answering)", msg)
+        echo: Dict[str, Any] = {}
+        if served.version is not None:
+            echo["version"] = served.version
+        if req.get("fleet_epoch") is not None:
+            echo["fleet_epoch"] = int(req["fleet_epoch"])
+        return echo
+
     def _serve_dispatch(
         self, conn, req: Dict[str, Any], kind: str, name: str, served, x,
         k: Optional[int] = None,
@@ -3038,7 +3147,17 @@ class DataPlaneDaemon:
         drained — framing stays aligned)."""
         sched = self._scheduler
         if sched is not None:
-            if sched.eligible(int(x.shape[0])):
+            # IVF/ANN kneighbors NEVER coalesce: the capacity-bucketed
+            # candidate search shares per-list query slots across the
+            # whole batch (models/knn.py "bucket (query, list) pairs ...
+            # capacity C"), so co-batched — or scheduler-padded — rows
+            # can EVICT a real query's candidates and change its
+            # answer. Solo dispatch keeps the request's own rows the
+            # only capacity holders (bitwise-exact), and the model's
+            # internal query bucketer still bounds compiles. Exact-KNN
+            # and every transform stay row-wise and batchable.
+            ann = kind == "kneighbors" and getattr(served, "algo", "") == "ann"
+            if not ann and sched.eligible(int(x.shape[0])):
                 try:
                     return sched.submit(
                         name, served, kind, x, k=k,
@@ -3120,11 +3239,13 @@ class DataPlaneDaemon:
         x = table_column_to_matrix(
             table, _opt(req, "input_col", "features"), req.get("n_cols")
         )
+        echo = self._version_fence(req, name, served)
         outs = self._serve_dispatch(conn, req, "transform", name, served, x)
         if outs is None:
             return  # shed with busy; the client retries
         _send_arrays_counted(
-            conn, "transform", outs, {"ok": True, "rows": int(x.shape[0])}
+            conn, "transform", outs,
+            {"ok": True, "rows": int(x.shape[0]), **echo},
         )
 
     def _op_kneighbors(self, conn, req: Dict[str, Any]) -> None:
@@ -3150,6 +3271,7 @@ class DataPlaneDaemon:
         q = table_column_to_matrix(
             table, _opt(req, "input_col", "features"), req.get("n_cols")
         )
+        echo = self._version_fence(req, name, served)
         k = _resolve_k(served, req.get("k"))
         res = self._serve_dispatch(
             conn, req, "kneighbors", name, served, q, k=k,
@@ -3162,7 +3284,7 @@ class DataPlaneDaemon:
             "kneighbors",
             {"distances": np.asarray(dists, np.float64),
              "indices": np.asarray(idx, np.int64)},
-            {"ok": True, "rows": int(q.shape[0])},
+            {"ok": True, "rows": int(q.shape[0]), **echo},
         )
 
     def _op_finalize(self, conn, req: Dict[str, Any]) -> None:

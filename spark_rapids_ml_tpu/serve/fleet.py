@@ -1,0 +1,344 @@
+"""Fleet control plane: replicated models + zero-downtime version rollout.
+
+serve/router.py routes requests; this module manages WHAT they route to:
+one model registered as versioned replicas on N daemons, and the
+register → warm → flip → drain sequence that swaps a live model version
+without dropping a request (ROADMAP item 3; docs/protocol.md "Fleet &
+versioned serving").
+
+The lifecycle of one rollout, v1 → v2:
+
+1. **register v2** under its versioned daemon name (``model@v2`` — the
+   routing table's ``reg_name`` convention) on every live replica. v1
+   keeps serving untouched; a replica that fails registration is marked
+   dead (the router already skips it) and the rollout proceeds with the
+   rest — a fleet with one dead member must still be upgradeable.
+2. **warm** each registration through the PR 5/7 warmup ladder (the
+   ``warmup`` wire op; with ``serve_warmup_on_register`` the daemon did
+   it inside the registration ack already and this pass is a no-op),
+   so the first routed v2 request is a dispatch, not a jit compile.
+3. **atomically flip**: one ``RoutingTable.activate`` call moves the
+   active version and bumps the fleet epoch. Requests that snapshotted
+   before the flip finish on v1 (their pinned version); requests after
+   it route to v2. No request ever sees a mixed state: the snapshot is
+   one lock-protected read, and the versioned daemon names make
+   cross-version answers structurally impossible.
+4. **drain v1**: wait (``fleet_drain_timeout_s``) for the in-flight v1
+   refcount to reach zero, then ``drop_model`` v1 everywhere and retire
+   it from the table. A drain timeout leaves v1 registered (and says
+   so) rather than yanking arrays out from under a live request.
+
+``ModelFleet`` is the driver/operator-side object; it is single-threaded
+like the admin clients it holds. Serving traffic goes through
+``fleet.client()`` — one :class:`~.router.FleetClient` per worker
+thread, all sharing this fleet's routing table and health view.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+from spark_rapids_ml_tpu.serve.daemon import _model_width
+from spark_rapids_ml_tpu.serve.router import FleetClient, RoutingTable
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.fleet")
+
+__all__ = ["ModelFleet", "FleetRolloutError"]
+
+#: Fleet control-plane telemetry (docs/observability.md).
+_M_REPLICAS = metrics_mod.gauge(
+    "srml_fleet_replicas",
+    "Replicas serving a model's active version, by model (set at "
+    "register/rollout time)",
+)
+_M_EPOCH = metrics_mod.gauge(
+    "srml_fleet_version_epoch",
+    "The fleet routing epoch, by model (bumps on every version flip)",
+)
+_M_REGISTRATIONS = metrics_mod.counter(
+    "srml_fleet_registrations_total",
+    "Per-replica version registrations, by outcome (ok|error)",
+)
+_M_ROLLOUTS = metrics_mod.counter(
+    "srml_fleet_rollouts_total",
+    "Version rollouts, by outcome (ok|partial — some replica failed "
+    "registration and was routed around)",
+)
+_M_DRAINS = metrics_mod.counter(
+    "srml_fleet_drains_total",
+    "Retired-version drains, by outcome (drained|timeout)",
+)
+
+
+class FleetRolloutError(RuntimeError):
+    """No replica accepted the new version — the rollout did NOT flip;
+    the old version keeps serving."""
+
+
+
+
+class ModelFleet:
+    """Replicated versioned model serving across N daemons.
+
+    ``endpoints``: ``[(host, port)]`` (or ``"host:port"`` strings) of
+    the replica daemons. All replicas are equals — there is no primary;
+    the consistent-hash ring (router.py) spreads models and traffic.
+    """
+
+    def __init__(
+        self,
+        endpoints,
+        token: Optional[str] = None,
+        vnodes: Optional[int] = None,
+        client_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        self._table = RoutingTable(endpoints, vnodes=vnodes)
+        self._token = token
+        # Admin-op client settings: fail a dead replica in seconds (it
+        # gets marked dead and routed around), don't heal for minutes.
+        kw: Dict[str, Any] = {
+            "timeout": 10.0, "op_deadline_s": 20.0, "max_op_attempts": 2,
+        }
+        kw.update(client_kwargs or {})
+        self._client_kwargs = kw
+        self._clients: Dict[str, DataPlaneClient] = {}
+        self._lock = threading.Lock()  # serializes admin ops per fleet
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def table(self) -> RoutingTable:
+        return self._table
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def client(self, **kwargs) -> FleetClient:
+        """A routing client sharing this fleet's table and health view.
+        One per worker thread (FleetClient is single-threaded)."""
+        kwargs.setdefault("token", self._token)
+        return FleetClient(self._table, **kwargs)
+
+    def _client(self, key: str) -> DataPlaneClient:
+        c = self._clients.get(key)
+        if c is None:
+            r = self._table.replica(key)
+            c = DataPlaneClient(
+                r.host, r.port, token=self._token, **self._client_kwargs
+            )
+            self._clients[key] = c
+        return c
+
+    # -- registration + rollout --------------------------------------------
+
+    def _register_on_replicas(
+        self, model: str, version: int, algo: str,
+        arrays: Dict[str, np.ndarray], params: Dict[str, Any],
+        warm: bool,
+    ) -> Dict[str, List[str]]:
+        """Register (and optionally warm) one version on every replica.
+        Returns {"ok": [replica keys], "failed": [replica keys]}; failed
+        replicas are marked dead so the router skips them."""
+        reg_name = self._table.reg_name(model, version)
+        # The daemon's own registration-width rule (ONE copy — a drifted
+        # mirror here would silently skip the warmup for an algo whose
+        # payload key changed); None skips the eager warmup.
+        width = _model_width(algo, arrays)
+        ok: List[str] = []
+        failed: List[str] = []
+        for r in self._table.replicas():
+            try:
+                c = self._client(r.key)
+                c.ensure_model(
+                    reg_name, algo, arrays, params=params, version=version,
+                )
+                if warm and width is not None:
+                    # The PR 5/7 bucket-ladder pre-compile. On a daemon
+                    # that already warmed inside ensure_model
+                    # (serve_warmup_on_register) this reports compiled=0;
+                    # with batching disabled it is an honest no-op.
+                    c.warmup(reg_name, n_cols=width, dtype="float32")
+                self._table.mark_alive(r.key)
+                _M_REGISTRATIONS.inc(outcome="ok")
+                ok.append(r.key)
+            except (OSError, protocol.ProtocolError, RuntimeError) as e:
+                _M_REGISTRATIONS.inc(outcome="error")
+                self._table.mark_dead(
+                    r.key, f"registration of {reg_name} failed: {e}",
+                    recheck_s=1.0,
+                )
+                logger.warning(
+                    "replica %s failed %s v%d registration (marked dead, "
+                    "routing around it): %s", r.key, model, version, e,
+                )
+                failed.append(r.key)
+        return {"ok": ok, "failed": failed}
+
+    def register(
+        self,
+        model: str,
+        algo: str,
+        arrays: Dict[str, np.ndarray],
+        params: Optional[Dict[str, Any]] = None,
+        version: int = 1,
+        warm: bool = True,
+    ) -> Dict[str, Any]:
+        """Register a model's FIRST served version on every replica and
+        activate it. Returns ``{"version", "epoch", "replicas",
+        "failed"}``. Raises :class:`FleetRolloutError` when no replica
+        accepted it (the table stays without an active version)."""
+        with self._lock:
+            version = int(version)
+            self._table.install(model, version, algo, arrays, params)
+            res = self._register_on_replicas(
+                model, version, algo, arrays, dict(params or {}), warm
+            )
+            if not res["ok"]:
+                self._table.retire(model, version)
+                raise FleetRolloutError(
+                    f"no replica accepted {model!r} v{version} "
+                    f"({len(res['failed'])} failed)"
+                )
+            epoch = self._table.activate(model, version)
+            _M_REPLICAS.set(len(res["ok"]), model=model)
+            _M_EPOCH.set(epoch, model=model)
+            return {
+                "version": version, "epoch": epoch,
+                "replicas": len(res["ok"]), "failed": res["failed"],
+            }
+
+    def rollout(
+        self,
+        model: str,
+        algo: str,
+        arrays: Dict[str, np.ndarray],
+        params: Optional[Dict[str, Any]] = None,
+        version: Optional[int] = None,
+        warm: bool = True,
+        drain_timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Zero-downtime version swap (module docstring): register the
+        next version everywhere, warm it, atomically flip, drain and
+        drop the old one. Returns ``{"version", "previous", "epoch",
+        "replicas", "failed", "drained"}``."""
+        from spark_rapids_ml_tpu import config
+
+        with self._lock:
+            old_v, _, old_reg = self._table.snapshot(model)
+            new_v = int(version) if version is not None else old_v + 1
+            if new_v == old_v:
+                raise ValueError(
+                    f"rollout version {new_v} is already the active "
+                    f"version of {model!r}"
+                )
+            self._table.install(model, new_v, algo, arrays, params)
+            res = self._register_on_replicas(
+                model, new_v, algo, arrays, dict(params or {}), warm
+            )
+            if not res["ok"]:
+                # Nothing flipped: v_old keeps serving, the failed
+                # install is retired so a retry starts clean.
+                self._table.retire(model, new_v)
+                _M_ROLLOUTS.inc(outcome="error")
+                raise FleetRolloutError(
+                    f"no replica accepted {model!r} v{new_v}; "
+                    f"v{old_v} keeps serving"
+                )
+            # THE flip: one atomic table write. Every request from here
+            # snapshots v_new; every in-flight request keeps its v_old
+            # pin and its v_old daemon registration.
+            epoch = self._table.activate(model, new_v)
+            _M_REPLICAS.set(len(res["ok"]), model=model)
+            _M_EPOCH.set(epoch, model=model)
+            _M_ROLLOUTS.inc(outcome="ok" if not res["failed"] else "partial")
+            logger.info(
+                "flipped %s to v%d (epoch %d) on %d replica(s)",
+                model, new_v, epoch, len(res["ok"]),
+            )
+            # Drain: let pinned v_old requests finish before their
+            # arrays are dropped. A timeout leaves v_old registered —
+            # stale registrations cost memory, yanked arrays cost
+            # correctness.
+            timeout = float(
+                config.get("fleet_drain_timeout_s")
+                if drain_timeout_s is None else drain_timeout_s
+            )
+            drained = self._table.wait_drained(model, old_v, timeout)
+            _M_DRAINS.inc(outcome="drained" if drained else "timeout")
+            if drained:
+                for r in self._table.replicas():
+                    try:
+                        self._client(r.key).drop_model(old_reg)
+                    except (OSError, protocol.ProtocolError, RuntimeError):
+                        pass  # dead replica: its registry died with it
+                self._table.retire(model, old_v)
+            else:
+                logger.warning(
+                    "drain of %s v%d timed out after %.1fs with %d "
+                    "request(s) in flight; its registrations stay up",
+                    model, old_v, timeout,
+                    self._table.inflight(model, old_v),
+                )
+            return {
+                "version": new_v, "previous": old_v, "epoch": epoch,
+                "replicas": len(res["ok"]), "failed": res["failed"],
+                "drained": drained,
+            }
+
+    # -- observability ------------------------------------------------------
+
+    def status(self, model: Optional[str] = None) -> Dict[str, Any]:
+        """Operator view: per-replica liveness/health plus (with
+        ``model``) which replicas hold the active version's
+        registration. Polls health live; a dead replica reports its
+        last error instead."""
+        versions: Dict[str, Any] = {}
+        reg_name = None
+        if model is not None:
+            try:
+                v, e, reg_name = self._table.snapshot(model)
+                versions = {
+                    "active": v, "epoch": e,
+                    "installed": self._table.versions(model),
+                }
+            except KeyError:
+                versions = {"active": None, "epoch": 0, "installed": []}
+        replicas = {}
+        for r in self._table.replicas():
+            entry: Dict[str, Any] = {"alive": r.alive}
+            try:
+                h = self._client(r.key).health()
+                self._table.mark_alive(r.key, h)
+                entry["alive"] = True
+                entry["health"] = {
+                    k: h.get(k) for k in
+                    ("id", "boot_id", "queue_depth", "served_models", "busy")
+                }
+                if reg_name is not None:
+                    entry["has_active_version"] = bool(
+                        self._client(r.key).model_exists(reg_name)
+                    )
+            except (OSError, protocol.ProtocolError, RuntimeError) as e:
+                self._table.mark_dead(r.key, str(e), recheck_s=1.0)
+                entry["alive"] = False
+                entry["error"] = str(e)
+            replicas[r.key] = entry
+        out: Dict[str, Any] = {"replicas": replicas}
+        if model is not None:
+            out["model"] = {"name": model, **versions}
+        return out

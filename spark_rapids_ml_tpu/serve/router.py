@@ -1,0 +1,647 @@
+"""Client-side fleet routing: consistent hashing, failover, version pinning.
+
+One daemon serves one host's devices; millions of users need N of them.
+This module is the CLIENT half of the fleet layer (serve/fleet.py is the
+control plane): a :class:`FleetClient` that routes each ``transform``/
+``kneighbors`` request to one of N replica daemons — the Podracer/Anakin
+split of a learner plane from a horizontally-scaled inference plane
+(PAPERS.md 2104.06272), with the routing decision pushed into the client
+so the fleet needs no load-balancer tier in front of it.
+
+Routing (docs/protocol.md "Fleet & versioned serving"):
+
+* **Consistent hashing.** Replicas are points on a hash ring
+  (``fleet_vnodes`` virtual nodes each, keyed by a stable digest — not
+  Python's salted ``hash``). A request's ``route_key`` (caller-supplied:
+  a user id, a session id; default: a fresh per-request nonce, which
+  spreads load uniformly) picks the primary replica. Sticky keys give
+  cache affinity (a replica's jit caches and scheduler ladder stay hot
+  for the traffic hashed to it); adding or removing a replica moves only
+  ~1/N of the key space.
+* **Least-loaded failover.** When the primary sheds with ``busy`` or is
+  dead, the request fails over to the least-loaded remaining replica —
+  load read from polled ``health`` snapshots (``queue_depth`` + the
+  scheduler's queued count), refreshed at most every
+  ``fleet_health_poll_s`` seconds. A replica that fails at the transport
+  level is marked dead and skipped until the same interval re-probes it.
+* **Exactly-once.** The serving ops are PURE reads of a registered
+  model, so a failover retry of a request whose first attempt may have
+  reached a dying daemon cannot double-apply anything — the same
+  property the feed path earns with ``feed_id`` dedupe, the serving
+  path gets by construction. The router still returns exactly one
+  response per request, and the underlying :class:`DataPlaneClient`
+  healing (reconnect/backoff/deadline, PR 2) runs per attempt.
+* **Version pinning.** Every request captures ONE ``(version, epoch)``
+  snapshot of the routing table before it routes and stamps it on the
+  wire; replicas echo — and with ``serve_version_strict`` enforce — the
+  registered version, so a request is never answered by a mixed-epoch
+  replica: retries and failovers of one request stay on the version it
+  started on, and a replica holding a different version under the
+  routed name refuses instead of answering quietly.
+
+The router journals each routed request as a ``router.<op>`` span
+(model/version/replica fields) on the calling thread, so the daemon-side
+``daemon.transform`` spans — stamped via the client's ``trace_ctx``
+(PR 6) — parent under it and one fleet request traces as one tree.
+
+Thread model: a :class:`FleetClient` is single-threaded like the
+:class:`DataPlaneClient` it wraps (one socket per replica); give each
+worker thread its own (``ModelFleet.client()`` is cheap — the routing
+table and its health view are shared and thread-safe).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serve import protocol
+from spark_rapids_ml_tpu.serve.client import DaemonBusy, DataPlaneClient
+from spark_rapids_ml_tpu.utils import journal
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.router")
+
+__all__ = [
+    "ConsistentHashRing",
+    "FleetClient",
+    "FleetUnavailable",
+    "RoutingTable",
+]
+
+#: Router telemetry (docs/observability.md catalogs all of these).
+_M_REQUESTS = metrics_mod.counter(
+    "srml_router_requests_total",
+    "Fleet-routed serving requests, by op and outcome (ok|unroutable)",
+)
+_M_REQ_SECONDS = metrics_mod.histogram(
+    "srml_router_request_seconds",
+    "End-to-end routed request latency (all failover attempts), by op",
+)
+_M_FAILOVERS = metrics_mod.counter(
+    "srml_router_failovers_total",
+    "Requests rerouted off a replica, by reason (busy|dead|error)",
+)
+_M_HEALTH_REFRESHES = metrics_mod.counter(
+    "srml_router_health_refreshes_total",
+    "Replica health polls issued by the router, by outcome (ok|dead)",
+)
+_M_REPAIRS = metrics_mod.counter(
+    "srml_router_repairs_total",
+    "Replicas re-registered in-band after answering 'no such model' "
+    "(a restarted replica lost its registry; the routing table re-seeds "
+    "it from the fleet's stored model payload)",
+)
+
+
+class FleetUnavailable(RuntimeError):
+    """Every candidate replica refused (busy/dead/error) within the
+    failover budget. Carries the last per-replica error as context."""
+
+
+def _h64(s: str) -> int:
+    """Stable 64-bit point on the ring. Python's ``hash`` is salted per
+    process — two clients would disagree about the whole ring."""
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8], "big")
+
+
+class ConsistentHashRing:
+    """The standard fixed ring: each replica key contributes ``vnodes``
+    points; a request key routes to the first point clockwise. Immutable
+    — membership changes (a dead replica) are handled by SKIPPING at
+    route time, not rebuilding, so a flapping daemon cannot churn every
+    client's key→replica mapping."""
+
+    def __init__(self, keys, vnodes: int = 64):
+        keys = list(keys)
+        if not keys:
+            raise ValueError("hash ring needs at least one replica key")
+        points = []
+        for k in keys:
+            for i in range(max(int(vnodes), 1)):
+                points.append((_h64(f"{k}#{i}"), k))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._keys = [k for _, k in points]
+        self._members = tuple(dict.fromkeys(keys))
+
+    @property
+    def members(self) -> Tuple[str, ...]:
+        return self._members
+
+    def primary(self, key: str) -> str:
+        """The replica owning ``key``."""
+        return self.ordered(key)[0]
+
+    def ordered(self, key: str) -> List[str]:
+        """Every member, in ring order from ``key``'s point (the
+        primary first, then the natural successor chain — the order a
+        pure ring failover would walk)."""
+        i = bisect.bisect_right(self._hashes, _h64(key)) % len(self._keys)
+        out: List[str] = []
+        seen = set()
+        for j in range(len(self._keys)):
+            k = self._keys[(i + j) % len(self._keys)]
+            if k not in seen:
+                seen.add(k)
+                out.append(k)
+                if len(out) == len(self._members):
+                    break
+        return out
+
+
+class _Replica:
+    """One fleet member: endpoint + the router-shared liveness/load view.
+    Mutated only under the owning table's lock."""
+
+    __slots__ = ("key", "host", "port", "alive", "recheck_at", "health",
+                 "health_ts", "last_error")
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+        self.key = f"{host}:{port}"
+        self.alive = True
+        self.recheck_at = 0.0  # monotonic: when a dead replica re-probes
+        self.health: Dict[str, Any] = {}
+        self.health_ts = 0.0
+        self.last_error: Optional[str] = None
+
+    def load(self) -> float:
+        """Comparable load score from the last health snapshot: open
+        connections + queued scheduler requests (both grow under
+        pressure); a busy replica sorts after every non-busy one."""
+        h = self.health
+        q = float(h.get("queue_depth", 0) or 0)
+        sched = h.get("scheduler") or {}
+        q += float(sched.get("queued", 0) or 0)
+        if h.get("busy"):
+            q += 1e6
+        return q
+
+
+class RoutingTable:
+    """The fleet's shared state: replicas + per-model version table.
+
+    One table is shared by the control plane (serve/fleet.py) and every
+    :class:`FleetClient`; all access is lock-protected and cheap. The
+    version table is the zero-downtime rollout mechanism:
+
+    * ``install`` adds a version's registration (name, payload) without
+      routing to it;
+    * ``activate`` atomically flips the active version and bumps the
+      fleet ``epoch`` — requests snapshot ``(version, epoch)`` ONCE at
+      entry, so every request is pinned to exactly one version;
+    * ``begin``/``done`` refcount in-flight requests per version, and
+      ``wait_drained`` blocks until a retired version's count reaches
+      zero — the drain barrier that lets v1 finish before it is dropped.
+    """
+
+    def __init__(self, endpoints, vnodes: Optional[int] = None):
+        from spark_rapids_ml_tpu import config
+
+        reps = []
+        for ep in endpoints:
+            if isinstance(ep, str):
+                host, _, port = ep.rpartition(":")
+                reps.append(_Replica(host or "127.0.0.1", int(port)))
+            else:
+                reps.append(_Replica(ep[0], int(ep[1])))
+        if not reps:
+            raise ValueError("a fleet needs at least one replica endpoint")
+        keys = [r.key for r in reps]
+        if len(set(keys)) != len(keys):
+            raise ValueError(f"duplicate replica endpoints: {sorted(keys)}")
+        self._replicas: Dict[str, _Replica] = {r.key: r for r in reps}
+        self.ring = ConsistentHashRing(
+            keys,
+            vnodes=int(config.get("fleet_vnodes") if vnodes is None
+                       else vnodes),
+        )
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        #: model → {"active": int|None, "epoch": int,
+        #:          "versions": {int: version-info dict}}
+        self._models: Dict[str, Dict[str, Any]] = {}
+
+    # -- replicas ----------------------------------------------------------
+
+    def replicas(self) -> List[_Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def replica(self, key: str) -> _Replica:
+        return self._replicas[key]
+
+    def mark_dead(self, key: str, error: str, recheck_s: float) -> None:
+        with self._lock:
+            r = self._replicas[key]
+            r.alive = False
+            r.last_error = error
+            r.recheck_at = time.monotonic() + max(recheck_s, 0.05)
+
+    def mark_alive(self, key: str, health: Optional[Dict[str, Any]] = None
+                   ) -> None:
+        with self._lock:
+            r = self._replicas[key]
+            r.alive = True
+            r.last_error = None
+            if health is not None:
+                r.health = health
+                r.health_ts = time.monotonic()
+
+    # -- version table -----------------------------------------------------
+
+    @staticmethod
+    def reg_name(model: str, version: int) -> str:
+        """The daemon-side registration name of one model version. The
+        '@v' convention IS the isolation mechanism: two versions are two
+        registry entries, so an in-flight v1 request addressed to
+        ``m@v1`` can never be answered from v2's arrays."""
+        return f"{model}@v{int(version)}"
+
+    def install(self, model: str, version: int, algo: str,
+                arrays: Dict[str, np.ndarray],
+                params: Optional[Dict[str, Any]] = None) -> str:
+        """Add (or refresh) a version entry without routing to it.
+        Returns the daemon registration name."""
+        version = int(version)
+        with self._lock:
+            entry = self._models.setdefault(
+                model, {"active": None, "epoch": 0, "versions": {}}
+            )
+            # Re-installing an existing version (an operator re-seeding a
+            # fleet) refreshes the payload but PRESERVES the in-flight
+            # refcount: resetting it to 0 would let a later drain declare
+            # "drained" while those requests still fly — exactly the
+            # yanked-arrays failure the barrier exists to prevent.
+            prev = entry["versions"].get(version)
+            entry["versions"][version] = {
+                "reg_name": self.reg_name(model, version),
+                "algo": str(algo),
+                "arrays": dict(arrays),
+                "params": dict(params or {}),
+                "inflight": 0 if prev is None else prev["inflight"],
+            }
+        return self.reg_name(model, version)
+
+    def activate(self, model: str, version: int) -> int:
+        """Atomically flip the model's active version; bumps and returns
+        the fleet epoch. Requests that snapshotted before the flip keep
+        their old (version, epoch) pin to completion."""
+        version = int(version)
+        with self._lock:
+            entry = self._models[model]
+            if version not in entry["versions"]:
+                raise KeyError(
+                    f"version {version} of {model!r} was never installed"
+                )
+            entry["active"] = version
+            entry["epoch"] += 1
+            return entry["epoch"]
+
+    def retire(self, model: str, version: int) -> None:
+        with self._lock:
+            entry = self._models.get(model)
+            if entry is None:
+                return
+            if entry.get("active") == int(version):
+                raise ValueError(
+                    f"cannot retire the ACTIVE version {version} of "
+                    f"{model!r}; activate a successor first"
+                )
+            entry["versions"].pop(int(version), None)
+
+    def snapshot(self, model: str) -> Tuple[int, int, str]:
+        """(active version, epoch, daemon registration name) — a
+        read-only view for control-plane callers. Requests must use
+        :meth:`acquire` instead: a snapshot alone does not hold the
+        version against a concurrent drain."""
+        with self._lock:
+            return self._snapshot_locked(model)
+
+    def _snapshot_locked(self, model: str) -> Tuple[int, int, str]:
+        entry = self._models.get(model)
+        if entry is None or entry["active"] is None:
+            raise KeyError(
+                f"no active version for model {model!r} (register it "
+                "through the fleet first)"
+            )
+        v = entry["active"]
+        return v, entry["epoch"], entry["versions"][v]["reg_name"]
+
+    def acquire(self, model: str) -> Tuple[int, int, str]:
+        """Atomically snapshot the active (version, epoch, reg_name) AND
+        take an in-flight reference on that version — ONE lock
+        acquisition, so a concurrent rollout can never flip-drain-retire
+        the version between a request's read and its refcount (the
+        zero-downtime contract's linchpin). Pair with :meth:`done`."""
+        with self._lock:
+            v, epoch, reg = self._snapshot_locked(model)
+            self._models[model]["versions"][v]["inflight"] += 1
+            return v, epoch, reg
+
+    def version_info(self, model: str, version: int) -> Dict[str, Any]:
+        """Registration payload of one version (the in-band repair
+        source). Returns a shallow copy; arrays are shared read-only."""
+        with self._lock:
+            info = self._models[model]["versions"][int(version)]
+            return {k: v for k, v in info.items() if k != "inflight"}
+
+    def versions(self, model: str) -> List[int]:
+        with self._lock:
+            entry = self._models.get(model)
+            return sorted(entry["versions"]) if entry else []
+
+    def begin(self, model: str, version: int) -> None:
+        with self._lock:
+            self._models[model]["versions"][int(version)]["inflight"] += 1
+
+    def done(self, model: str, version: int) -> None:
+        with self._lock:
+            entry = self._models.get(model)
+            info = entry and entry["versions"].get(int(version))
+            if info is None:
+                return  # retired while we flew — drain already gave up on us
+            info["inflight"] -= 1
+            if info["inflight"] <= 0:
+                self._drained.notify_all()
+
+    def inflight(self, model: str, version: int) -> int:
+        with self._lock:
+            entry = self._models.get(model)
+            info = entry and entry["versions"].get(int(version))
+            return 0 if info is None else int(info["inflight"])
+
+    def wait_drained(self, model: str, version: int,
+                     timeout_s: float) -> bool:
+        """Block until no request is in flight on ``version`` (True) or
+        the timeout passes (False) — the rollout's drain barrier."""
+        deadline = time.monotonic() + float(timeout_s)
+        with self._lock:
+            while True:
+                entry = self._models.get(model)
+                info = entry and entry["versions"].get(int(version))
+                if info is None or info["inflight"] <= 0:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drained.wait(timeout=remaining)
+
+
+class FleetClient:
+    """Route serving requests across a fleet's replicas (module
+    docstring has the routing contract). Constructed from a shared
+    :class:`RoutingTable` — usually via ``ModelFleet.client()``."""
+
+    def __init__(
+        self,
+        table: RoutingTable,
+        token: Optional[str] = None,
+        health_poll_s: Optional[float] = None,
+        failover_attempts: Optional[int] = None,
+        client_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        from spark_rapids_ml_tpu import config
+
+        self._table = table
+        self._token = token
+        self._poll_s = float(
+            config.get("fleet_health_poll_s")
+            if health_poll_s is None else health_poll_s
+        )
+        n = int(
+            config.get("fleet_failover_attempts")
+            if failover_attempts is None else failover_attempts
+        )
+        # 0 = one attempt per replica: every member gets exactly one
+        # chance before the request is declared unroutable.
+        self._attempts = n if n > 0 else len(table.ring.members)
+        # Inner-client defaults tuned for FAILOVER, not solo healing: a
+        # busy shed must surface immediately (max_busy_wait_s=0 — the
+        # router's reroute IS the retry), and a dead replica must fail
+        # in seconds, not socket-default minutes. Callers can override
+        # any of these per fleet.
+        kw: Dict[str, Any] = {
+            "timeout": 10.0,
+            "op_deadline_s": 15.0,
+            "max_op_attempts": 2,
+            "max_busy_wait_s": 0.0,
+        }
+        kw.update(client_kwargs or {})
+        self._client_kwargs = kw
+        self._clients: Dict[str, DataPlaneClient] = {}
+        self._nonce = uuid.uuid4().hex[:12]
+        self._seq = 0
+        #: replica key → requests this client had ANSWERED there — the
+        #: per-client routing distribution (chaos tests and affinity
+        #: debugging read it; the process-wide aggregate lives in the
+        #: srml_router_* registry metrics).
+        self.stats: Dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- replica selection -------------------------------------------------
+
+    def _client(self, key: str) -> DataPlaneClient:
+        c = self._clients.get(key)
+        if c is None:
+            r = self._table.replica(key)
+            c = DataPlaneClient(
+                r.host, r.port, token=self._token, **self._client_kwargs
+            )
+            self._clients[key] = c
+        return c
+
+    def _refresh_health(self, key: str) -> None:
+        """Poll one replica's health when its snapshot is stale; a
+        failed poll marks it dead until the next poll interval."""
+        r = self._table.replica(key)
+        now = time.monotonic()
+        if r.alive and now - r.health_ts < self._poll_s:
+            return
+        if not r.alive and now < r.recheck_at:
+            return
+        try:
+            health = self._client(key).health()
+        except (OSError, protocol.ProtocolError, RuntimeError) as e:
+            _M_HEALTH_REFRESHES.inc(outcome="dead")
+            self._table.mark_dead(key, str(e), self._poll_s)
+            return
+        _M_HEALTH_REFRESHES.inc(outcome="ok")
+        self._table.mark_alive(key, health)
+
+    def _candidates(self, route_key: str) -> List[str]:
+        """Attempt order for one request: the ring primary first (cache
+        affinity), then every other live replica least-loaded-first —
+        the failover half of the contract. Dead replicas past their
+        recheck time still appear (at the end): the router must be able
+        to REDISCOVER a healed replica without an operator poke."""
+        order = self._table.ring.ordered(route_key)
+        for k in order:
+            self._refresh_health(k)
+        now = time.monotonic()
+        primary = order[0]
+        rest = order[1:]
+        live = [k for k in rest if self._table.replica(k).alive]
+        live.sort(key=lambda k: self._table.replica(k).load())
+        dead = [
+            k for k in rest
+            if not self._table.replica(k).alive
+            and now >= self._table.replica(k).recheck_at
+        ]
+        head = [primary] if (
+            self._table.replica(primary).alive
+            or now >= self._table.replica(primary).recheck_at
+        ) else []
+        return (head + live + dead) if head else (live + dead + [primary])
+
+    def _route_key(self, route_key: Optional[str]) -> str:
+        if route_key is not None:
+            return str(route_key)
+        self._seq += 1
+        return f"{self._nonce}-{self._seq}"
+
+    # -- serving ops -------------------------------------------------------
+
+    def transform(
+        self,
+        model: str,
+        data,
+        route_key: Optional[str] = None,
+        input_col: str = "features",
+        n_cols: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Routed :meth:`DataPlaneClient.transform` against the model's
+        ACTIVE version. Returns the role-keyed output arrays."""
+        return self._request(
+            "transform", model, route_key,
+            lambda c, reg, v, e: c.transform(
+                reg, data, input_col=input_col, n_cols=n_cols,
+                deadline_s=deadline_s, version=v, fleet_epoch=e,
+            ),
+        )
+
+    def kneighbors(
+        self,
+        model: str,
+        queries,
+        k: Optional[int] = None,
+        route_key: Optional[str] = None,
+        input_col: str = "features",
+        n_cols: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Routed :meth:`DataPlaneClient.kneighbors`: (distances,
+        indices) from the model's ACTIVE version."""
+        return self._request(
+            "kneighbors", model, route_key,
+            lambda c, reg, v, e: c.kneighbors(
+                reg, queries, k=k, input_col=input_col, n_cols=n_cols,
+                deadline_s=deadline_s, version=v, fleet_epoch=e,
+            ),
+        )
+
+    def _repair(self, key: str, model: str, version: int) -> bool:
+        """Re-register a version on a replica that answered "no such
+        model" — a restarted replica lost its (re-creatable) registry.
+        The payload comes from the routing table; failure just means the
+        failover continues."""
+        try:
+            info = self._table.version_info(model, version)
+        except KeyError:
+            return False
+        try:
+            self._client(key).ensure_model(
+                info["reg_name"], info["algo"], info["arrays"],
+                params=info["params"], version=version,
+            )
+        except (OSError, protocol.ProtocolError, RuntimeError) as e:
+            logger.warning(
+                "in-band repair of %s v%d on %s failed: %s",
+                model, version, key, e,
+            )
+            return False
+        _M_REPAIRS.inc()
+        logger.warning(
+            "re-registered %s v%d on replica %s (it had lost the "
+            "registration)", model, version, key,
+        )
+        return True
+
+    def _request(self, kind: str, model: str, route_key, attempt_fn):
+        # ONE atomic snapshot-and-refcount pins this request — and every
+        # failover retry of it — to a single version (docs/protocol.md
+        # "Fleet & versioned serving"); taken in one lock acquisition so
+        # a concurrent rollout cannot drain-and-retire the version
+        # between the read and the refcount.
+        version, epoch, reg_name = self._table.acquire(model)
+        t0 = time.perf_counter()
+        key = self._route_key(route_key)
+        last_err: Optional[BaseException] = None
+        tried = 0
+        try:
+            with journal.span(
+                f"router.{kind}", model=model, version=version, epoch=epoch,
+            ):
+                for rk in self._candidates(key):
+                    if tried >= self._attempts:
+                        break
+                    tried += 1
+                    repaired = False
+                    while True:
+                        try:
+                            out = attempt_fn(
+                                self._client(rk), reg_name, version, epoch
+                            )
+                            self._table.mark_alive(rk)
+                            self.stats[rk] = self.stats.get(rk, 0) + 1
+                            _M_REQUESTS.inc(op=kind, outcome="ok")
+                            return out
+                        except DaemonBusy as e:
+                            last_err = e
+                            _M_FAILOVERS.inc(reason="busy")
+                            break
+                        except (OSError, protocol.ProtocolError) as e:
+                            last_err = e
+                            _M_FAILOVERS.inc(reason="dead")
+                            self._table.mark_dead(rk, str(e), self._poll_s)
+                            break
+                        except RuntimeError as e:
+                            last_err = e
+                            if (
+                                not repaired
+                                and "no such model" in str(e)
+                                and self._repair(rk, model, version)
+                            ):
+                                repaired = True
+                                continue  # retry THIS replica once
+                            _M_FAILOVERS.inc(reason="error")
+                            break
+            _M_REQUESTS.inc(op=kind, outcome="unroutable")
+            raise FleetUnavailable(
+                f"no replica could serve {kind} for {model!r} v{version} "
+                f"({tried} attempt(s); last error: {last_err})"
+            ) from last_err
+        finally:
+            self._table.done(model, version)
+            _M_REQ_SECONDS.observe(time.perf_counter() - t0, op=kind)

@@ -21,11 +21,17 @@ Core pieces (docs/protocol.md "Serving scheduler"):
   ladder of bucket sizes (config ``serve_batch_buckets``, env
   ``SRML_SERVE_BATCH_BUCKETS``), so jit compilations are BOUNDED by the
   ladder size and counted (``srml_scheduler_compile_misses_total``).
-  Padding is exact by construction: every model's serving path is
-  row-wise (``run_bucketed`` / the KNN query bucketer already pad), so
-  a padded row can never contaminate a real row's output — batched
-  results are bitwise-equal to solo requests (tested across bucket
-  boundaries in tests/test_serve_scheduler.py).
+  Padding is exact by construction for every BATCHED path: transform
+  and exact-KNN serving are row-wise (``run_bucketed`` / the KNN query
+  bucketer already pad), so a padded row can never contaminate a real
+  row's output — batched results are bitwise-equal to solo requests
+  (tested across bucket boundaries in tests/test_serve_scheduler.py).
+  IVF/ANN ``kneighbors`` is the carve-out the daemon enforces: its
+  capacity-bucketed candidate search shares per-list query slots
+  across a batch (a padding or co-batched row can EVICT a real
+  query's candidates), so those requests always dispatch solo
+  (``srml_scheduler_bypass_total``; the index's internal query
+  bucketer still bounds their compiles).
 * **Batching loop** — one dispatcher thread drains the queues: a batch
   goes to the device when its oldest request has waited
   ``serve_batch_window_ms`` or the coalesced rows reach
@@ -46,6 +52,12 @@ bucketer) keeps even bypass compiles bounded.
 Fault site ``daemon.scheduler`` (utils/faults.py): an injected fault at
 admission is translated into a shed — the chaos suite proves shed
 requests retry to exact results through the ordinary busy contract.
+
+Default: ON since the fleet PR (``serve_batching`` / the
+``SRML_SERVE_BATCHING=0`` opt-out). The burn-in that earned the flip:
+the frozen protocol goldens replay unchanged and the PR 5
+batched-vs-solo matrix stays bitwise under the default configuration
+(tests/test_serve_scheduler.py, tests/test_protocol_golden.py).
 """
 
 from __future__ import annotations

@@ -45,6 +45,12 @@ DEFAULT_MAX_REGRESSION = 0.15
 #: of what the trajectory once recorded.
 MULTICHIP_MIN_EFFICIENCY = 0.8
 
+#: Absolute QPS scaling-efficiency floor for fleet serving records
+#: (``bench.py --serve --fleet``): QPS_N / (N × QPS_1) must keep at
+#: least 70% of each added replica — below it the router or the
+#: replicas serialize somewhere and "scale-out" is mostly overhead.
+FLEET_MIN_EFFICIENCY = 0.7
+
 
 def parse_record(obj: Dict[str, Any]) -> Dict[str, Any]:
     """Normalize either record shape to {metric, value, ...}: the raw
@@ -237,6 +243,99 @@ def check_multichip(
     return ok and t_ok, lines + t_lines
 
 
+def check_serve_fleet(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --serve --fleet`` record: the QPS
+    scaling-efficiency floor (absolute :data:`FLEET_MIN_EFFICIENCY`,
+    raised by the trajectory median like every other gate), then
+    throughput vs like-for-like history (same metric + replica count).
+    Dryrun records — the in-process smoke mode, whose replicas share one
+    device lock — SKIP, never pass: they prove plumbing, not scaling."""
+    lines: List[str] = []
+    if bool(fresh.get("dryrun")):
+        lines.append(
+            "fleet [SKIP] fresh record is a dryrun (in-process replicas "
+            "share one device lock; no measured scaling) — nothing "
+            "gated, NOT a pass"
+        )
+        return True, lines
+    eff = fresh.get("scaling_efficiency")
+    if eff is None:
+        return False, [
+            "fleet record has no scaling_efficiency — not a "
+            "bench.py --serve --fleet record?"
+        ]
+    ok = True
+    eff = float(eff)
+    wire_limited = bool(fresh.get("wire_limited"))
+    key = "fabric_relative_efficiency" if wire_limited else "scaling_efficiency"
+    matching = [
+        float(h[key]) for h in history
+        if not bool(h.get("dryrun"))
+        and h.get("metric") == fresh.get("metric")
+        and h.get("n_replicas") == fresh.get("n_replicas")
+        and bool(h.get("wire_limited")) == wire_limited
+        and h.get(key) is not None
+    ]
+    floor = FLEET_MIN_EFFICIENCY
+    if matching:
+        floor = max(floor, (1.0 - max_regression) * _median(matching))
+    if wire_limited:
+        # The host's raw loopback cannot even carry N x QPS_1 (the
+        # record's `wire` microphase, protocol-faithful frame pattern)
+        # — a single-box transport ceiling no networked service can
+        # beat. The ABSOLUTE gate is therefore unmeasurable here: SKIP,
+        # never pass. What IS measurable is the fleet layer's own
+        # overhead on top of that fabric — gate the fabric-relative
+        # efficiency (QPS_N / min(N x QPS_1, fabric capacity)) instead.
+        wire_cap = (fresh.get("wire") or {}).get("reqs_per_s_n")
+        lines.append(
+            f"fleet scaling [SKIP] absolute QPS efficiency {eff:.4f} "
+            f"unmeasurable: the raw wire fabric carries {wire_cap} "
+            f"req/s across {fresh.get('n_replicas')} process pairs, "
+            "below the N x QPS_1 ideal (single-box transport ceiling) "
+            "— NOT a pass"
+        )
+        rel = fresh.get("fabric_relative_efficiency")
+        if rel is None:
+            return False, lines + [
+                "fleet scaling [FAIL] wire_limited record carries no "
+                "fabric_relative_efficiency"
+            ]
+        rel = float(rel)
+        verdict = "OK" if rel >= floor else "REGRESSION"
+        lines.append(
+            f"fabric-relative [{verdict}] {rel:.4f} (QPS scaling / wire "
+            f"scaling) vs floor {floor:.4f} (abs {FLEET_MIN_EFFICIENCY}, "
+            f"{len(matching)} trajectory record(s))"
+        )
+        if rel < floor:
+            ok = False
+    else:
+        verdict = "OK" if eff >= floor else "REGRESSION"
+        lines.append(
+            f"fleet scaling [{verdict}] QPS efficiency {eff:.4f} at "
+            f"{fresh.get('n_replicas')} replica(s) vs floor {floor:.4f} "
+            f"(abs {FLEET_MIN_EFFICIENCY}, {len(matching)} trajectory "
+            "record(s))"
+        )
+        if eff < floor:
+            ok = False
+    t_ok, t_lines = check(
+        fresh,
+        [
+            h for h in history
+            if not bool(h.get("dryrun"))
+            and h.get("n_replicas") == fresh.get("n_replicas")
+        ],
+        max_regression=max_regression,
+    )
+    return ok and t_ok, lines + t_lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.perfcheck",
@@ -298,9 +397,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     multichip = str(fresh.get("metric", "")).startswith("multichip_") or (
         _is_dryrun(fresh) and "n_devices" in fresh
     )
-    default_glob = "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
+    fleet = str(fresh.get("metric", "")).startswith("serve_fleet_")
+    default_glob = (
+        "FLEET_r*.json" if fleet
+        else "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
+    )
     history = load_history(args.history or [default_glob])
-    if multichip:
+    if fleet:
+        ok, lines = check_serve_fleet(
+            fresh, history, max_regression=args.max_regression,
+        )
+    elif multichip:
         ok, lines = check_multichip(
             fresh, history, max_regression=args.max_regression,
             allow_compiles=tuple(args.allow_compile),

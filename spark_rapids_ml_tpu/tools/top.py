@@ -10,12 +10,18 @@ number the dashboard records.
 
 Usage::
 
-    python -m spark_rapids_ml_tpu.tools.top [host:port] \
+    python -m spark_rapids_ml_tpu.tools.top [host:port[,host:port...]] \
         [--interval 2] [--count N] [--once] [--token SECRET]
 
 ``host:port`` defaults to ``$SRML_DAEMON_ADDRESS``. ``--once`` prints a
 single snapshot and exits (scripts/tests); the default loop redraws in
 place until interrupted.
+
+A comma-separated address list renders the FLEET panel instead: one row
+per replica daemon (identity, boot, uptime, connections, served models,
+scheduler queue, busy state), with dead replicas shown as DOWN rather
+than killing the poll — the operator view of a serve/fleet.py
+deployment. The single-address view is unchanged.
 """
 
 from __future__ import annotations
@@ -251,6 +257,38 @@ def _sched_lines(health: Dict[str, Any], snap: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_fleet(healths: Dict[str, Optional[Dict[str, Any]]]) -> str:
+    """The fleet panel: one line per replica from its ``health``
+    response (None = unreachable → DOWN). Pure function — the unit under
+    test; ``main`` feeds it live polls when given a comma-separated
+    address list."""
+    lines: List[str] = []
+    up = sum(1 for h in healths.values() if h is not None)
+    lines.append(f"fleet — {up}/{len(healths)} replicas up")
+    lines.append(
+        f"{'replica':<22}{'id':<14}{'boot':<14}{'up':>7}{'conns':>7}"
+        f"{'models':>8}{'queued':>8}{'state':>8}"
+    )
+    for addr in sorted(healths):
+        h = healths[addr]
+        if h is None:
+            lines.append(f"{addr:<22}{'-':<14}{'-':<14}{'-':>7}{'-':>7}"
+                         f"{'-':>8}{'-':>8}{'DOWN':>8}")
+            continue
+        sched = h.get("scheduler") or {}
+        state = "BUSY" if h.get("busy") else "ok"
+        lines.append(
+            f"{addr:<22}{str(h.get('id', '?')):<14}"
+            f"{str(h.get('boot_id', '?')):<14}"
+            f"{float(h.get('uptime_s', 0.0)):>6.0f}s"
+            f"{int(h.get('queue_depth', 0)):>7}"
+            f"{int(h.get('served_models', 0)):>8}"
+            f"{int(sched.get('queued', 0) or 0):>8}"
+            f"{state:>8}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.top",
@@ -276,6 +314,38 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from spark_rapids_ml_tpu.serve.client import DataPlaneClient
     from spark_rapids_ml_tpu.spark.daemon_session import _parse_addr
+
+    if "," in args.address:
+        # Fleet mode: one health poll per replica per tick, rendered as
+        # the per-replica panel. An unreachable replica reports DOWN.
+        addrs = [a.strip() for a in args.address.split(",") if a.strip()]
+        clients = {
+            a: DataPlaneClient(*_parse_addr(a), token=args.token,
+                               timeout=5.0, max_op_attempts=1)
+            for a in addrs
+        }
+        polls = 0
+        try:
+            while True:
+                healths: Dict[str, Optional[Dict[str, Any]]] = {}
+                for a, c in clients.items():
+                    try:
+                        healths[a] = c.health()
+                    except Exception:
+                        healths[a] = None
+                body = render_fleet(healths)
+                if args.once or args.count:
+                    print(body)
+                    print()
+                else:
+                    print("\x1b[2J\x1b[H" + body, flush=True)
+                polls += 1
+                if args.once or (args.count and polls >= args.count):
+                    return 0
+                time.sleep(args.interval)
+        finally:
+            for c in clients.values():
+                c.close()
 
     host, port = _parse_addr(args.address)
     prev_snap: Optional[Dict[str, Any]] = None

@@ -1,0 +1,852 @@
+"""Serving-fleet tests: replicated versioned models, client-side routing,
+zero-downtime rollout (serve/fleet.py + serve/router.py).
+
+The load-bearing claims, in test order:
+
+* **routing** — consistent hashing is stable and minimally disruptive;
+  sticky keys pin a replica; busy/dead replicas fail over and the answer
+  stays bitwise-identical to the single-daemon one;
+* **versioning** — a replica refuses a version-mismatched request
+  (`serve_version_strict`), acks echo the (version, epoch) pin, and a
+  version is immutable under a registration name;
+* **rollout** — register v2 → warm → atomic flip → drain v1: concurrent
+  traffic never sees a failed or mixed-version response, in-flight v1
+  requests complete on v1, and the drain waits for them;
+* **chaos flagship** — a rolling v1→v2 swap concurrent with a replica
+  SIGKILL (real subprocess daemons) and injected client-side faults
+  loses ZERO requests, keeps p99 under the request deadline, and every
+  response is bitwise-correct for its version.
+
+Also here: the ADVICE r5 rejected-first-feed orphan race regression, the
+serve_batching default-ON burn-in, the tools.top fleet panel, and the
+perfcheck fleet gate units.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.serve import (
+    ConsistentHashRing,
+    DataPlaneClient,
+    DataPlaneDaemon,
+    FleetRolloutError,
+    ModelFleet,
+    RoutingTable,
+)
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+
+D = 16
+
+
+@pytest.fixture
+def pca_v1_v2(rng, mesh8):
+    """Two DIFFERENT fitted PCA versions + their transform oracles for a
+    fixed query batch: the bitwise ground truth per version."""
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    basis = rng.normal(size=(D, D)) * np.logspace(0, -1.5, D)
+    data = rng.normal(size=(400, D)) @ basis
+    m1 = PCA(mesh=mesh8).setK(3).fit({"features": data})
+    m2 = PCA(mesh=mesh8).setK(2).fit({"features": data})
+    q = rng.normal(size=(12, D))
+    return {
+        "q": q,
+        "v1": m1._model_data(),
+        "v2": m2._model_data(),
+        "ref1": np.asarray(m1.transform_matrix(q)["output"]),
+        "ref2": np.asarray(m2.transform_matrix(q)["output"]),
+    }
+
+
+@pytest.fixture
+def trio(mesh8):
+    """Three in-process replica daemons (one device plane, like the
+    multidaemon suites) + their endpoints."""
+    daemons = [DataPlaneDaemon(mesh=mesh8).start() for _ in range(3)]
+    try:
+        yield daemons, [d.address for d in daemons]
+    finally:
+        for d in daemons:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# consistent hashing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_hash_ring_is_stable_and_covers_members():
+    """Two independently built rings agree on every key (the digest is
+    process-independent — Python's salted hash would not be), and
+    ordered() walks every member exactly once, primary first."""
+    keys = [f"10.0.0.{i}:7000" for i in range(5)]
+    r1 = ConsistentHashRing(keys, vnodes=32)
+    r2 = ConsistentHashRing(list(keys), vnodes=32)
+    hits = {k: 0 for k in keys}
+    for i in range(200):
+        k = f"user-{i}"
+        assert r1.primary(k) == r2.primary(k)
+        order = r1.ordered(k)
+        assert sorted(order) == sorted(keys)
+        assert order[0] == r1.primary(k)
+        hits[order[0]] += 1
+    # Uniform-ish spread: every member owns some keys.
+    assert all(n > 0 for n in hits.values()), hits
+
+
+@pytest.mark.fleet
+def test_hash_ring_minimal_disruption():
+    """Removing one member only moves the keys it owned: every key whose
+    primary survives keeps its primary — the property that makes replica
+    death cheap for cache affinity."""
+    keys = [f"h{i}" for i in range(6)]
+    full = ConsistentHashRing(keys, vnodes=64)
+    without = ConsistentHashRing(keys[1:], vnodes=64)
+    moved = stayed = 0
+    for i in range(300):
+        k = f"req-{i}"
+        p = full.primary(k)
+        if p == keys[0]:
+            moved += 1
+        else:
+            assert without.primary(k) == p
+            stayed += 1
+    assert moved > 0 and stayed > 0
+
+
+# ---------------------------------------------------------------------------
+# routing table: flip atomicity, epoch, drain refcount
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_routing_table_flip_and_epoch():
+    t = RoutingTable([("127.0.0.1", 1), ("127.0.0.1", 2)], vnodes=8)
+    t.install("m", 1, "pca", {}, {})
+    with pytest.raises(KeyError):
+        t.snapshot("m")  # installed but never activated
+    assert t.activate("m", 1) == 1
+    assert t.snapshot("m") == (1, 1, "m@v1")
+    t.install("m", 2, "pca", {}, {})
+    assert t.snapshot("m") == (1, 1, "m@v1")  # install alone routes nothing
+    assert t.activate("m", 2) == 2  # the atomic flip bumps the epoch
+    assert t.snapshot("m") == (2, 2, "m@v2")
+    with pytest.raises(ValueError):
+        t.retire("m", 2)  # the ACTIVE version cannot be retired
+    t.retire("m", 1)
+    assert t.versions("m") == [2]
+
+
+@pytest.mark.fleet
+def test_acquire_pins_atomically_and_reinstall_preserves_inflight():
+    """Review findings: (a) a request's snapshot+refcount is ONE lock
+    acquisition (`acquire`), so a rollout can never drain-retire the
+    version between a read and its pin; (b) re-installing an existing
+    version (operator re-seed) must PRESERVE the in-flight count — a
+    reset-to-zero would let a later drain yank arrays under live
+    requests."""
+    t = RoutingTable([("127.0.0.1", 1)], vnodes=8)
+    t.install("m", 1, "pca", {}, {})
+    t.activate("m", 1)
+    assert t.acquire("m") == (1, 1, "m@v1")
+    assert t.inflight("m", 1) == 1
+    t.install("m", 1, "pca", {}, {})  # re-seed while a request flies
+    assert t.inflight("m", 1) == 1  # NOT reset
+    assert not t.wait_drained("m", 1, timeout_s=0.05)
+    t.done("m", 1)
+    assert t.wait_drained("m", 1, timeout_s=1.0)
+
+
+@pytest.mark.fleet
+def test_routing_table_drain_refcount():
+    t = RoutingTable([("127.0.0.1", 1)], vnodes=8)
+    t.install("m", 1, "pca", {}, {})
+    t.activate("m", 1)
+    t.begin("m", 1)
+    t.begin("m", 1)
+    assert t.inflight("m", 1) == 2
+    assert not t.wait_drained("m", 1, timeout_s=0.05)
+    t.done("m", 1)
+    done = threading.Timer(0.1, lambda: t.done("m", 1))
+    done.start()
+    try:
+        assert t.wait_drained("m", 1, timeout_s=5.0)  # wakes on the notify
+    finally:
+        done.join()
+    assert t.inflight("m", 1) == 0
+
+
+# ---------------------------------------------------------------------------
+# routed serving: bitwise, stickiness, failover
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_fleet_register_and_routed_transform_bitwise(trio, pca_v1_v2):
+    daemons, eps = trio
+    with ModelFleet(eps) as fleet:
+        res = fleet.register("m", "pca", pca_v1_v2["v1"], version=1)
+        assert res == {"version": 1, "epoch": 1, "replicas": 3, "failed": []}
+        with fleet.client() as fc:
+            for i in range(6):
+                out = fc.transform("m", pca_v1_v2["q"], route_key=f"k{i}")
+                assert np.array_equal(
+                    np.asarray(out["output"]), pca_v1_v2["ref1"]
+                )
+        # The registration landed on EVERY replica, under the versioned
+        # daemon name.
+        for host, port in eps:
+            with DataPlaneClient(host, port) as c:
+                assert c.model_exists("m@v1")
+
+
+@pytest.mark.fleet
+def test_sticky_route_key_pins_one_replica(trio, pca_v1_v2):
+    """One sticky key opens exactly one replica connection (cache
+    affinity); distinct keys spread across replicas."""
+    _, eps = trio
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        with fleet.client() as fc:
+            for _ in range(5):
+                fc.transform("m", pca_v1_v2["q"], route_key="user-7")
+            primary = fleet.table.ring.primary("user-7")
+            assert fc.stats == {primary: 5}  # all five on the ring owner
+        with fleet.client() as fc:
+            for i in range(30):
+                fc.transform("m", pca_v1_v2["q"], route_key=f"user-{i}")
+            assert sorted(fc.stats) == sorted(
+                fleet.table.ring.members
+            )  # uniform keys reach the whole fleet
+            assert sum(fc.stats.values()) == 30
+
+
+@pytest.mark.fleet
+def test_failover_on_dead_replica_is_bitwise(trio, pca_v1_v2):
+    """Kill the replica that owns a sticky key: the request fails over
+    and the answer stays bitwise-identical; the dead replica is marked
+    and skipped until its re-probe."""
+    daemons, eps = trio
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        with fleet.client(health_poll_s=30.0) as fc:
+            primary = fleet.table.ring.primary("sticky")
+            victim = next(
+                d for d in daemons
+                if f"{d.address[0]}:{d.address[1]}" == primary
+            )
+            victim.stop()
+            out = fc.transform("m", pca_v1_v2["q"], route_key="sticky")
+            assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+            assert not fleet.table.replica(primary).alive
+            # Subsequent requests skip the corpse without re-dialing it.
+            out = fc.transform("m", pca_v1_v2["q"], route_key="sticky")
+            assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_failover_on_busy_shed(trio, pca_v1_v2):
+    """An injected scheduler fault sheds the first attempt with `busy`;
+    the router reroutes instead of waiting, counts the failover, and the
+    retried answer is exact."""
+    _, eps = trio
+    metrics_mod.reset()
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        plan = faults.FaultPlan(seed=3).rule(
+            "daemon.scheduler", "drop", times=1
+        )
+        with faults.active(plan):
+            with fleet.client() as fc:
+                out = fc.transform("m", pca_v1_v2["q"], route_key="x")
+        assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+        assert plan.fired.get("daemon.scheduler") == 1
+    snap = metrics_mod.snapshot()
+    failovers = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["srml_router_failovers_total"]["samples"]
+    }
+    assert failovers.get("busy") == 1
+
+
+# ---------------------------------------------------------------------------
+# version fence + echo
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_version_fence_refuses_mismatch_and_echoes(trio, pca_v1_v2):
+    _, eps = trio
+    host, port = eps[0]
+    with DataPlaneClient(host, port) as c:
+        c.ensure_model("m@v1", "pca", pca_v1_v2["v1"], version=1)
+        out, meta = c.transform(
+            "m@v1", pca_v1_v2["q"], version=1, fleet_epoch=7, with_meta=True
+        )
+        assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+        assert meta["version"] == 1 and meta["fleet_epoch"] == 7
+        # The fence: a request pinned to v2 must not be answered by v1.
+        with pytest.raises(RuntimeError, match="version mismatch"):
+            c.transform("m@v1", pca_v1_v2["q"], version=2)
+        # Debug mode answers (with a warning) instead of refusing.
+        with config.option("serve_version_strict", False):
+            out = c.transform("m@v1", pca_v1_v2["q"], version=2)
+            assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+        # Unpinned requests (no version field) are untouched.
+        out = c.transform("m@v1", pca_v1_v2["q"])
+        assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+
+
+@pytest.mark.fleet
+def test_version_is_immutable_under_a_name(trio, pca_v1_v2):
+    _, eps = trio
+    host, port = eps[0]
+    with DataPlaneClient(host, port) as c:
+        c.ensure_model("m@v1", "pca", pca_v1_v2["v1"], version=1)
+        with pytest.raises(RuntimeError, match="immutable"):
+            c.ensure_model("m@v1", "pca", pca_v1_v2["v2"], version=2)
+        # Same version re-register stays the idempotent no-op.
+        assert c.ensure_model("m@v1", "pca", pca_v1_v2["v1"], version=1) is False
+
+
+# ---------------------------------------------------------------------------
+# rollout: atomic flip, drain, zero downtime
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_rollout_flips_drains_and_drops_v1(trio, pca_v1_v2):
+    daemons, eps = trio
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        res = fleet.rollout("m", "pca", pca_v1_v2["v2"], warm=False)
+        assert res["version"] == 2 and res["previous"] == 1
+        assert res["epoch"] == 2 and res["drained"] is True
+        with fleet.client() as fc:
+            out = fc.transform("m", pca_v1_v2["q"])
+            assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref2"])
+        for host, port in eps:
+            with DataPlaneClient(host, port) as c:
+                assert c.model_exists("m@v2")
+                assert not c.model_exists("m@v1")  # drained then dropped
+        assert fleet.table.versions("m") == [2]
+
+
+@pytest.mark.fleet
+def test_rollout_drain_timeout_keeps_v1_registered(trio, pca_v1_v2):
+    """An in-flight v1 request blocks the drain: the rollout flips (new
+    traffic is v2) but leaves v1's registrations up rather than yanking
+    arrays out from under the pinned request."""
+    _, eps = trio
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        fleet.table.begin("m", 1)  # a pinned v1 request, still flying
+        res = fleet.rollout(
+            "m", "pca", pca_v1_v2["v2"], warm=False, drain_timeout_s=0.2
+        )
+        assert res["drained"] is False
+        host, port = eps[0]
+        with DataPlaneClient(host, port) as c:
+            assert c.model_exists("m@v1")  # survived the timeout
+            assert c.model_exists("m@v2")
+        fleet.table.done("m", 1)
+        assert fleet.table.wait_drained("m", 1, timeout_s=1.0)
+
+
+@pytest.mark.fleet
+def test_rollout_zero_downtime_under_concurrent_traffic(trio, pca_v1_v2):
+    """The acceptance shape, in-process: client threads hammer transform
+    while the rollout flips v1→v2 — zero failed requests, every response
+    bitwise-equal to exactly ONE version's oracle, and the tail is all
+    v2."""
+    _, eps = trio
+    q, ref1, ref2 = pca_v1_v2["q"], pca_v1_v2["ref1"], pca_v1_v2["ref2"]
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        stop = threading.Event()
+        results: list = []
+        errors: list = []
+
+        def worker(i: int) -> None:
+            try:
+                with fleet.client() as fc:
+                    n = 0
+                    while not stop.is_set():
+                        out = fc.transform("m", q, route_key=f"w{i}-{n}")
+                        results.append(np.asarray(out["output"]))
+                        n += 1
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # v1 traffic flowing
+        fleet.rollout("m", "pca", pca_v1_v2["v2"], warm=False)
+        time.sleep(0.3)  # v2 traffic flowing
+        stop.set()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert len(results) > 0
+        n_v1 = n_v2 = 0
+        for out in results:
+            if out.shape == ref1.shape and np.array_equal(out, ref1):
+                n_v1 += 1
+            elif out.shape == ref2.shape and np.array_equal(out, ref2):
+                n_v2 += 1
+            else:  # pragma: no cover - the mixed-version failure mode
+                raise AssertionError(
+                    "a response matched NEITHER version's oracle bitwise"
+                )
+        assert n_v1 > 0 and n_v2 > 0  # the swap happened mid-traffic
+
+
+@pytest.mark.fleet
+def test_register_all_replicas_dead_raises(pca_v1_v2):
+    # Ports from the ephemeral range with nothing listening.
+    with ModelFleet([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                    client_kwargs={"timeout": 0.5, "op_deadline_s": 1.0,
+                                   "max_op_attempts": 1}) as fleet:
+        with pytest.raises(FleetRolloutError):
+            fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        with pytest.raises(KeyError):
+            fleet.table.snapshot("m")  # nothing activated
+
+
+@pytest.mark.fleet
+def test_router_repairs_restarted_replica(trio, pca_v1_v2):
+    """A replica restart loses its (re-creatable) registry; the router's
+    in-band repair re-registers the pinned version and the sticky key's
+    traffic continues on its home replica."""
+    daemons, eps = trio
+    metrics_mod.reset()
+    with ModelFleet(eps) as fleet:
+        fleet.register("m", "pca", pca_v1_v2["v1"], warm=False)
+        primary = fleet.table.ring.primary("sticky")
+        idx = next(
+            i for i, d in enumerate(daemons)
+            if f"{d.address[0]}:{d.address[1]}" == primary
+        )
+        host, port = daemons[idx].address
+        daemons[idx].stop()
+        daemons[idx] = DataPlaneDaemon(
+            host=host, port=port, mesh=daemons[idx]._mesh
+        ).start()  # same address, empty registry
+        with fleet.client(health_poll_s=30.0) as fc:
+            out = fc.transform("m", pca_v1_v2["q"], route_key="sticky")
+            assert np.array_equal(np.asarray(out["output"]), pca_v1_v2["ref1"])
+        with DataPlaneClient(host, port) as c:
+            assert c.model_exists("m@v1")  # the repair re-registered it
+    snap = metrics_mod.snapshot()
+    repairs = snap.get("srml_router_repairs_total", {}).get("samples", [])
+    assert repairs and repairs[0]["value"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos flagship: rolling swap + replica SIGKILL, zero lost requests
+# ---------------------------------------------------------------------------
+
+
+def _spawn_daemon_workers(n: int):
+    """n replica daemons as real OS processes (tests/daemon_worker.py
+    contract: READY <port> on stdout, stdin-close shutdown). Spawned
+    together so the ~4 s jax imports overlap."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("SRML_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    # The parity contract is BITWISE vs the parent session's oracles, so
+    # the workers must run the same f64 profile conftest.py pins.
+    env["JAX_ENABLE_X64"] = "True"
+    env["SRML_TPU_ACCUM_DTYPE"] = "float64"
+    env["SRML_TPU_COMPUTE_DTYPE"] = "float64"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "daemon_worker.py")],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            cwd=repo_root, env=env,
+        )
+        for _ in range(n)
+    ]
+    eps = []
+    for proc in procs:
+        line = proc.stdout.readline()
+        assert line.startswith("READY"), f"daemon worker said {line!r}"
+        eps.append(("127.0.0.1", int(line.split()[1])))
+    return procs, eps
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2):
+    """The acceptance flagship: 3 subprocess replicas, a rolling v1→v2
+    swap concurrent with a SIGKILL of one replica, seeded client-side
+    fault injection on top — and still: zero lost requests, p99 under
+    the request deadline, every response bitwise-correct FOR ITS
+    VERSION."""
+    DEADLINE_S = 30.0  # generous: subprocess CPU daemons jit-compile lazily
+    q, ref1, ref2 = pca_v1_v2["q"], pca_v1_v2["ref1"], pca_v1_v2["ref2"]
+    procs, eps = _spawn_daemon_workers(3)
+    try:
+        with ModelFleet(eps) as fleet:
+            fleet.register("m", "pca", pca_v1_v2["v1"])
+            n_workers, n_reqs = 4, 25
+            latencies: list = []
+            outputs: list = []
+            errors: list = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(n_workers + 1)
+
+            def worker(i: int) -> None:
+                try:
+                    with fleet.client() as fc:
+                        fc.transform("m", q)  # warm sockets pre-barrier
+                        barrier.wait()
+                        for n in range(n_reqs):
+                            t0 = time.perf_counter()
+                            out = fc.transform(
+                                "m", q, route_key=f"w{i}-{n}",
+                                deadline_s=DEADLINE_S,
+                            )
+                            dt = time.perf_counter() - t0
+                            with lock:
+                                latencies.append(dt)
+                                outputs.append(np.asarray(out["output"]))
+                except Exception as e:  # pragma: no cover - failure path
+                    with lock:
+                        errors.append(e)
+
+            # Seeded chaos on the CLIENT side too: sporadic connection
+            # drops exercise the healing + failover paths during the
+            # swap (the daemon side gets the real chaos: SIGKILL).
+            plan = faults.FaultPlan(seed=11).rule(
+                "client.op", "drop", p=0.03
+            )
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(n_workers)
+            ]
+            with faults.active(plan):
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                time.sleep(0.2)  # v1 traffic in flight
+                killed = procs[0]
+                killed.kill()  # SIGKILL: a replica dies mid-swap
+                fleet.rollout("m", "pca", pca_v1_v2["v2"])
+                for t in threads:
+                    t.join()
+            killed.wait(timeout=10)
+
+        assert errors == [], f"lost {len(errors)} request(s): {errors[:3]}"
+        assert len(outputs) == n_workers * n_reqs  # zero lost requests
+        latencies.sort()
+        p99 = latencies[min(int(len(latencies) * 0.99), len(latencies) - 1)]
+        assert p99 < DEADLINE_S, f"p99 {p99:.3f}s breached the deadline"
+        n_v1 = n_v2 = 0
+        for out in outputs:
+            if out.shape == ref1.shape and np.array_equal(out, ref1):
+                n_v1 += 1
+            elif out.shape == ref2.shape and np.array_equal(out, ref2):
+                n_v2 += 1
+            else:  # pragma: no cover - the mixed-version failure mode
+                raise AssertionError(
+                    "a response matched NEITHER version's oracle bitwise"
+                )
+        assert n_v2 > 0  # the swap completed under fire
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r5: rejected-first-feed orphan cleanup race
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_feed_into_raced_orphan_cleanup_retries(mesh8, rng, monkeypatch):
+    """Deterministic replay of the ADVICE r5 interleaving: a valid first
+    feed holds a job object that a concurrent rejected-first-feed
+    cleanup has already dropped-and-deleted (empty). The feed must
+    transparently retry against the live registry instead of failing
+    with a spurious KeyError."""
+    from spark_rapids_ml_tpu.serve.daemon import _Job
+
+    with DataPlaneDaemon(mesh=mesh8) as daemon:
+        victim = _Job("pca", D, mesh8)
+        victim.dropped = True  # the cleanup's tombstone; rows == 0
+        real = daemon._lookup_job
+        state = {"handed": False}
+
+        def racy_lookup(name):
+            if name == "race-job" and not state["handed"]:
+                state["handed"] = True
+                return victim  # the stale fetch the race produces
+            return real(name)
+
+        monkeypatch.setattr(daemon, "_lookup_job", racy_lookup)
+        x = rng.normal(size=(8, D))
+        with DataPlaneClient(*daemon.address) as c:
+            assert c.feed("race-job", x) == 8  # healed, not KeyError
+            assert c.status("race-job")["rows"] == 8
+
+
+@pytest.mark.fleet
+def test_feed_into_legitimately_dropped_job_still_fails(mesh8, rng,
+                                                        monkeypatch):
+    """The retry is scoped to the RACE victim (empty + unregistered): a
+    dropped job that holds rows — a finalized fit — still fails the
+    late feed loudly instead of silently restarting the job."""
+    from spark_rapids_ml_tpu.serve.daemon import _Job
+
+    with DataPlaneDaemon(mesh=mesh8) as daemon:
+        stale = _Job("pca", D, mesh8)
+        stale.dropped = True
+        stale.rows = 100  # NOT the race victim: it held committed rows
+        real = daemon._lookup_job
+        state = {"handed": False}
+
+        def racy_lookup(name):
+            if name == "stale-job" and not state["handed"]:
+                state["handed"] = True
+                return stale
+            return real(name)
+
+        monkeypatch.setattr(daemon, "_lookup_job", racy_lookup)
+        with DataPlaneClient(*daemon.address) as c:
+            with pytest.raises(RuntimeError, match="dropped"):
+                c.feed("stale-job", rng.normal(size=(8, D)))
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_concurrent_valid_and_rejected_first_feeds(mesh8, rng):
+    """Stress the real interleaving: valid first feeds racing rejected
+    ones (stale pass_id) under one job name, repeatedly. Valid feeds
+    must NEVER fail; total committed rows must account exactly for the
+    valid feeds that were acked."""
+    x = rng.normal(size=(4, D))
+    with DataPlaneDaemon(mesh=mesh8) as daemon:
+        host, port = daemon.address
+        for round_no in range(8):
+            name = f"race-{round_no}"
+            errors: list = []
+            acked = [0]
+            barrier = threading.Barrier(4)
+
+            def worker(i: int, _name=name, _errors=errors, _acked=acked,
+                       _barrier=barrier) -> None:
+                try:
+                    with DataPlaneClient(host, port) as c:
+                        _barrier.wait()
+                        if i % 2 == 0:
+                            c.feed(_name, x)  # valid: must never fail
+                            _acked[0] += 1
+                        else:
+                            try:
+                                # Stale pass_id: rejected by _check_pass,
+                                # triggering the orphan cleanup when it
+                                # created the job.
+                                c.feed(_name, x, pass_id=1)
+                            except RuntimeError:
+                                pass  # the rejection is the point
+                except Exception as e:  # pragma: no cover - failure path
+                    _errors.append(e)
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errors == [], f"round {round_no}: {errors}"
+            with DataPlaneClient(host, port) as c:
+                assert c.status(name)["rows"] == 4 * acked[0]
+
+
+# ---------------------------------------------------------------------------
+# serve_batching default-ON burn-in
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.serving
+def test_serve_batching_defaults_on_and_stays_bitwise(mesh8, pca_v1_v2):
+    """The PR's default flip, burned in: a daemon built with NO explicit
+    options runs the scheduler (health says so), serves bitwise-equal to
+    the in-memory model, and SRML_SERVE_BATCHING=0 remains the opt-out
+    (config honors the env spelling)."""
+    import spark_rapids_ml_tpu.config as config_mod
+
+    assert config_mod._DEFAULTS["serve_batching"] is True
+    assert config.get("serve_batching") is True
+    with DataPlaneDaemon(mesh=mesh8) as daemon:  # default config: batching
+        with DataPlaneClient(*daemon.address) as c:
+            assert c.health()["scheduler"]["enabled"] is True
+            c.ensure_model("m", "pca", pca_v1_v2["v1"])
+            out = c.transform("m", pca_v1_v2["q"])
+            assert np.array_equal(
+                np.asarray(out["output"]), pca_v1_v2["ref1"]
+            )
+            # The warmup op is live under the default too.
+            info = c.warmup("m", n_cols=D)
+            assert info["enabled"] is True
+    with config.option("serve_batching", False):  # the documented opt-out
+        with DataPlaneDaemon(mesh=mesh8) as daemon:
+            with DataPlaneClient(*daemon.address) as c:
+                assert c.health()["scheduler"] == {"enabled": False}
+                c.ensure_model("m", "pca", pca_v1_v2["v1"])
+                out = c.transform("m", pca_v1_v2["q"])
+                assert np.array_equal(
+                    np.asarray(out["output"]), pca_v1_v2["ref1"]
+                )
+
+
+# ---------------------------------------------------------------------------
+# tools: top fleet panel, perfcheck fleet gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_top_fleet_panel_renders_up_and_down_replicas():
+    from spark_rapids_ml_tpu.tools.top import render_fleet
+
+    healths = {
+        "127.0.0.1:7001": {
+            "id": "abc123", "boot_id": "boot1", "uptime_s": 12.0,
+            "queue_depth": 3, "served_models": 2,
+            "scheduler": {"enabled": True, "queued": 5}, "busy": False,
+        },
+        "127.0.0.1:7002": None,  # unreachable replica
+        "127.0.0.1:7003": {
+            "id": "def456", "boot_id": "boot2", "uptime_s": 7.0,
+            "queue_depth": 0, "served_models": 2,
+            "scheduler": {"enabled": True, "queued": 0}, "busy": True,
+        },
+    }
+    body = render_fleet(healths)
+    assert "2/3 replicas up" in body
+    assert "DOWN" in body
+    assert "BUSY" in body
+    assert "abc123" in body and "def456" in body
+
+
+@pytest.mark.fleet
+def test_perfcheck_fleet_gate():
+    from spark_rapids_ml_tpu.tools.perfcheck import check_serve_fleet
+
+    good = {
+        "metric": "serve_fleet_transform_qps_d256_k16_c8_b64",
+        "value": 4000.0, "n_replicas": 4, "dryrun": False,
+        "scaling_efficiency": 0.85,
+    }
+    ok, lines = check_serve_fleet(good, [])
+    assert ok and any("OK" in ln for ln in lines)
+
+    bad = {**good, "scaling_efficiency": 0.55}
+    ok, lines = check_serve_fleet(bad, [])
+    assert not ok and any("REGRESSION" in ln for ln in lines)
+
+    # Dryrun (in-process smoke) records SKIP — explicitly not a pass.
+    dry = {**good, "dryrun": True}
+    ok, lines = check_serve_fleet(dry, [])
+    assert ok and any("SKIP" in ln and "NOT a pass" in ln for ln in lines)
+
+    # The trajectory median raises the floor above the absolute 0.7.
+    history = [
+        {**good, "scaling_efficiency": 0.95, "value": 5000.0}
+        for _ in range(3)
+    ]
+    ok, lines = check_serve_fleet({**good, "scaling_efficiency": 0.75},
+                                  history)
+    assert not ok  # 0.75 < 0.85 * 0.95
+
+    # wire_limited (the host's transport cannot even carry N x QPS_1):
+    # the absolute gate SKIPs — explicitly not a pass — and the
+    # fabric-relative efficiency is gated instead.
+    wire = {"pairs": 4, "reqs_per_s_1": 600.0, "reqs_per_s_n": 1700.0}
+    limited = {
+        **good, "scaling_efficiency": 0.45, "wire_limited": True,
+        "wire": wire, "fabric_relative_efficiency": 0.76,
+    }
+    ok, lines = check_serve_fleet(limited, [])
+    assert ok
+    assert any("SKIP" in ln and "NOT a pass" in ln for ln in lines)
+    assert any("fabric-relative [OK]" in ln for ln in lines)
+    ok, lines = check_serve_fleet(
+        {**limited, "fabric_relative_efficiency": 0.5}, []
+    )
+    assert not ok and any("REGRESSION" in ln for ln in lines)
+    ok, _ = check_serve_fleet(
+        {k: v for k, v in limited.items()
+         if k != "fabric_relative_efficiency"}, []
+    )
+    assert not ok  # wire_limited without the relative number cannot pass
+
+    # Missing efficiency = not a fleet record.
+    ok, _ = check_serve_fleet({"metric": "serve_fleet_x", "value": 1.0}, [])
+    assert not ok
+
+
+@pytest.mark.fleet
+@pytest.mark.perf
+@pytest.mark.slow
+def test_bench_fleet_smoke_dryrun():
+    """End-to-end plumbing of ``bench.py --serve --fleet`` in the
+    in-process smoke mode: the record parses, carries the fleet fields,
+    and perfcheck reads a dryrun as SKIP, never a pass."""
+    from spark_rapids_ml_tpu.tools.perfcheck import check_serve_fleet
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SRML_BENCH_FLEET_INPROC": "1",
+        "SRML_BENCH_FLEET_REPLICAS": "2",
+        "SRML_BENCH_FLEET_CLIENTS": "2",
+        "SRML_BENCH_FLEET_REQS": "3",
+        "SRML_BENCH_FLEET_D": "32",
+        "SRML_BENCH_FLEET_K": "4",
+        "SRML_BENCH_FLEET_ROWS": "16",
+    }
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--serve", "--fleet"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("serve_fleet_transform_qps")
+    assert rec["dryrun"] is True
+    assert rec["n_replicas"] == 2
+    assert set(rec["replicas"]) == {"1", "2"}
+    ok, lines = check_serve_fleet(rec, [])
+    assert ok and any("SKIP" in ln for ln in lines)

@@ -3,11 +3,14 @@ bounded recompiles, admission control, warmup, chaos, model LRU.
 
 The load-bearing claim is EXACTNESS: a batched request's output must be
 bitwise-identical to the same request served alone. The scheduler earns
-that by construction — every serving path is row-wise and already pads
-through a bucketer, so a co-batched (or padding) row can never reach
-another row's output — and these tests enforce it across bucket
-boundaries (sizes 1, bucket−1, bucket, bucket+1) with np.array_equal,
-not allclose.
+that by construction on the paths it batches — transform and exact-KNN
+serving are row-wise and already pad through a bucketer, so a
+co-batched (or padding) row can never reach another row's output — and
+these tests enforce it across bucket boundaries (sizes 1, bucket−1,
+bucket, bucket+1) with np.array_equal, not allclose. IVF/ANN
+kneighbors is the enforced carve-out: its capacity-bucketed candidate
+search is NOT row-independent, so the daemon serves it solo (tested
+below, batched-vs-off bitwise + the bypass counter).
 """
 
 import threading
@@ -208,7 +211,9 @@ def test_warmup_bounds_recompiles_to_the_ladder(mesh8, data, pca_arrays, rng):
 
 
 def test_warmup_without_scheduler_is_honest_noop(mesh8, pca_arrays):
-    with DataPlaneDaemon(mesh=mesh8) as daemon:
+    # serve_batching defaults ON since the fleet PR: the off-mode
+    # contract under test needs the explicit opt-out.
+    with DataPlaneDaemon(mesh=mesh8, serve_batching=False) as daemon:
         with DataPlaneClient(*daemon.address) as c:
             c.ensure_model("m", "pca", pca_arrays)
             info = c.warmup("m", n_cols=D)
@@ -234,7 +239,8 @@ def test_health_reports_scheduler_state(mesh8, pca_arrays, data):
             assert sched["models"] == {}
     finally:
         close()
-    with DataPlaneDaemon(mesh=mesh8) as plain:
+    # The off mode (explicit opt-out now that batching defaults ON).
+    with DataPlaneDaemon(mesh=mesh8, serve_batching=False) as plain:
         with DataPlaneClient(*plain.address) as c:
             assert c.health()["scheduler"] == {"enabled": False}
 
@@ -489,3 +495,38 @@ def test_top_renders_scheduler_panel():
     assert "25%" in body
     plain = render({"id": "abc", "scheduler": {"enabled": False}}, {})
     assert "scheduler" not in plain.splitlines()[-1]
+
+
+@pytest.mark.serving
+def test_ann_kneighbors_bypasses_batching_and_stays_exact(mesh8, rng):
+    """IVF/ANN kneighbors must NOT coalesce (docs/protocol.md "Serving
+    scheduler", exactness carve-out): the capacity-bucketed candidate
+    search shares per-list query slots across a batch, so scheduler
+    zero-padding — or a co-batched neighbor request — could evict a
+    real query's candidates (observed: a 4-row shard losing a k=2 hit
+    to -1 under the 64-row pad). The scheduler serves them solo and
+    counts the bypass; results equal the scheduler-off daemon bitwise."""
+    db = rng.normal(size=(4, D))
+    queries = db[:2]
+
+    def serve(batching):
+        with config.option("serve_batching", batching):
+            with DataPlaneDaemon(mesh=mesh8) as daemon:
+                with DataPlaneClient(*daemon.address) as c:
+                    c.feed("j", db, algo="knn", partition=0)
+                    c.commit("j", 0)
+                    c.finalize_knn("j", register_as="idx", mode="ivf",
+                                   nlist=2, row_id_base={0: 0})
+                    return c.kneighbors("idx", queries, k=2)
+
+    ref_d, ref_i = serve(False)
+    metrics_mod.reset()
+    got_d, got_i = serve(True)
+    assert np.array_equal(np.asarray(got_i), np.asarray(ref_i))
+    assert np.array_equal(np.asarray(got_d), np.asarray(ref_d))
+    snap = metrics_mod.snapshot()
+    bypass = {
+        s["labels"]["op"]: s["value"]
+        for s in snap.get("srml_scheduler_bypass_total", {}).get("samples", [])
+    }
+    assert bypass.get("kneighbors", 0) >= 1  # solo-dispatched, counted
