@@ -34,6 +34,16 @@ benchmark instead: N concurrent transform clients against one in-process
 daemon, scheduler off then on (serve/scheduler.py), and prints one JSON
 line with QPS, p50/p99 latency, and mean batch occupancy for both modes.
 
+``python bench.py --chaos-elastic`` (or SRML_BENCH_CHAOS_ELASTIC=1)
+runs the ELASTIC-DEGRADE micro-benchmark: a 3-daemon hub-protocol
+kmeans fit whose peer daemon dies permanently mid-pass (stop, NO
+restart — docs/protocol.md "Permanent daemon loss"). The record carries
+time-to-recover (death probe + survivor rewind + the replayed pass on
+the 3→2 topology), the replayed-row count, the recovery overhead
+relative to a steady pass, and a bitwise check against an uninterrupted
+fit on the surviving topology; tools/perfcheck.py gates
+recovery-cost regressions against the CHAOS_r* trajectory.
+
 ``python bench.py --serve --fleet`` (or SRML_BENCH_FLEET=1) runs the
 FLEET benchmark: N replica daemons (each its own OS process — its own
 Python runtime and device dispatch, the deployment shape) × M client
@@ -622,6 +632,171 @@ def serve_bench() -> None:
     }))
 
 
+def chaos_elastic_bench() -> None:
+    """``--chaos-elastic``: the recovery-cost micro-record for the
+    elastic fit (docs/protocol.md "Permanent daemon loss").
+
+    Three in-process daemons drive a hub-protocol kmeans fit (the same
+    feed/commit → export/merge → step → set_iterate sequence the Spark
+    estimator runs); the peer holding a third of the partitions is
+    STOPPED mid-pass and never restarted. The bench then performs the
+    estimator's degrade unit — liveness probe to deadline exhaustion,
+    survivor rewind to the last boundary iterate, the full pass replayed
+    with the dead daemon's partitions rerouted — and times it. Integer-
+    valued data makes every fold exact, so the record self-verifies: the
+    degraded fit's centers must be bitwise-equal to an uninterrupted fit
+    on the surviving 2-daemon topology. One JSON line; perfcheck gates
+    ``recovery_overhead``/``value`` against the CHAOS_r* trajectory."""
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+    from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+
+    d = int(os.environ.get("SRML_BENCH_ELASTIC_D", 64))
+    k = int(os.environ.get("SRML_BENCH_ELASTIC_K", 8))
+    part_rows = int(os.environ.get("SRML_BENCH_ELASTIC_PART_ROWS", 32768))
+    passes = max(int(os.environ.get("SRML_BENCH_ELASTIC_PASSES", 3)), 2)
+    death_timeout = float(
+        os.environ.get("SRML_BENCH_ELASTIC_DEATH_TIMEOUT_S", 1.0)
+    )
+    n_parts = 6
+    rng = np.random.default_rng(7)
+    centers0 = rng.integers(-12, 13, size=(k, d)) * 4
+    n = n_parts * part_rows
+    x = (
+        centers0[rng.integers(0, k, size=(n,))]
+        + rng.integers(-1, 2, size=(n, d))
+    ).astype(np.float64)
+    parts = [np.ascontiguousarray(p) for p in np.array_split(x, n_parts)]
+    seed_batch = x[: 32 * k]
+    params = {"k": k, "seed": 11}
+
+    def client(daemon):
+        return DataPlaneClient(
+            *daemon.address, timeout=60.0, max_op_attempts=2,
+            backoff_base_s=0.02, backoff_max_s=0.2,
+        )
+
+    def feed_pass(job, routing, it):
+        for pid, c in routing.items():
+            c.feed(job, parts[pid], algo="kmeans", partition=pid,
+                   pass_id=it, params=params)
+            c.commit(job, partition=pid, pass_id=it)
+
+    def reduce_step_sync(job, primary, peers):
+        for pc in peers:
+            arrays, meta = pc.export_state(job)
+            primary.merge_state(
+                job, arrays, rows=int(meta["pass_rows"]), algo="kmeans",
+                n_cols=d, params=params,
+            )
+        info = primary.step(job)
+        arrays, it_n = primary.get_iterate(job)
+        for pc in peers:
+            pc.set_iterate(job, arrays, it_n)
+        return info, (arrays, it_n)
+
+    record: dict = {
+        "metric": f"chaos_elastic_replay_rows_per_s_d{d}_k{k}",
+        "unit": "rows/s",
+        "mode": "chaos_elastic",
+        "n_daemons": 3,
+        "n_survivors": 2,
+        "rows": n,
+        "passes": passes,
+        "death_timeout_s": death_timeout,
+    }
+    da = DataPlaneDaemon(ttl=3600.0).start()
+    db = DataPlaneDaemon(ttl=3600.0).start()
+    dc_ = DataPlaneDaemon(ttl=3600.0).start()
+    ca, cb, cc = client(da), client(db), client(dc_)
+    try:
+        # Oracle: the surviving topology (a holds the victim's
+        # partitions), uninterrupted — also the steady-pass clock.
+        job = "elastic-oracle"
+        steady = []
+        for c in (ca, cc):
+            c.seed_kmeans(job, seed_batch, k=k, params=params)
+        routing2 = {pid: (cc if pid >= 4 else ca) for pid in range(n_parts)}
+        for it in range(passes):
+            t0 = time.perf_counter()
+            feed_pass(job, routing2, it)
+            reduce_step_sync(job, ca, [cc])
+            steady.append(time.perf_counter() - t0)
+        oracle, _ = ca.finalize(job, {}, drop=False)
+        ca.drop(job)
+        steady_pass_s = min(steady)
+
+        # Degraded run: 3 daemons; the victim dies mid-pass-1 for good.
+        job = "elastic-degrade"
+        for c in (ca, cb, cc):
+            c.seed_kmeans(job, seed_batch, k=k, params=params)
+        routing3 = {
+            pid: (cc if pid >= 4 else cb if pid >= 2 else ca)
+            for pid in range(n_parts)
+        }
+        feed_pass(job, routing3, 0)
+        _, ledger = reduce_step_sync(job, ca, [cb, cc])
+        # Pass 1 opens normally, then the victim vanishes under it.
+        for pid in (0, 1):
+            ca.feed(job, parts[pid], algo="kmeans", partition=pid,
+                    pass_id=1, params=params)
+            ca.commit(job, partition=pid, pass_id=1)
+        db.stop()  # the permanent death — nothing ever restarts it
+        failed = False
+        try:
+            cb.feed(job, parts[2], algo="kmeans", partition=2, pass_id=1,
+                    params=params)
+        except Exception:
+            failed = True
+        assert failed, "the dead daemon accepted a feed?"
+        # The degrade unit, timed end to end: classify → rewind → replay.
+        t0 = time.perf_counter()
+        probe_t0 = time.perf_counter()
+        dead = False
+        try:
+            with DataPlaneClient(
+                *db.address, timeout=60.0, op_deadline_s=death_timeout,
+                max_op_attempts=8, backoff_base_s=0.02, backoff_max_s=0.2,
+            ) as probe:
+                probe.ping()
+        except Exception:
+            dead = True
+        probe_s = time.perf_counter() - probe_t0
+        assert dead, "the liveness probe answered for a stopped daemon"
+        arrays, it_n = ledger
+        ca.set_iterate(job, arrays, it_n)
+        cc.set_iterate(job, arrays, it_n)
+        routing_shrunk = {
+            pid: (cc if pid >= 4 else ca) for pid in range(n_parts)
+        }
+        feed_pass(job, routing_shrunk, 1)
+        _, ledger = reduce_step_sync(job, ca, [cc])
+        time_to_recover = time.perf_counter() - t0
+        for it in range(2, passes):
+            feed_pass(job, routing_shrunk, it)
+            reduce_step_sync(job, ca, [cc])
+        degraded, _ = ca.finalize(job, {}, drop=False)
+        ca.drop(job)
+        cc.drop(job)
+
+        record.update({
+            "value": round(n / time_to_recover, 1),
+            "time_to_recover_s": round(time_to_recover, 4),
+            "probe_s": round(probe_s, 4),
+            "replayed_rows": n,
+            "steady_pass_s": round(steady_pass_s, 4),
+            "recovery_overhead": round(time_to_recover / steady_pass_s, 3),
+            "bitwise_equal_oracle": bool(
+                np.array_equal(degraded["centers"], oracle["centers"])
+            ),
+        })
+    finally:
+        for c in (ca, cb, cc):
+            c.close()
+        for daemon in (da, db, dc_):
+            daemon.stop()
+    print(json.dumps(record))
+
+
 def _fleet_daemon_worker() -> None:
     """``--fleet-daemon`` subcommand: one replica daemon as its own OS
     process (the deployment unit). Prints ``READY <port>``; serves until
@@ -1080,6 +1255,10 @@ if __name__ == "__main__":
         "SRML_BENCH_FLEET", ""
     ) in ("1", "true"):
         fleet_bench()
+    elif "--chaos-elastic" in sys.argv or os.environ.get(
+        "SRML_BENCH_CHAOS_ELASTIC", ""
+    ) in ("1", "true"):
+        chaos_elastic_bench()
     elif "--serve" in sys.argv or os.environ.get("SRML_BENCH_SERVE", "") in (
         "1", "true"
     ):

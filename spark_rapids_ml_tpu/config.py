@@ -293,6 +293,26 @@ _DEFAULTS: Dict[str, Any] = {
     # Overridable per session via $SRML_FIT_RECOVERY_ATTEMPTS /
     # spark.srml.fit.recovery_attempts (spark/daemon_session.py).
     "fit_recovery_attempts": _env("FIT_RECOVERY_ATTEMPTS", 0, int),
+    # Elastic-fit death policy (spark/estimator.py; docs/protocol.md
+    # "Permanent daemon loss"): how many PEER daemons one fit may declare
+    # permanently dead and amputate — quarantining the daemon, rewinding
+    # survivors to the last pass boundary, and rerunning the scan with
+    # the dead daemon's partitions rerouted. 0 (default) = off: a lost
+    # daemon fails the fit loudly, byte-for-byte today's behavior, and
+    # no classification probe ever runs. Overridable per session via
+    # $SRML_FIT_DAEMON_LOSS_TOLERANCE / spark.srml.fit.daemon_loss_tolerance
+    # (spark/daemon_session.py).
+    "fit_daemon_loss_tolerance": _env("FIT_DAEMON_LOSS_TOLERANCE", 0, int),
+    # The death deadline: a peer implicated in a failed pass is probed
+    # with this as its TOTAL reconnect/healing budget, and escalates from
+    # *retrying* to *declared dead* only when the whole budget is
+    # exhausted — a slow or busy daemon that answers within it is never
+    # amputated on a hunch. Overridable via
+    # $SRML_FIT_DAEMON_DEATH_TIMEOUT_S /
+    # spark.srml.fit.daemon_death_timeout_s.
+    "fit_daemon_death_timeout_s": _env(
+        "FIT_DAEMON_DEATH_TIMEOUT_S", 15.0, float
+    ),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

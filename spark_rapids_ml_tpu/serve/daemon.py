@@ -1970,7 +1970,24 @@ class DataPlaneDaemon:
                     del self._models[n]
             for n in stale_models:
                 _M_MODEL_EVICTIONS.inc(reason="ttl")
+                # An evicted durable index becomes disk-only NOW: its
+                # snapshot's retention clock restarts so the sweep below
+                # grants the full 8×-TTL window from this moment.
+                self._touch_model_state(n)
                 logger.warning("evicted idle served model %r", n)
+            if self._state_dir is not None:
+                # LIVE registrations keep their snapshot fresh (the
+                # model-snapshot twin of boundary writes refreshing job
+                # snapshots): without this, an index that stays live —
+                # and therefore unswept — past 8× the TTL would carry a
+                # build-time mtime, and a SIGKILL would let the next
+                # boot's sweep reclaim it BEFORE first mention restores
+                # it. With the refresh, the retention clock effectively
+                # counts from eviction or death, never from the build.
+                with self._models_lock:
+                    live_now = list(self._models)
+                for n in live_now:
+                    self._touch_model_state(n)
             self._sweep_orphan_snapshots()
 
     def _sweep_orphan_snapshots(self) -> None:
@@ -1985,6 +2002,8 @@ class DataPlaneDaemon:
             return
         with self._jobs_lock:
             live = {self._job_state_path(n) for n in self._jobs}
+        with self._models_lock:
+            live_models = {self._model_state_path(n) for n in self._models}
         try:
             names = os.listdir(self._state_dir)
         except OSError:
@@ -1992,6 +2011,25 @@ class DataPlaneDaemon:
         now_wall = time.time()  # file mtimes are wall-clock
         for fname in names:
             path = os.path.join(self._state_dir, fname)
+            if fname.startswith("model-") and fname.endswith(".npz"):
+                # Served-model snapshots: a LIVE registration's file is
+                # never swept; an evicted one keeps an 8×-TTL disk
+                # retention window (mtime refreshed at eviction — the
+                # old in-memory "not re-creatable" hold, moved to disk)
+                # before the dataset-sized file is reclaimed.
+                if path in live_models:
+                    continue
+                try:
+                    if now_wall - os.path.getmtime(path) > self._ttl * 8.0:
+                        os.unlink(path)
+                        logger.warning(
+                            "swept served-model snapshot %s (evicted "
+                            "> 8x ttl %.1fs ago with no drop_model)",
+                            fname, self._ttl,
+                        )
+                except OSError:
+                    pass  # raced a restore/drop, or already gone
+                continue
             if fname.endswith(".tmp"):
                 # A writer SIGKILLed between mkstemp and the atomic
                 # rename (exactly the crash window this feature
@@ -2131,6 +2169,157 @@ class DataPlaneDaemon:
             name, job.iteration, job.rows, meta.get("boot_id"), self.boot_id,
         )
         return job
+
+    # -- durable served-model state (daemon-built KNN/ANN indexes) ---------
+
+    def _model_state_path(self, name: str) -> str:
+        """Snapshot file for one daemon-built index registration (same
+        sanitize+digest scheme as job snapshots)."""
+        safe = "".join(
+            c if c.isalnum() or c in "._-" else "_" for c in name
+        )[:64]
+        digest = hashlib.sha1(name.encode()).hexdigest()[:10]
+        return os.path.join(self._state_dir, f"model-{safe}-{digest}.npz")
+
+    def _save_model_state(self, name: str, served: _ServedModel) -> bool:
+        """Persist a daemon-BUILT index registration (the finalize-knn
+        path — ``ensure_model`` registrations stay volatile: their
+        clients hold the arrays and re-register on miss). Written
+        BEFORE the finalize ack (write-ahead, like job snapshots): an
+        acked build is a restorable one, so a durable daemon's index
+        survives a SIGKILL and the 8×-TTL "not re-creatable" special
+        case retires — the snapshot IS the re-creation source. Returns
+        True when a snapshot was written."""
+        if self._state_dir is None:
+            return False
+        model = served.model
+        with _DEVICE_LOCK:  # index arrays may be device-resident
+            arrays = {
+                k: np.asarray(jax.device_get(v))
+                for k, v in model._model_data().items()
+                if v is not None
+            }
+        if served.id_map is not None:
+            arrays["id_map"] = np.asarray(served.id_map, np.int64)
+        params = {
+            p: model.getOrDefault(p)
+            for p in ("metric", "nprobe") if model.hasParam(p)
+        }
+        checkpoint_mod.save_state(
+            self._model_state_path(name),
+            arrays,
+            {
+                "name": name,
+                "algo": served.algo,
+                "params": params,
+                "sharded": getattr(model, "_shard_mesh", None) is not None,
+                "boot_id": self.boot_id,
+            },
+        )
+        return True
+
+    def _discard_model_state(self, name: str) -> None:
+        """A dropped model must not resurrect (same contract as
+        _discard_job_state; drop_model discards even with no live model
+        — the abort must not leave a restorable ghost)."""
+        if self._state_dir is not None:
+            checkpoint_mod.discard_state(self._model_state_path(name))
+
+    def _touch_model_state(self, name: str) -> None:
+        """Restart an evicted registration's disk-retention clock: the
+        moment the index leaves memory (TTL/LRU eviction) is when the
+        snapshot becomes the only copy — the orphan sweep's 8×-TTL
+        window counts from here, not from the build."""
+        if self._state_dir is None:
+            return
+        try:
+            os.utime(self._model_state_path(name), None)
+        except OSError:
+            pass
+
+    def _restore_model(self, name: str) -> Optional[_ServedModel]:
+        """Resurrect a daemon-built index from its snapshot: rebuild the
+        core model from the persisted arrays, re-pin its serving params
+        and (for ANN) the baked-in fit metric + sharded placement. The
+        restored registration reaps at the PLAIN TTL — it is
+        re-creatable from disk now, so the dataset-sized memory can be
+        reclaimed and resurrected on the next query."""
+        data = checkpoint_mod.load_state(self._model_state_path(name))
+        if data is None:
+            return None
+        arrays, meta = data
+        arrays = dict(arrays)
+        id_map = arrays.pop("id_map", None)
+        algo = str(meta["algo"])
+        if algo == "ann":
+            from spark_rapids_ml_tpu.models.knn import (
+                ApproximateNearestNeighborsModel,
+            )
+
+            model = ApproximateNearestNeighborsModel._from_model_data(
+                "served", arrays
+            )
+        else:
+            from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
+
+            model = NearestNeighborsModel._from_model_data("served", arrays)
+            model._mesh = self._mesh
+        params = meta.get("params") or {}
+        known = {k: v for k, v in params.items() if model.hasParam(k)}
+        if known:
+            model._set(**known)
+        if (
+            algo == "ann"
+            and meta.get("sharded")
+            and self._mesh.shape[DATA_AXIS] > 1
+        ):
+            with _DEVICE_LOCK:
+                model.shard_index(self._mesh)
+        served = _ServedModel.from_model(
+            algo, model, clock=self._clock, id_map=id_map
+        )
+        served.ttl_scale = 1.0  # re-creatable from disk: plain TTL
+        logger.warning(
+            "restored served model %r from durable snapshot (%s index; "
+            "snapshot by boot %s, this boot %s)",
+            name, algo, meta.get("boot_id"), self.boot_id,
+        )
+        return served
+
+    def _lookup_model(self, name: str) -> Optional[_ServedModel]:
+        """Registry lookup with a lazy durable restore — the served-model
+        twin of :meth:`_lookup_job` (same single-filed restore, same
+        race-safe publication, same honor-a-raced-drop re-check)."""
+        with self._models_lock:
+            served = self._models.get(name)
+        if served is not None or self._state_dir is None:
+            return served
+        with self._restore_lock:
+            with self._models_lock:
+                served = self._models.get(name)
+            if served is not None:
+                return served
+            restored = self._restore_model(name)
+        if restored is None:
+            return None
+        evicted: list = []
+        with self._models_lock:
+            current = self._models.get(name)
+            if current is None:
+                self._models[name] = restored
+                current = restored
+                evicted = self._enforce_model_cap_locked(keep=name)
+        self._log_lru_evictions(evicted)
+        if current is restored and not os.path.exists(
+            self._model_state_path(name)
+        ):
+            # A drop_model raced this restore and already discarded the
+            # snapshot: honor the drop.
+            with self._models_lock:
+                if self._models.get(name) is restored:
+                    del self._models[name]
+            return None
+        return current
 
     def _lookup_job(self, name: str) -> Optional[_Job]:
         """Registry lookup, falling back to a lazy durable restore. The
@@ -2329,6 +2518,14 @@ class DataPlaneDaemon:
             dropped = self._drop_job(str(req.get("job")))
             protocol.send_json(conn, {"ok": True, "dropped": dropped})
         elif op == "export_state":
+            # The permanent-loss chaos site (with set_iterate and
+            # reduce_mesh below): a crash HERE is a peer daemon dying at
+            # the cross-daemon coordination moment — the elastic-fit
+            # death the driver must classify, quarantine, and survive
+            # (docs/protocol.md "Permanent daemon loss"). Unlike
+            # daemon.op crashes, chaos tests pair this site with NO
+            # restart.
+            faults.checkpoint("daemon.vanish")
             job = self._get_job(req)
             arrays, meta = job.export_state()
             _send_arrays_counted(conn, "export_state", arrays, {"ok": True, **meta})
@@ -2369,8 +2566,14 @@ class DataPlaneDaemon:
                  "algo": None if m is None else m.algo},
             )
         elif op == "drop_model":
+            # Snapshot discard FIRST, and unconditionally (even with no
+            # live model): drop is the release op, and an orphan model
+            # snapshot would resurrect the released index at its next
+            # mention (same ordering contract as the job `drop`).
+            model_name = str(req.get("model"))
+            self._discard_model_state(model_name)
             with self._models_lock:
-                m = self._models.pop(str(req.get("model")), None)
+                m = self._models.pop(model_name, None)
             protocol.send_json(conn, {"ok": True, "dropped": m is not None})
         elif op == "health":
             self._op_health(conn)
@@ -2809,6 +3012,10 @@ class DataPlaneDaemon:
         peers_spec = req.get("peers") or {}
         if not isinstance(peers_spec, dict) or not peers_spec:
             raise ValueError("reduce_mesh needs a non-empty peers map")
+        # Permanent-loss chaos site (see export_state): a peer stopping
+        # here leaves the mesh mid-reduce — the epoch fence refuses the
+        # replay and the driver's death policy takes over.
+        faults.checkpoint("daemon.vanish")
         # Replay dedupe FIRST — before the epoch fence and the peer
         # gather: a replay of an applied drop_peers reduce finds the
         # peer jobs gone (and possibly a changed epoch), and must get
@@ -2942,6 +3149,10 @@ class DataPlaneDaemon:
         daemon that lost the job entirely (docs/protocol.md "Crash
         recovery"). Without ``n_cols`` an unknown job stays an error."""
         arrays = _recv_arrays_aligned(conn, req)
+        # Permanent-loss chaos site (see export_state): the boundary
+        # sync is where an iterative fit discovers a dead peer — the
+        # frames are already drained, so the framing stays aligned.
+        faults.checkpoint("daemon.vanish")
         name = str(req["job"])
         job = self._lookup_job(name)
         if job is None:
@@ -2994,6 +3205,7 @@ class DataPlaneDaemon:
             victim = candidates[0][2]
             del self._models[victim]
             _M_MODEL_EVICTIONS.inc(reason="lru")
+            self._touch_model_state(victim)  # disk retention starts now
             evicted.append(victim)
         return evicted
 
@@ -3189,8 +3401,7 @@ class DataPlaneDaemon:
         real traffic will carry — jit caches are dtype-keyed. With the
         scheduler disabled the op is an honest no-op (enabled: false)."""
         name = str(req["model"])
-        with self._models_lock:
-            served = self._models.get(name)
+        served = self._lookup_model(name)  # registry, then durable restore
         if served is None:
             raise KeyError(f"no such model {name!r}; ensure_model first")
         if self._scheduler is None:
@@ -3232,8 +3443,7 @@ class DataPlaneDaemon:
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         name = str(req["model"])
-        with self._models_lock:
-            served = self._models.get(name)
+        served = self._lookup_model(name)  # registry, then durable restore
         if served is None:
             raise KeyError(f"no such model {name!r}; ensure_model first")
         x = table_column_to_matrix(
@@ -3260,13 +3470,12 @@ class DataPlaneDaemon:
         with pa.ipc.open_stream(payload) as reader:
             table = reader.read_all()
         name = str(req["model"])
-        with self._models_lock:
-            served = self._models.get(name)
+        served = self._lookup_model(name)  # registry, then durable restore
         if served is None:
             raise KeyError(
                 f"no such model {name!r} — a daemon-built index this old "
-                "was TTL-evicted (it is not client-re-creatable); refit "
-                "the estimator"
+                "was evicted (and any durable snapshot's retention "
+                "window passed); refit the estimator"
             )
         q = table_column_to_matrix(
             table, _opt(req, "input_col", "features"), req.get("n_cols")
@@ -3310,17 +3519,25 @@ class DataPlaneDaemon:
                     )
             model, info, id_map = job.build_knn_model(params, extra)
             algo = "ann" if params.get("mode") == "ivf" else "knn"
+            served = _ServedModel.from_model(
+                algo, model, clock=self._clock, id_map=id_map
+            )
             with self._models_lock:
                 if name in self._models:  # raced registration: first wins
                     raise ValueError(
                         f"model name {name!r} is already registered; "
                         "pick a fresh register_as"
                     )
-                self._models[name] = _ServedModel.from_model(
-                    algo, model, clock=self._clock, id_map=id_map
-                )
+                self._models[name] = served
                 evicted = self._enforce_model_cap_locked(keep=name)
             self._log_lru_evictions(evicted)
+            # Durable daemons write-ahead-snapshot the built index BEFORE
+            # the finalize ack: an acked build is restorable across a
+            # SIGKILL, and the registration reaps at the plain TTL (the
+            # 8×-TTL "not re-creatable" hold retires — the snapshot is
+            # the re-creation source; docs/protocol.md).
+            if self._save_model_state(name, served):
+                served.ttl_scale = 1.0
             # Same eager-warmup contract as ensure_model: the built index
             # shard's kneighbors ladder pre-compiles before the finalize
             # ack, so the first real query never pays the compile.

@@ -146,6 +146,66 @@ def recovery_attempts(spark=None) -> int:
         return 0
 
 
+def _env_conf_config(spark, env_name: str, conf_key: str, config_key: str,
+                     cast, floor=None):
+    """Shared resolution ladder for fit-policy knobs (the
+    ``recovery_attempts`` pattern): env, then Spark conf, then the
+    process config default. A typo'd value warns and falls through —
+    it must never SILENTLY disable a policy the operator configured."""
+    sources = [(f"${env_name}", os.environ.get(env_name))]
+    if spark is not None:
+        sources.append((conf_key, _spark_conf_get(spark, conf_key)))
+    for src, v in sources:
+        if v is None:
+            continue
+        try:
+            v = cast(v)
+            return v if floor is None else max(v, floor)
+        except (TypeError, ValueError):
+            from spark_rapids_ml_tpu.utils.logging import get_logger
+
+            get_logger("spark.daemon_session").warning(
+                "ignoring invalid %s value %r from %s", config_key, v, src,
+            )
+    from spark_rapids_ml_tpu import config
+
+    try:
+        v = cast(config.get(config_key))
+        return v if floor is None else max(v, floor)
+    except (TypeError, ValueError):
+        return floor if floor is not None else cast(0)
+
+
+def daemon_loss_tolerance(spark=None) -> int:
+    """Elastic-fit death budget (spark/estimator.py; docs/protocol.md
+    "Permanent daemon loss"): how many peer daemons one fit may declare
+    permanently dead and amputate. 0 (the default) = elastic degrade
+    off — a lost daemon fails the fit loudly, and no classification
+    probe ever runs. Sources, env first then Spark conf then config:
+    ``$SRML_FIT_DAEMON_LOSS_TOLERANCE`` /
+    ``spark.srml.fit.daemon_loss_tolerance`` /
+    ``config "fit_daemon_loss_tolerance"``."""
+    return _env_conf_config(
+        spark, "SRML_FIT_DAEMON_LOSS_TOLERANCE",
+        "spark.srml.fit.daemon_loss_tolerance",
+        "fit_daemon_loss_tolerance", int, floor=0,
+    )
+
+
+def daemon_death_timeout_s(spark=None) -> float:
+    """The death deadline: the TOTAL reconnect/healing budget a peer
+    implicated in a failed pass gets on its liveness probe before it
+    escalates from *retrying* to *declared dead*. Sources:
+    ``$SRML_FIT_DAEMON_DEATH_TIMEOUT_S`` /
+    ``spark.srml.fit.daemon_death_timeout_s`` /
+    ``config "fit_daemon_death_timeout_s"``."""
+    return _env_conf_config(
+        spark, "SRML_FIT_DAEMON_DEATH_TIMEOUT_S",
+        "spark.srml.fit.daemon_death_timeout_s",
+        "fit_daemon_death_timeout_s", float, floor=0.1,
+    )
+
+
 def resolve_all(spark=None) -> list:
     """The full daemon set for fits that must know every peer BEFORE the
     first scan (kmeans: centers are seeded on all daemons up front).

@@ -64,6 +64,17 @@ _M_MESH_PATHS = metrics_mod.counter(
     "Multi-daemon pass reductions by path (collective = on-mesh "
     "reduce_mesh; hub = driver-mediated export/merge fallback)",
 )
+_M_DAEMON_LOSSES = metrics_mod.counter(
+    "srml_fit_daemon_losses_total",
+    "Peer daemons declared permanently dead and quarantined by an "
+    "elastic fit (fit_daemon_loss_tolerance > 0; docs/protocol.md "
+    "'Permanent daemon loss'), by algo",
+)
+_M_FIT_REROUTES = metrics_mod.counter(
+    "srml_fit_reroutes_total",
+    "Feed passes rerun on the shrunken topology after a daemon loss — "
+    "the dead daemon's partitions reroute to survivors, by algo",
+)
 
 
 def _drop_quietly(client, job: str, stage: str) -> None:
@@ -144,6 +155,34 @@ def _df_to_arrow(df, columns):
 _DAEMON_ID_CACHE: dict = {}
 
 
+def _evict_daemon_id_cache(job: str, addr: Optional[str] = None,
+                           prefix: bool = False) -> None:
+    """Drop this fit's id-cache routes from THIS PROCESS's cache (all of
+    them on fit exit; only a quarantined daemon's on amputation). The
+    entries are job-scoped, so without the fit-exit sweep a long-lived
+    driver-process deployment (tasks running in the driver's
+    interpreter) leaks one per (fit, daemon) and a RECYCLED job name
+    could inherit a stale daemon id from the fit that used the name
+    before. Each process owns its own copy: the eviction that matters on
+    real executors (reused Spark python workers) rides the replayed
+    task itself — ``_FeedTask.evict_routes``. ``prefix`` sweeps every
+    job under a uid prefix (the KNN fit shell, which exits outside the
+    scope that minted the exact job name)."""
+    if addr is not None:
+        try:
+            host, port = daemon_session._parse_addr(addr)
+        except ValueError:
+            return
+        _DAEMON_ID_CACHE.pop((job, host, port), None)
+        return
+    match = (
+        (lambda k: str(k[0]).startswith(job)) if prefix
+        else (lambda k: k[0] == job)
+    )
+    for key in [k for k in _DAEMON_ID_CACHE if match(k)]:
+        _DAEMON_ID_CACHE.pop(key, None)
+
+
 class _FeedTask:
     """The executor-side partition feeder (a plain-pickle-able callable —
     shipped to tasks by Spark's closure serializer; imports happen on the
@@ -156,11 +195,17 @@ class _FeedTask:
     exactly-once accumulation (see serve/daemon.py)."""
 
     def __init__(self, host, port, token, job, algo, input_col, label_col,
-                 params, pass_id):
+                 params, pass_id, evict_routes=()):
         self.host, self.port, self.token = host, port, token
         self.job, self.algo = job, algo
         self.input_col, self.label_col = input_col, label_col
         self.params, self.pass_id = params, pass_id
+        # Quarantined-daemon addresses (elastic degrade): evicted from
+        # the EXECUTOR-side id cache at task start — the cache lives in
+        # reused Spark python workers, where the driver's own eviction
+        # cannot reach; a replacement daemon at the dead address must be
+        # re-pinged, not answered from the ghost's cached id.
+        self.evict_routes = tuple(evict_routes)
         # Distributed tracing: the driver's journal frame at task
         # construction rides the closure to the executor, whose client
         # stamps it on every wire op — the daemon's spans then parent
@@ -176,6 +221,14 @@ class _FeedTask:
 
         pid, attempt = ds.task_context()
         h, p = ds.executor_daemon_address(self.host, self.port)
+        for bad in self.evict_routes:
+            # Executor-side quarantine eviction (see __init__): runs in
+            # the worker process that actually OWNS the cache.
+            try:
+                bh, bp = ds._parse_addr(bad)
+            except ValueError:
+                continue
+            _DAEMON_ID_CACHE.pop((self.job, bh, bp), None)
         rows = 0
         # client_kwargs(): executor-env resilience tuning — per-op healing
         # deadline, socket timeout — so a daemon hiccup or busy-shed is
@@ -519,7 +572,12 @@ class _SparkAdapter:
             "fit", estimator=type(self).__name__, algo="knn",
             uid=self._core.uid,
         ):
-            return self._fit_knn_inner(df)
+            try:
+                return self._fit_knn_inner(df)
+            finally:
+                # This fit's job is f"{uid}-{hex}" — sweep by prefix
+                # (the exact name is minted inside the inner scope).
+                _evict_daemon_id_cache(f"{self._core.uid}-", prefix=True)
 
     def _fit_knn_inner(self, df):
         """Daemon-fed KNN/ANN fit: executors stream partitions to a knn
@@ -776,6 +834,18 @@ class _SparkAdapter:
         # change before the failure surfaces. 0 = off — and genuinely
         # zero-overhead: no ledger pulls, no extra wire ops.
         rec_attempts = daemon_session.recovery_attempts(spark)
+        # Elastic degrade (docs/protocol.md "Permanent daemon loss"): how
+        # many PEER daemons this fit may declare permanently dead and
+        # amputate, and the reconnect/deadline budget a peer gets before
+        # it escalates from *retrying* to *declared dead*. 0 (default) =
+        # off: a lost daemon is today's loud error and no classification
+        # probe ever runs. The recovery LEDGER arms for either feature —
+        # an amputation rewinds survivors through the same boundary
+        # replay a reboot does.
+        loss_tolerance = daemon_session.daemon_loss_tolerance(spark)
+        death_timeout = daemon_session.daemon_death_timeout_s(spark)
+        elastic = loss_tolerance > 0
+        ledger_on = bool(rec_attempts) or elastic
         job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
         input_col = core.getOrDefault(
             "inputCol" if core.hasParam("inputCol") else "featuresCol"
@@ -809,6 +879,12 @@ class _SparkAdapter:
         # "this plane has no mesh ops" verdict so a fit probes once, not
         # every pass.
         mesh_cache: dict = {}
+        # Amputated daemons (id → last known address): a quarantined
+        # daemon is out of the fit for good — its routes are evicted, it
+        # is never synced or merged again, and a replayed pass that still
+        # acks rows from it fails loudly (it is alive with unrewound
+        # state; the routing must stop feeding it).
+        quarantined: dict = {}
 
         def peer_client(did, addr=None):
             c = peer_clients.get(did)
@@ -876,7 +952,7 @@ class _SparkAdapter:
                         # an unreachable/unauthorized peer)
                         if not registered:
                             pc.close()
-                if rec_attempts:
+                if ledger_on:
                     # Ledger seed: pass 0 opens with the seeded centers —
                     # a pass-0 replay re-installs exactly these.
                     ledger["arrays"], ledger["iteration"] = (
@@ -890,6 +966,12 @@ class _SparkAdapter:
                 fn = _FeedTask(
                     host, port, token, job, wire_algo, input_col,
                     label_col or "label", feed_params, pass_id,
+                    # Ship the amputation set to the executors: THEIR
+                    # cache copies hold the dead daemon's id (reused
+                    # python workers), not the driver's.
+                    evict_routes=sorted(
+                        addr for addr in quarantined.values() if addr
+                    ),
                 )
                 with trace_span("feed pass"):
                     acks = sel.mapInArrow(
@@ -898,6 +980,20 @@ class _SparkAdapter:
                         "daemon_id string, boots string",
                     ).collect()
                 n, per, addr_of, owner, boots = _ack_rows(acks)
+                for did, cnt in per.items():
+                    if cnt > 0 and did in quarantined:
+                        # The amputation's safety valve: a daemon that
+                        # was declared dead but ANSWERS the replayed
+                        # scan is alive with unrewound state — folding
+                        # its rows would corrupt the model the rewind
+                        # just repaired.
+                        raise RuntimeError(
+                            f"daemon {addr_of[did]} ({did}) was declared "
+                            f"dead and quarantined, yet acked {cnt} rows "
+                            "of the replayed pass: it is alive and holds "
+                            "un-rewound state. Stop routing executors to "
+                            "it (it left this fit for good), or refit."
+                        )
                 for did, cnt in per.items():
                     fed_by_daemon[did] = fed_by_daemon.get(did, 0) + cnt
                     addr_by_id.setdefault(did, addr_of[did])
@@ -1017,13 +1113,13 @@ class _SparkAdapter:
                 converged-logreg boundary, where nothing will read a
                 peer's iterate but a finalize replay still rewinds to
                 exactly this iterate."""
-                if not (peers and push_peers) and not rec_attempts:
+                if not (peers and push_peers) and not ledger_on:
                     return
                 arrays, iteration = client.get_iterate(job)
                 if push_peers:
                     for did in sorted(peers):
                         peer_client(did).set_iterate(job, arrays, iteration)
-                if rec_attempts:
+                if ledger_on:
                     # The ledger advances ONLY once every daemon holds
                     # the new boundary: a half-pushed boundary (a peer
                     # died mid-sync) must replay from the OLD one — an
@@ -1031,6 +1127,130 @@ class _SparkAdapter:
                     # iteration N+1 while the replay re-feeds pass N,
                     # turning every replay into a stale-pass rejection.
                     ledger["arrays"], ledger["iteration"] = arrays, iteration
+
+            def _probe_alive(addr_tuple) -> bool:
+                """Liveness verdict under the death policy: the probing
+                client's op deadline IS ``fit_daemon_death_timeout_s``,
+                so the daemon gets the WHOLE reconnect/backoff budget to
+                answer one ping — a slow or busy daemon that makes it in
+                time is never amputated on a hunch."""
+                probe_kw = dict(ckw)
+                probe_kw["op_deadline_s"] = death_timeout
+                probe_kw["max_op_attempts"] = max(
+                    int(probe_kw.get("max_op_attempts", 5)), 8
+                )
+                try:
+                    with DataPlaneClient(*addr_tuple, token=token,
+                                         **probe_kw) as pc:
+                        pc.ping()
+                    return True
+                except Exception:
+                    return False
+
+            def try_quarantine(err) -> bool:
+                """The death policy's classification step, run only after
+                a pass unit already failed (zero wire ops on the happy
+                path): probe every peer within the death deadline,
+                corroborate with mesh membership when co-resident, and
+                amputate the corroborated-dead peers if the loss budget
+                allows. True = at least one daemon quarantined (the pass
+                replays on the shrunken topology); False = nothing
+                classified as dead — the transient replay budget (or the
+                original error) rules."""
+                if not peers:
+                    return False
+                # Mesh corroboration (docs/mesh.md): on the collective
+                # path the membership registry is a second witness — a
+                # peer the device plane still lists as a live member is
+                # NOT dead, however its TCP probe fared.
+                live_members = None
+                if not mesh_cache.get("hub_only"):
+                    try:
+                        info = client.mesh_info()
+                        live_members = {
+                            str(m["id"]) for m in info.get("members", [])
+                        }
+                    except Exception:
+                        live_members = None
+                # Probes run CONCURRENTLY (independent reads): a pod-
+                # scale fit partitioned away from several peers must
+                # classify in ~one death deadline, not n_peers of them.
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                    max_workers=min(len(peers) + 1, 16)
+                ) as ex:
+                    primary_fut = ex.submit(_probe_alive, (host, port))
+                    peer_futs = {
+                        did: ex.submit(_probe_alive, peers[did])
+                        for did in sorted(peers)
+                    }
+                    primary_ok = primary_fut.result()
+                    alive = {d_: f.result() for d_, f in peer_futs.items()}
+                # The primary is the reduce target and the rewind anchor:
+                # its loss is not survivable by amputation — name that
+                # clearly instead of burning the tolerance on peers.
+                if not primary_ok:
+                    raise RuntimeError(
+                        f"primary daemon {host}:{port} is unreachable "
+                        f"(no answer within the {death_timeout:.1f}s "
+                        "death deadline): elastic degrade can only "
+                        "amputate PEER daemons — the primary holds the "
+                        "folded state. Restart it (crash recovery "
+                        "resurrects durable jobs) or refit."
+                    ) from err
+                dead = []
+                for did in sorted(peers):
+                    if alive[did]:
+                        continue
+                    if live_members is not None and did in live_members:
+                        logger.warning(
+                            "peer daemon %s failed its liveness probe "
+                            "but is still a live mesh member — treating "
+                            "the failure as transient, not a death",
+                            addr_by_id.get(did, did),
+                        )
+                        continue
+                    dead.append(did)
+                if not dead:
+                    return False
+                if len(quarantined) + len(dead) > loss_tolerance:
+                    raise RuntimeError(
+                        f"daemon(s) "
+                        f"{[addr_by_id.get(d, d) for d in dead]} gave no "
+                        f"answer within the {death_timeout:.1f}s death "
+                        f"deadline, but this fit's loss budget is spent "
+                        f"(fit_daemon_loss_tolerance={loss_tolerance}, "
+                        f"{len(quarantined)} already quarantined). Raise "
+                        "the tolerance, or refit on the surviving "
+                        "daemons."
+                    ) from err
+                for did in dead:
+                    addr = addr_by_id.get(did)
+                    quarantined[did] = addr
+                    peers.pop(did, None)
+                    pc = peer_clients.pop(did, None)
+                    if pc is not None:
+                        pc.close()
+                    if addr is not None:
+                        # The replayed tasks must re-ping whatever now
+                        # answers at the dead daemon's address — a cached
+                        # id would resurrect the ghost.
+                        _evict_daemon_id_cache(job, addr)
+                    _M_DAEMON_LOSSES.inc(algo=str(algo))
+                    journal.mark(
+                        "fit daemon loss", algo=algo, job=job,
+                        daemon=did, addr=addr,
+                    )
+                    logger.warning(
+                        "fit elastic degrade (%s): peer daemon %s (%s) "
+                        "declared dead — no answer within the %.1fs "
+                        "death deadline; quarantining it and replaying "
+                        "from the last pass boundary with its "
+                        "partitions rerouted to the %d survivor(s)",
+                        algo, addr, did, death_timeout, len(peers) + 1,
+                    )
+                return True
 
             def recover(err):
                 """Rewind the fit to the last pass boundary: re-seed the
@@ -1095,8 +1315,16 @@ class _SparkAdapter:
                 replayed — a full-dataset re-scan cannot fix an empty
                 DataFrame or a bad label column. Daemon/task failures
                 (RuntimeError from acks, transport errors, job aborts)
-                are the retryable class the replay exists for."""
-                for attempt in range(rec_attempts + 1):
+                are the retryable class the replay exists for.
+
+                Elastic degrade rides the same loop: a failure that
+                classifies as a PERMANENT daemon death (try_quarantine)
+                replays the pass on the shrunken topology without
+                consuming the transient replay budget — each amputation
+                consumes the loss tolerance instead, so both budgets
+                stay bounded."""
+                attempt = 0
+                while True:
                     try:
                         return body()
                     except (ValueError, TypeError, KeyError,
@@ -1104,8 +1332,18 @@ class _SparkAdapter:
                             NotImplementedError):
                         raise  # deterministic — a replay cannot help
                     except Exception as e:
+                        if elastic and try_quarantine(e):
+                            with trace_span("elastic degrade"):
+                                _M_FIT_REROUTES.inc(algo=str(algo))
+                                journal.mark(
+                                    "fit elastic-degrade", algo=algo,
+                                    job=job, error=str(e)[:300],
+                                )
+                                recover(e)
+                            continue
                         if attempt >= rec_attempts:
                             raise
+                        attempt += 1
                         recover(e)
 
             if algo == "scaler":
@@ -1310,6 +1548,11 @@ class _SparkAdapter:
                     loss=info["loss"], numIter=info["iteration"], n_rows=rows
                 )
         finally:
+            # The fit's id-cache routes die with the fit (success,
+            # failure, or quarantine): the entries are job-scoped, so a
+            # leaked one both grows forever on a long-lived driver and
+            # could hand a RECYCLED job name a stale daemon id.
+            _evict_daemon_id_cache(job)
             # no-op when finalize already dropped it; failures are
             # COUNTED (srml_client_drop_errors_total) — a swallowed drop
             # leaks the daemon job until the TTL reaper hides it.

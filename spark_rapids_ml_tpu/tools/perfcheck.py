@@ -336,6 +336,90 @@ def check_serve_fleet(
     return ok and t_ok, lines + t_lines
 
 
+def check_chaos_elastic(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --chaos-elastic`` record (the 3→2 daemon
+    kmeans degrade). Correctness gates are ABSOLUTE — a record whose
+    degraded fit was not bitwise-equal to the surviving-topology oracle,
+    or that replayed no rows, FAILS regardless of history. The COST
+    gates are trajectory-relative: replay throughput (``value``) must
+    stay within ``max_regression`` of the metric-matched median, and
+    ``recovery_overhead`` (time-to-recover / steady pass) must not grow
+    past (1 + max_regression) × its median. No history → cost gates
+    SKIP with a note (first record seeds the trajectory)."""
+    lines: List[str] = []
+    if fresh.get("mode") != "chaos_elastic":
+        return False, [
+            "record has no mode=chaos_elastic — not a "
+            "bench.py --chaos-elastic record?"
+        ]
+    ok = True
+    if not bool(fresh.get("bitwise_equal_oracle")):
+        ok = False
+        lines.append(
+            "elastic correctness [FAIL] the degraded fit was NOT "
+            "bitwise-equal to the surviving-topology oracle — the "
+            "recovery itself is broken; no cost number matters"
+        )
+    else:
+        lines.append(
+            "elastic correctness [OK] degraded fit bitwise-equal to the "
+            f"{fresh.get('n_survivors')}-daemon oracle"
+        )
+    replayed = int(fresh.get("replayed_rows") or 0)
+    if replayed <= 0:
+        ok = False
+        lines.append(
+            "elastic correctness [FAIL] record replayed 0 rows — the "
+            "degrade path never ran"
+        )
+    matching = [
+        h for h in history
+        if h.get("mode") == "chaos_elastic"
+        and h.get("metric") == fresh.get("metric")
+    ]
+    value = float(fresh.get("value") or 0.0)
+    overhead = fresh.get("recovery_overhead")
+    if not matching:
+        lines.append(
+            f"recovery cost [SKIP] no CHAOS_r* history matches metric "
+            f"{fresh.get('metric')!r} — recorded "
+            f"{fresh.get('time_to_recover_s')}s to recover "
+            f"({replayed:,} rows; overhead {overhead}×), nothing gated"
+        )
+        return ok, lines
+    base_v = _median([
+        float(h["value"]) for h in matching if h.get("value") is not None
+    ] or [value])
+    floor = (1.0 - max_regression) * base_v
+    verdict = "OK" if value >= floor else "REGRESSION"
+    lines.append(
+        f"replay throughput [{verdict}] {value:,.1f} rows/s vs median "
+        f"{base_v:,.1f} over {len(matching)} record(s) "
+        f"(gate at -{max_regression:.0%})"
+    )
+    if value < floor:
+        ok = False
+    ovs = [
+        float(h["recovery_overhead"]) for h in matching
+        if h.get("recovery_overhead") is not None
+    ]
+    if overhead is not None and ovs:
+        ceil = (1.0 + max_regression) * _median(ovs)
+        verdict = "OK" if float(overhead) <= ceil else "REGRESSION"
+        lines.append(
+            f"recovery overhead [{verdict}] {float(overhead):.3f}x a "
+            f"steady pass vs ceiling {ceil:.3f}x "
+            f"(median {_median(ovs):.3f}x)"
+        )
+        if float(overhead) > ceil:
+            ok = False
+    return ok, lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.perfcheck",
@@ -398,12 +482,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         _is_dryrun(fresh) and "n_devices" in fresh
     )
     fleet = str(fresh.get("metric", "")).startswith("serve_fleet_")
+    chaos = str(fresh.get("metric", "")).startswith("chaos_elastic_")
     default_glob = (
-        "FLEET_r*.json" if fleet
+        "CHAOS_r*.json" if chaos
+        else "FLEET_r*.json" if fleet
         else "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
     )
     history = load_history(args.history or [default_glob])
-    if fleet:
+    if chaos:
+        ok, lines = check_chaos_elastic(
+            fresh, history, max_regression=args.max_regression,
+        )
+    elif fleet:
         ok, lines = check_serve_fleet(
             fresh, history, max_regression=args.max_regression,
         )

@@ -40,6 +40,8 @@ TX = "srml_daemon_tx_bytes_total"
 PHASES = "srml_phase_duration_seconds"
 RESTORES = "srml_daemon_job_restores_total"
 RECOVERIES = "srml_fit_recoveries_total"
+LOSSES = "srml_fit_daemon_losses_total"
+REROUTES = "srml_fit_reroutes_total"
 SCHED_QUEUE = "srml_scheduler_queue_depth"
 SCHED_BATCH_ROWS = "srml_scheduler_batch_rows"
 SCHED_BATCHED = "srml_scheduler_batched_requests_total"
@@ -145,7 +147,15 @@ def render(
         float(s.get("value", 0.0))
         for s in (snap.get(RECOVERIES) or {}).get("samples", [])
     )
-    if boot or restores or recoveries:
+    losses = sum(
+        float(s.get("value", 0.0))
+        for s in (snap.get(LOSSES) or {}).get("samples", [])
+    )
+    reroutes = sum(
+        float(s.get("value", 0.0))
+        for s in (snap.get(REROUTES) or {}).get("samples", [])
+    )
+    if boot or restores or recoveries or losses or reroutes:
         bits = []
         if boot:
             durable = "durable" if health.get("durable") else "volatile"
@@ -154,6 +164,12 @@ def render(
             bits.append(f"jobs restored {int(restores)}")
         if recoveries:
             bits.append(f"fit recoveries {int(recoveries)}")
+        if losses:
+            # An operator must see an amputation at a glance: each one
+            # is a daemon the fleet permanently lost mid-fit.
+            bits.append(f"daemons lost {int(losses)}")
+        if reroutes:
+            bits.append(f"passes rerouted {int(reroutes)}")
         lines.append("  ".join(bits))
     reqs = _sum_by_op(snap.get(REQ))
     prev_reqs = _sum_by_op((prev or {}).get(REQ))

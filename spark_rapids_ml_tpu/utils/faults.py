@@ -25,6 +25,13 @@ fires):
                           durable snapshot, when armed), before the ack —
                           a crash here is a daemon dying exactly between
                           two passes
+``daemon.vanish``         daemon side, at the cross-daemon coordination
+                          ops (set_iterate / export_state / reduce_mesh):
+                          a crash here is a PEER daemon dying at the
+                          moment the fit coordinates across daemons —
+                          the permanent-loss site; elastic-fit chaos
+                          tests pair it with NO restart
+                          (docs/protocol.md "Permanent daemon loss")
 ``daemon.scheduler``      serving-scheduler admission (serve/scheduler.py):
                           a drop/refuse here is translated into a shed —
                           the request is answered with the busy/

@@ -161,7 +161,11 @@ class SimDataFrame:
         self._max_attempts = max_attempts
         # env_plan: partition -> extra task env (models executors on
         # DIFFERENT hosts: e.g. a per-executor SRML_DAEMON_ADDRESS that
-        # routes the task to its host-local daemon).
+        # routes the task to its host-local daemon). A LIST value is
+        # per-ATTEMPT env — attempt i gets entry min(i, last) — which
+        # models Spark rescheduling a failed task onto a different host
+        # (the elastic-fit suite reroutes a dead daemon's partitions to
+        # survivors this way).
         self._env_plan = env_plan or {}
         # Partition tasks run CONCURRENTLY like Spark's scheduler (each
         # still its own OS process); retries stay sequential within a
@@ -299,7 +303,10 @@ class SimDataFrame:
             k: v for k, v in os.environ.items()
             if k.startswith(("SRML_", "JAX_"))
         }
-        env.update(self._env_plan.get(pid, {}))
+        extra = self._env_plan.get(pid, {})
+        if isinstance(extra, (list, tuple)):
+            extra = extra[min(attempt, len(extra) - 1)] if extra else {}
+        env.update(extra)
         proc = ctx.Process(
             target=_run_task,
             args=(self._mapped, list(batches), pid, attempt, fail_after, q, env),

@@ -13,10 +13,7 @@ to the fault-free result, and the plan actually FIRED (a chaos test
 whose faults never triggered proves nothing).
 """
 
-import os
 import socket
-import subprocess
-import sys
 import threading
 import time
 
@@ -352,25 +349,10 @@ def test_connection_watermark_sheds_heavy_ops(mesh8, rng):
 # ---------------- daemon killed and restarted mid-job (process) --------------
 
 
-def _spawn_worker(port, fault_spec=None):
-    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
-    env["JAX_PLATFORMS"] = "cpu"
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p
-    )
-    if fault_spec:
-        env["SRML_FAULT_PLAN"] = fault_spec
-    proc = subprocess.Popen(
-        [sys.executable,
-         os.path.join(os.path.dirname(__file__), "daemon_worker.py"),
-         str(port)],
-        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-        cwd=repo_root, env=env, text=True,
-    )
-    line = proc.stdout.readline().strip()
-    assert line.startswith("READY "), line
-    return proc, int(line.split()[1])
+# Worker spawning is centralized in conftest.py (the f64-pinned env);
+# the fault-free REFERENCE run shares the module-scoped worker pair
+# instead of spawning its own (VERDICT carry #7).
+from conftest import spawn_daemon_worker  # noqa: E402
 
 
 def _free_port():
@@ -381,34 +363,32 @@ def _free_port():
     return port
 
 
-def test_chaos_daemon_crash_restart_mid_job_exact(rng):
+def test_chaos_daemon_crash_restart_mid_job_exact(rng, worker_daemon_pair):
     """The flagship: a daemon PROCESS with an env-activated
     crash-on-Nth-op plan dies abruptly (exit 17) mid-fit; a supervisor
     restarts it at the same address; client-side drops keep firing the
     whole time. The fit completes through fit-level retry + client
     healing and matches the fault-free run from an identical clean
-    worker exactly."""
+    worker (the module's shared pair) exactly."""
     x = (rng.normal(size=(160, 5)) + 2.0 * rng.integers(0, 3, size=(160, 1))
          ).astype(np.float64)
     parts = [np.ascontiguousarray(p) for p in np.array_split(x, 4)]
     port = _free_port()
     procs = []
     try:
-        # Fault-free reference from a clean worker process.
-        proc, port_r = _spawn_worker(port)
-        procs.append(proc)
+        # Fault-free reference from the shared clean worker.
+        _, port_r = worker_daemon_pair[0]
         baseline, _ = _drive_kmeans(
-            ("127.0.0.1", port_r), parts, k=3, seed=11, iters=3, job="ref"
+            ("127.0.0.1", port_r), parts, k=3, seed=11, iters=3,
+            job="chaos-flagship-ref",
         )
-        proc.stdin.close()
-        proc.wait(timeout=30)
 
         # Chaos worker: dies abruptly on its 30th op, with latency before
         # that; the supervisor below restarts a clean one at the SAME port.
         state = {"proc": None, "crashed": False}
 
         def start(spec):
-            p, _ = _spawn_worker(port, fault_spec=spec)
+            p, _ = spawn_daemon_worker(port, fault_spec=spec)
             state["proc"] = p
 
         start("seed=5;daemon.op:crash:after=12,times=1;"

@@ -1,0 +1,487 @@
+"""Elastic fit: graceful degradation under PERMANENT daemon loss
+(ISSUE 10; docs/protocol.md "Permanent daemon loss").
+
+The claim under test: a multi-daemon fit whose PEER daemon dies and
+NEVER comes back — the evicted-host case PR 4's reboot recovery does not
+cover — completes anyway when the operator grants a loss budget
+(``fit_daemon_loss_tolerance``): the driver classifies the death (probe
+within the ``fit_daemon_death_timeout_s`` budget, mesh membership as the
+co-resident witness), quarantines the daemon, rewinds survivors to the
+last pass boundary via the recovery ledger, and reruns the scan with the
+dead daemon's partitions rerouted to survivors (sparksim's per-attempt
+env plan models Spark rescheduling onto surviving hosts). The fitted
+model must be BITWISE-identical to an uninterrupted fit on the surviving
+topology — integer-valued data makes every fold exact, so any lost,
+duplicated, or double-merged row is a hard mismatch.
+
+With the DEFAULT tolerance of 0 the same death is today's loud error —
+no probe ever runs, byte-for-byte the pre-elastic behavior.
+
+The in-process tests cover BOTH reduce paths (collective `reduce_mesh`
+and the driver hub) plus the PCA single-pass variant; the subprocess
+flagship SIGKILLs a real daemon process (exit 17, no restart) under the
+hub path and is marked ``slow`` per the recovery-flagship convention.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+from spark_rapids_ml_tpu.spark import estimator as spark_est
+from spark_rapids_ml_tpu.spark.estimator import (
+    _DAEMON_ID_CACHE,
+    _evict_daemon_id_cache,
+    SparkKMeans,
+    SparkPCA,
+)
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.faults import FaultPlan
+
+from conftest import spawn_daemon_worker
+from sparksim import SimDataFrame, SimSparkSession, simdf_from_numpy
+
+pytestmark = pytest.mark.elastic
+
+spark_est.register_dataframe_type(SimDataFrame)
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leaks():
+    yield
+    faults.deactivate()
+    assert faults.active_plan() is None
+
+
+@pytest.fixture(autouse=True)
+def _fast_dead_daemon_clients(monkeypatch):
+    """Bound every client's dead-daemon retry cost: the elastic runs
+    deliberately talk to a stopped daemon many times (task reroutes,
+    boundary syncs, probes), and the default 5-attempt healing would
+    dominate the suite's wall clock."""
+    monkeypatch.setenv("SRML_DAEMON_OP_ATTEMPTS", "2")
+    monkeypatch.setenv("SRML_FIT_DAEMON_DEATH_TIMEOUT_S", "2")
+
+
+@pytest.fixture
+def three_daemons():
+    """Primary + two peers, in-process ('three TPU hosts' on one box;
+    real TCP, one shared device plane so the collective path applies)."""
+    with DataPlaneDaemon(ttl=600.0) as a, DataPlaneDaemon(ttl=600.0) as b, \
+            DataPlaneDaemon(ttl=600.0) as c:
+        yield a, b, c
+
+
+def _addr(daemon) -> str:
+    return f"{daemon.address[0]}:{daemon.address[1]}"
+
+
+def _counter_total(name):
+    snap = metrics_mod.snapshot()
+    return sum(
+        float(s.get("value", 0.0))
+        for s in (snap.get(name) or {}).get("samples", [])
+    )
+
+
+def _int_blobs(rng, k=3, d=5, per=60):
+    """Integer-valued clustered rows: every sufficient statistic is
+    exact in the accumulator dtype, so fold order/grouping cannot
+    perturb the model — equality checks are bitwise (the multidaemon
+    suite's convention)."""
+    centers = rng.integers(-12, 13, size=(k, d)) * 4
+    x = np.concatenate(
+        [centers[i] + rng.integers(-1, 2, size=(per, d)) for i in range(k)]
+    ).astype(np.float64)
+    return x[rng.permutation(len(x))]
+
+
+def _reroute_env(addr_dead, addr_survivor, addr_c):
+    """Partition routing: 0,1 → primary; 2,3 → the doomed daemon with
+    per-ATTEMPT failover to the survivor (Spark rescheduling a lost
+    host's tasks); 4,5 → the surviving peer."""
+    return {
+        2: [{"SRML_DAEMON_ADDRESS": addr_dead},
+            {"SRML_DAEMON_ADDRESS": addr_survivor}],
+        3: [{"SRML_DAEMON_ADDRESS": addr_dead},
+            {"SRML_DAEMON_ADDRESS": addr_survivor}],
+        4: {"SRML_DAEMON_ADDRESS": addr_c},
+        5: {"SRML_DAEMON_ADDRESS": addr_c},
+    }
+
+
+def _survivor_env(addr_c):
+    """The oracle topology: the dead daemon's partitions live on the
+    primary, 4,5 on the surviving peer — exactly where the elastic fit
+    reroutes them."""
+    return {
+        4: {"SRML_DAEMON_ADDRESS": addr_c},
+        5: {"SRML_DAEMON_ADDRESS": addr_c},
+    }
+
+
+def _fit_kmeans(x, primary_addr, env_plan, addresses):
+    session = SimSparkSession({
+        "spark.srml.daemon.address": primary_addr,
+        "spark.srml.daemon.addresses": addresses,
+    })
+    df = simdf_from_numpy(x, n_partitions=6, session=session,
+                          env_plan=env_plan, concurrency=1)
+    return SparkKMeans().setK(3).setMaxIter(3).setSeed(5).fit(df)
+
+
+def _fit_pca(x, primary_addr, env_plan):
+    session = SimSparkSession({"spark.srml.daemon.address": primary_addr})
+    df = simdf_from_numpy(x, n_partitions=6, session=session,
+                          env_plan=env_plan, concurrency=1)
+    return SparkPCA().setInputCol("features").setK(3).fit(df)
+
+
+@pytest.mark.parametrize("collectives", [True, False],
+                         ids=["collective", "hub"])
+def test_kmeans_elastic_degrade_bitwise(rng, mesh8, monkeypatch, collectives,
+                                        three_daemons):
+    """THE tentpole scenario on both reduce paths: 3-daemon iterative
+    fit, one peer dies permanently mid-fit (daemon.vanish at a boundary
+    sync, stop() with NO restart), tolerance=1 — the fit completes with
+    the model bitwise-equal to an uninterrupted fit on the surviving
+    2-daemon topology, and the loss/reroute telemetry fired."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.setenv("SRML_FIT_DAEMON_LOSS_TOLERANCE", "1")
+    losses0 = _counter_total("srml_fit_daemon_losses_total")
+    reroutes0 = _counter_total("srml_fit_reroutes_total")
+    with config.option("mesh_collectives", collectives):
+        oracle = _fit_kmeans(
+            x, _addr(a), _survivor_env(_addr(c)),
+            addresses=f"{_addr(a)},{_addr(c)}",
+        )
+        # after=1: the first vanish hit is pass 0's reduce (collective)
+        # or a pass-0 export (hub); the SECOND lands inside the pass-0
+        # boundary coordination — wherever it fires, the callback kills
+        # b for good.
+        plan = (
+            FaultPlan(seed=2)
+            .rule("daemon.vanish", "crash", after=1, times=1)
+            .on_crash(b.stop)
+        )
+        with faults.active(plan):
+            m = _fit_kmeans(
+                x, _addr(a), _reroute_env(_addr(b), _addr(a), _addr(c)),
+                addresses=f"{_addr(a)},{_addr(b)},{_addr(c)}",
+            )
+    assert plan.fired.get("daemon.vanish") == 1, (
+        "the permanent death never fired — the run proved nothing"
+    )
+    np.testing.assert_array_equal(m.centers, oracle.centers)
+    assert m.summary.trainingCost == oracle.summary.trainingCost
+    assert m.summary.numIter == oracle.summary.numIter
+    # zero lost rows: the dead daemon's partitions were re-fed entirely
+    assert m.summary.n_rows == x.shape[0]
+    assert _counter_total("srml_fit_daemon_losses_total") - losses0 >= 1
+    assert _counter_total("srml_fit_reroutes_total") - reroutes0 >= 1
+
+
+@pytest.mark.parametrize("collectives", [True, False],
+                         ids=["collective", "hub"])
+def test_pca_elastic_degrade_single_pass_bitwise(rng, mesh8, monkeypatch,
+                                                 collectives, three_daemons):
+    """The single-pass variant: no iterate ledger exists, so the rewind
+    degenerates to drop-and-rescan — the peer dies at the merge moment
+    (first vanish hit: the pass's reduce/export), after its rows were
+    already committed and acked, and the whole scan replays on the
+    shrunken topology."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng, k=3, d=8, per=60)
+    monkeypatch.setenv("SRML_FIT_DAEMON_LOSS_TOLERANCE", "1")
+    losses0 = _counter_total("srml_fit_daemon_losses_total")
+    with config.option("mesh_collectives", collectives):
+        oracle = _fit_pca(x, _addr(a), _survivor_env(_addr(c)))
+        plan = (
+            FaultPlan(seed=3)
+            .rule("daemon.vanish", "crash", after=0, times=1)
+            .on_crash(b.stop)
+        )
+        with faults.active(plan):
+            m = _fit_pca(
+                x, _addr(a), _reroute_env(_addr(b), _addr(a), _addr(c))
+            )
+    assert plan.fired.get("daemon.vanish") == 1
+    np.testing.assert_array_equal(m.pc, oracle.pc)
+    np.testing.assert_array_equal(m.mean, oracle.mean)
+    np.testing.assert_array_equal(m.explainedVariance, oracle.explainedVariance)
+    assert _counter_total("srml_fit_daemon_losses_total") - losses0 >= 1
+
+
+def test_default_zero_tolerance_is_todays_loud_error(rng, mesh8, monkeypatch,
+                                                     three_daemons):
+    """The acceptance pin: with fit_daemon_loss_tolerance at its default
+    0, the same permanent death fails the fit loudly — no probe runs, no
+    daemon is amputated, no model is returned."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.delenv("SRML_FIT_DAEMON_LOSS_TOLERANCE", raising=False)
+    losses0 = _counter_total("srml_fit_daemon_losses_total")
+    plan = (
+        FaultPlan(seed=2)
+        .rule("daemon.vanish", "crash", after=1, times=1)
+        .on_crash(b.stop)
+    )
+    with faults.active(plan):
+        with pytest.raises(OSError):
+            _fit_kmeans(
+                x, _addr(a), _reroute_env(_addr(b), _addr(a), _addr(c)),
+                addresses=f"{_addr(a)},{_addr(b)},{_addr(c)}",
+            )
+    assert plan.fired.get("daemon.vanish") == 1
+    assert _counter_total("srml_fit_daemon_losses_total") == losses0
+
+
+def test_loss_budget_exhausted_fails_loudly(rng, mesh8, monkeypatch,
+                                            three_daemons):
+    """Losing MORE daemons than the tolerance grants must surface a
+    clear budget error, not a silent partial model: both peers die at
+    once under tolerance=1."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.setenv("SRML_FIT_DAEMON_LOSS_TOLERANCE", "1")
+    plan = (
+        FaultPlan(seed=2)
+        .rule("daemon.vanish", "crash", after=1, times=1)
+        .on_crash(lambda: (b.stop(), c.stop()))
+    )
+    with faults.active(plan):
+        with pytest.raises(RuntimeError, match="loss budget"):
+            _fit_kmeans(
+                x, _addr(a), _reroute_env(_addr(b), _addr(a), _addr(c)),
+                addresses=f"{_addr(a)},{_addr(b)},{_addr(c)}",
+            )
+    assert plan.fired.get("daemon.vanish") == 1
+
+
+# --------------------- _DAEMON_ID_CACHE lifecycle ----------------------------
+
+
+def test_evict_daemon_id_cache_semantics():
+    """The cache-eviction helper: exact-job sweep, single-address
+    eviction (the quarantine path), and uid-prefix sweep (the KNN fit
+    shell) — none of them may touch another fit's routes."""
+    _DAEMON_ID_CACHE.clear()
+    _DAEMON_ID_CACHE[("job-a", "127.0.0.1", 1111)] = "id1"
+    _DAEMON_ID_CACHE[("job-a", "127.0.0.1", 2222)] = "id2"
+    _DAEMON_ID_CACHE[("job-b", "127.0.0.1", 1111)] = "id3"
+    _DAEMON_ID_CACHE[("uid9-deadbeef", "127.0.0.1", 3333)] = "id4"
+    _evict_daemon_id_cache("job-a", addr="127.0.0.1:1111")
+    assert ("job-a", "127.0.0.1", 1111) not in _DAEMON_ID_CACHE
+    assert ("job-a", "127.0.0.1", 2222) in _DAEMON_ID_CACHE
+    _evict_daemon_id_cache("job-a")
+    assert ("job-a", "127.0.0.1", 2222) not in _DAEMON_ID_CACHE
+    assert ("job-b", "127.0.0.1", 1111) in _DAEMON_ID_CACHE
+    _evict_daemon_id_cache("uid9-", prefix=True)
+    assert ("uid9-deadbeef", "127.0.0.1", 3333) not in _DAEMON_ID_CACHE
+    assert ("job-b", "127.0.0.1", 1111) in _DAEMON_ID_CACHE
+    # a malformed address is a no-op, never an error (cleanup path)
+    _evict_daemon_id_cache("job-b", addr="not-an-address")
+    _DAEMON_ID_CACHE.clear()
+
+
+def test_fit_exit_clears_the_fits_cache_routes(rng, mesh8, monkeypatch):
+    """End-to-end lifecycle (the leak fix): entries keyed by this fit's
+    job are gone after fit exit — a long-lived driver no longer grows an
+    entry per fit, and a recycled job name cannot inherit a stale daemon
+    id. The fit's job name is pinned by monkeypatching the uuid suffix."""
+    import uuid as uuid_mod
+
+    with DataPlaneDaemon(ttl=600.0) as a:
+        fake = uuid_mod.UUID(hex="deadbeef" * 4)
+        monkeypatch.setattr(spark_est.uuid, "uuid4", lambda: fake)
+        session = SimSparkSession({"spark.srml.daemon.address": _addr(a)})
+        df = simdf_from_numpy(_int_blobs(rng, per=40), n_partitions=2,
+                              session=session)
+        est = SparkPCA().setInputCol("features").setK(2)
+        job = f"{est._core.uid}-{fake.hex[:8]}"
+        # a stale route from "the fit that used this name before"
+        _DAEMON_ID_CACHE[(job, "127.0.0.1", a.address[1])] = "stale-ghost"
+        est.fit(df)
+        assert not [k for k in _DAEMON_ID_CACHE if k[0] == job], (
+            "fit exit left its id-cache routes behind"
+        )
+
+
+# ------------------- flagship: SIGKILL with NO restart -----------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_flagship_sigkill_no_restart_3to2_bitwise(rng, monkeypatch,
+                                                  worker_daemon_pair):
+    """THE acceptance flagship: three daemon PROCESSES (hub reduce path
+    by construction — separate runtimes), a kmeans fit mid-flight, and
+    the victim process dies abruptly (env-activated daemon.vanish crash,
+    exit 17) with NO restart. The fit must complete with zero lost rows
+    and a model bitwise-equal to an uninterrupted fit on the surviving
+    2-daemon topology. The two survivors are the module's shared worker
+    pair; only the victim is spawned (and killed) here."""
+    (_pa, port_a), (_pc, port_c) = worker_daemon_pair
+    addr_a, addr_c = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_c}"
+    x = _int_blobs(rng)
+    monkeypatch.setenv("SRML_FIT_DAEMON_LOSS_TOLERANCE", "1")
+    monkeypatch.setenv("SRML_FIT_DAEMON_DEATH_TIMEOUT_S", "4")
+    monkeypatch.setenv("SRML_DAEMON_ADDRESS", addr_a)
+
+    def fit(addresses, env_plan):
+        session = SimSparkSession({
+            "spark.srml.daemon.addresses": addresses,
+        })
+        df = simdf_from_numpy(x, n_partitions=6, session=session,
+                              env_plan=env_plan, concurrency=1)
+        return SparkKMeans().setK(3).setMaxIter(3).setSeed(5).fit(df)
+
+    oracle = fit(f"{addr_a},{addr_c}", _survivor_env(addr_c))
+
+    losses0 = _counter_total("srml_fit_daemon_losses_total")
+    # The victim dies at its SECOND vanish hit: its first is the pass-0
+    # export (hub merge), the second the pass-0 boundary set_iterate —
+    # mid-fit, after it committed and acked rows. os._exit(17), the
+    # honest process death; nothing ever restarts it.
+    victim, port_b = spawn_daemon_worker(
+        fault_spec="daemon.vanish:crash:after=1,times=1"
+    )
+    addr_b = f"127.0.0.1:{port_b}"
+    try:
+        m = fit(
+            f"{addr_a},{addr_b},{addr_c}",
+            _reroute_env(addr_b, addr_a, addr_c),
+        )
+        victim.wait(timeout=30)
+        assert victim.returncode == 17, (
+            "the injected permanent death never happened"
+        )
+        np.testing.assert_array_equal(m.centers, oracle.centers)
+        assert m.summary.trainingCost == oracle.summary.trainingCost
+        assert m.summary.numIter == oracle.summary.numIter
+        assert m.summary.n_rows == x.shape[0]  # zero lost rows
+        assert _counter_total("srml_fit_daemon_losses_total") - losses0 >= 1
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+
+
+# ------------------- bench --chaos-elastic + perfcheck gate ------------------
+
+
+def test_perfcheck_chaos_elastic_gates():
+    """The recovery-cost gate's unit matrix: correctness (bitwise vs the
+    surviving-topology oracle, nonzero replayed rows) is ABSOLUTE;
+    throughput/overhead gate against the metric-matched trajectory and
+    SKIP — never pass — without history."""
+    from spark_rapids_ml_tpu.tools import perfcheck
+
+    good = {
+        "metric": "chaos_elastic_replay_rows_per_s_d64_k8",
+        "mode": "chaos_elastic", "value": 1000.0, "replayed_rows": 100,
+        "recovery_overhead": 2.0, "bitwise_equal_oracle": True,
+        "n_survivors": 2, "time_to_recover_s": 0.5,
+    }
+    ok, lines = perfcheck.check_chaos_elastic(good, [])
+    assert ok and any("SKIP" in ln for ln in lines)
+    ok, lines = perfcheck.check_chaos_elastic(
+        dict(good, bitwise_equal_oracle=False), []
+    )
+    assert not ok and any("FAIL" in ln for ln in lines)
+    ok, _ = perfcheck.check_chaos_elastic(dict(good, replayed_rows=0), [good])
+    assert not ok
+    ok, _ = perfcheck.check_chaos_elastic(dict(good, value=500.0), [good])
+    assert not ok  # replay throughput regressed past the floor
+    ok, _ = perfcheck.check_chaos_elastic(
+        dict(good, recovery_overhead=5.0), [good]
+    )
+    assert not ok  # recovery got relatively MORE expensive
+    ok, _ = perfcheck.check_chaos_elastic(dict(good), [good])
+    assert ok  # healthy vs its own trajectory
+    ok, _ = perfcheck.check_chaos_elastic({"metric": "x"}, [])
+    assert not ok  # not a chaos-elastic record at all
+
+
+@pytest.mark.perf
+def test_bench_chaos_elastic_smoke_and_gate(tmp_path):
+    """End-to-end: ``bench.py --chaos-elastic`` at toy shapes emits one
+    self-verifying JSON record (bitwise_equal_oracle must hold even at
+    toy sizes — integer folds are exact at any scale) and the perfcheck
+    CLI routes it to the chaos gate: correctness OK, cost SKIP (no
+    history), exit 0."""
+    import json as json_mod
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SRML_BENCH_ELASTIC_PART_ROWS": "512",
+        "SRML_BENCH_ELASTIC_D": "8",
+        "SRML_BENCH_ELASTIC_K": "4",
+        "SRML_BENCH_ELASTIC_DEATH_TIMEOUT_S": "0.3",
+        "PYTHONPATH": os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p
+        ),
+    })
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--chaos-elastic"],
+        cwd=repo_root, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json_mod.loads(line)
+    assert rec["mode"] == "chaos_elastic"
+    assert rec["bitwise_equal_oracle"] is True
+    assert rec["replayed_rows"] == rec["rows"] > 0
+    assert rec["time_to_recover_s"] > 0
+
+    from spark_rapids_ml_tpu.tools import perfcheck
+
+    path = tmp_path / "rec.json"
+    path.write_text(line)
+    assert perfcheck.main(
+        [str(path), "--history", str(tmp_path / "no-history-*.json")]
+    ) == 0
+
+
+def test_feed_task_evicts_quarantined_routes_worker_side(rng, mesh8,
+                                                         monkeypatch):
+    """The eviction that matters on REAL executors rides the task
+    closure (``_FeedTask.evict_routes``): a reused python worker's
+    cached ghost id for a quarantined address is dropped at task start,
+    so whatever now answers at that address is re-pinged — the driver's
+    own cache copy cannot reach the worker's."""
+    import pyarrow as pa
+
+    from spark_rapids_ml_tpu.bridge.arrow import matrix_to_list_column
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+    from spark_rapids_ml_tpu.spark.estimator import _FeedTask
+
+    with DataPlaneDaemon(ttl=600.0) as d:
+        h, p = d.address
+        job = "evict-task-job"
+        # The "reused worker" state: a ghost id cached for the address
+        # a quarantined daemon used to hold.
+        _DAEMON_ID_CACHE[(job, h, p)] = "ghost-id"
+        monkeypatch.setenv("SRML_PARTITION_ID", "0")
+        monkeypatch.setenv("SRML_ATTEMPT", "0")
+        monkeypatch.delenv("SRML_DAEMON_ADDRESS", raising=False)
+        fn = _FeedTask(h, p, None, job, "pca", "features", "label", {},
+                       None, evict_routes=(f"{h}:{p}",))
+        batch = pa.table(
+            {"features": matrix_to_list_column(rng.normal(size=(8, 4)))}
+        ).to_batches()[0]
+        acks = list(fn([batch]))
+        assert _DAEMON_ID_CACHE[(job, h, p)] == d.instance_id, (
+            "the ghost id survived the task-borne eviction"
+        )
+        got = acks[0].column("daemon_id")[0].as_py()
+        assert got == d.instance_id  # the ack names the LIVE daemon
+        with DataPlaneClient(h, p) as c:
+            c.drop(job)
+        _evict_daemon_id_cache(job)

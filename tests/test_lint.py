@@ -53,7 +53,7 @@ def test_fault_checkpoints_exist_at_contract_sites():
     expect = {
         "serve/client.py": ["client.connect", "client.op"],
         "serve/daemon.py": ["daemon.conn", "daemon.op",
-                            "daemon.pass_boundary"],
+                            "daemon.pass_boundary", "daemon.vanish"],
         "serve/scheduler.py": ["daemon.scheduler"],
         "serve/protocol.py": ["wire.send_frame"],
         "bridge/arrow.py": ["bridge.to_matrix", "bridge.to_ipc"],
@@ -65,6 +65,53 @@ def test_fault_checkpoints_exist_at_contract_sites():
                 f"fault-injection site {site!r} missing from {rel} "
                 "(utils/faults.py module docstring lists the contract)"
             )
+
+
+def test_fault_sites_used_by_tests_exist_in_the_package():
+    """The inverse of the gate above, closing its blind spot: a chaos
+    test naming a site that NO ``faults.checkpoint(...)`` /
+    ``faults.truncation(...)`` call instruments never fires — a renamed
+    site silently turns the test into a no-op that proves nothing.
+    Every dotted site string used in a test FaultPlan (``.rule("x.y",
+    ...)``) or an env-spec string (``"x.y:kind"``) must exist as a
+    literal site in the package. Dot-free sites (``"s"``) are
+    unit-test-local fixtures of the faults framework itself and exempt."""
+    known = set()
+    for path in _py_sources():
+        text = path.read_text()
+        known.update(re.findall(
+            r"faults\.checkpoint\(\s*[\"']([a-z_.]+)[\"']", text
+        ))
+        known.update(re.findall(
+            r"faults\.truncation\(\s*[\"']([a-z_.]+)[\"']", text
+        ))
+    assert len(known) >= 8, (
+        f"only {len(known)} instrumented fault sites found — the hook "
+        "pattern or this regex regressed"
+    )
+    used = {}  # site -> first use location
+    tests_dir = Path(__file__).resolve().parent
+    rule_re = re.compile(r"\.rule\(\s*[\"']([a-z_]+(?:\.[a-z_]+)+)[\"']")
+    spec_re = re.compile(
+        r"[\"'][^\"']*?\b([a-z_]+(?:\.[a-z_]+)+)"
+        r":(?:latency|drop|refuse|partial|crash)\b"
+    )
+    for path in sorted(tests_dir.glob("*.py")):
+        if path.name == Path(__file__).name:
+            continue
+        text = path.read_text()
+        for m in list(rule_re.finditer(text)) + list(spec_re.finditer(text)):
+            used.setdefault(m.group(1), path.name)
+    assert used, "no FaultPlan sites found in tests — the regex regressed"
+    phantoms = sorted(
+        f"{site} (first used in {where})"
+        for site, where in used.items() if site not in known
+    )
+    assert phantoms == [], (
+        "chaos tests target fault sites that are not instrumented "
+        "anywhere in the package (the test is a silent no-op): "
+        + ", ".join(phantoms)
+    )
 
 
 def _def_bodies(text: str, pattern: str):

@@ -22,8 +22,6 @@ split-brain errors) — never silent wrong answers.
 
 import os
 import socket
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -199,14 +197,25 @@ def test_reaper_sweeps_orphan_snapshots(tmp_path, mesh8, rng):
             with open(litter, "wb") as f:
                 f.write(b"partial")
             os.utime(litter, (1.0, 1.0))
+            # ...and a served-model snapshot whose owner never called
+            # drop_model, evicted (mtime) far beyond the 8x-TTL disk
+            # retention window.
+            ghost_model = os.path.join(state, "model-ghost-0123456789.npz")
+            with open(ghost_model, "wb") as f:
+                f.write(b"npz-ish")
+            os.utime(ghost_model, (1.0, 1.0))
             import time as _time
             for _ in range(100):
-                if not (os.path.exists(orphan) or os.path.exists(litter)):
+                if not (os.path.exists(orphan) or os.path.exists(litter)
+                        or os.path.exists(ghost_model)):
                     break
                 c.status("live")  # keep the live job warm (not evicted)
                 _time.sleep(0.05)
             assert not os.path.exists(orphan), "orphan snapshot not swept"
             assert not os.path.exists(litter), "crashed .tmp not swept"
+            assert not os.path.exists(ghost_model), (
+                "stale served-model snapshot not swept"
+            )
             live_path = d._job_state_path("live")
             assert os.path.exists(live_path), "live job's snapshot swept!"
     finally:
@@ -394,38 +403,14 @@ def test_spark_kmeans_boundary_crash_without_recovery_fails_loudly(
 
 
 # ------------------- flagship: SIGKILL a daemon process ----------------------
+#
+# Worker spawning is centralized in conftest.py (spawn_daemon_worker /
+# stop_daemon_worker — the f64-pinned env every bitwise contract needs),
+# and the fault-free REFERENCE runs share the module-scoped
+# worker_daemon_pair instead of paying a fresh ~4 s jax import per
+# flagship (VERDICT carry #7). Only the crash VICTIMS are spawned here.
 
-
-def _spawn_worker(port, state_dir=None):
-    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
-    env["JAX_PLATFORMS"] = "cpu"
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p
-    )
-    argv = [
-        sys.executable,
-        os.path.join(os.path.dirname(__file__), "daemon_worker.py"),
-        str(port),
-    ]
-    if state_dir is not None:
-        argv.append(state_dir)
-    proc = subprocess.Popen(
-        argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-        cwd=repo_root, env=env, text=True,
-    )
-    line = proc.stdout.readline().strip()
-    assert line.startswith("READY "), line
-    return proc
-
-
-def _stop_worker(proc):
-    try:
-        if proc.poll() is None:
-            proc.stdin.close()
-            proc.wait(timeout=15)
-    except Exception:
-        proc.kill()
+from conftest import spawn_daemon_worker, stop_daemon_worker  # noqa: E402
 
 
 def _drive_kmeans_passes(c, job, parts, params, passes):
@@ -438,40 +423,40 @@ def _drive_kmeans_passes(c, job, parts, params, passes):
 
 
 @pytest.mark.slow
-def test_flagship_sigkill_between_kmeans_passes_bitwise(tmp_path, rng):
+def test_flagship_sigkill_between_kmeans_passes_bitwise(
+    tmp_path, rng, worker_daemon_pair
+):
     """THE acceptance scenario: SIGKILL the daemon process strictly
     between two kmeans passes (after a step's ack); restart it at the
     same address over the same state_dir. The restarted daemon
     resurrects the job and the fitted model equals the uninterrupted
-    fit's bit-for-bit."""
+    fit's bit-for-bit. The uninterrupted reference runs on the module's
+    shared worker (it is never killed — unique job name)."""
     x = _blobs(rng, 160, 5, 3, scale=2.0)
     parts = [np.ascontiguousarray(p) for p in np.array_split(x, 4)]
     params = {"k": 3, "seed": 11}
     seed_batch = np.concatenate(parts)[:30]
     procs = []
     try:
-        # Uninterrupted reference from its own clean worker.
-        port_r = _free_port()
-        proc_r = _spawn_worker(port_r, state_dir=str(tmp_path / "ref"))
-        procs.append(proc_r)
+        # Uninterrupted reference from the shared clean worker.
+        _, port_r = worker_daemon_pair[0]
         with _client(("127.0.0.1", port_r)) as c:
-            c.seed_kmeans("km", seed_batch, k=3, params=params)
-            _drive_kmeans_passes(c, "km", parts, params, range(3))
-            base, _ = c.finalize("km", {}, drop=False)
-            c.drop("km")
-        _stop_worker(proc_r)
+            c.seed_kmeans("km-ref", seed_batch, k=3, params=params)
+            _drive_kmeans_passes(c, "km-ref", parts, params, range(3))
+            base, _ = c.finalize("km-ref", {}, drop=False)
+            c.drop("km-ref")
 
         # Crash run: pass 0, SIGKILL, restart, passes 1-2.
         port = _free_port()
         state = str(tmp_path / "state")
-        proc1 = _spawn_worker(port, state_dir=state)
+        proc1, _ = spawn_daemon_worker(port, state_dir=state)
         procs.append(proc1)
         with _client(("127.0.0.1", port)) as c:
             c.seed_kmeans("km", seed_batch, k=3, params=params)
             _drive_kmeans_passes(c, "km", parts, params, [0])
             proc1.kill()  # SIGKILL: no shutdown hooks, no flush
             proc1.wait(timeout=30)
-            proc2 = _spawn_worker(port, state_dir=state)
+            proc2, _ = spawn_daemon_worker(port, state_dir=state)
             procs.append(proc2)
             # The healed client resumes pass 1 against the RESURRECTED
             # job — the daemon restores it lazily at first mention.
@@ -489,7 +474,7 @@ def test_flagship_sigkill_between_kmeans_passes_bitwise(tmp_path, rng):
         assert int(healed["n_iter"][0]) == int(base["n_iter"][0])
     finally:
         for p in procs:
-            _stop_worker(p)
+            stop_daemon_worker(p)
 
 
 def _drive_logreg_passes(c, job, xs, ys, step_params, passes):
@@ -504,10 +489,13 @@ def _drive_logreg_passes(c, job, xs, ys, step_params, passes):
 
 
 @pytest.mark.slow
-def test_flagship_sigkill_between_logreg_passes_bitwise(tmp_path, rng):
+def test_flagship_sigkill_between_logreg_passes_bitwise(
+    tmp_path, rng, worker_daemon_pair
+):
     """The logreg half of the flagship: Newton state (w, b) survives the
     SIGKILL via the pass-boundary snapshot; the final coefficients are
-    bitwise-equal to the uninterrupted fit."""
+    bitwise-equal to the uninterrupted fit (reference on the module's
+    shared worker — never killed, unique job name)."""
     n, d = 180, 6
     x = rng.normal(size=(n, d))
     w = rng.normal(size=d)
@@ -517,24 +505,21 @@ def test_flagship_sigkill_between_logreg_passes_bitwise(tmp_path, rng):
     step_params = {"reg": 1e-2, "fit_intercept": True}
     procs = []
     try:
-        port_r = _free_port()
-        proc_r = _spawn_worker(port_r, state_dir=str(tmp_path / "ref"))
-        procs.append(proc_r)
+        _, port_r = worker_daemon_pair[1]
         with _client(("127.0.0.1", port_r)) as c:
-            _drive_logreg_passes(c, "lr", xs, ys, step_params, range(3))
-            base, _ = c.finalize("lr", {}, drop=False)
-            c.drop("lr")
-        _stop_worker(proc_r)
+            _drive_logreg_passes(c, "lr-ref", xs, ys, step_params, range(3))
+            base, _ = c.finalize("lr-ref", {}, drop=False)
+            c.drop("lr-ref")
 
         port = _free_port()
         state = str(tmp_path / "state")
-        proc1 = _spawn_worker(port, state_dir=state)
+        proc1, _ = spawn_daemon_worker(port, state_dir=state)
         procs.append(proc1)
         with _client(("127.0.0.1", port)) as c:
             _drive_logreg_passes(c, "lr", xs, ys, step_params, [0])
             proc1.kill()
             proc1.wait(timeout=30)
-            proc2 = _spawn_worker(port, state_dir=state)
+            proc2, _ = spawn_daemon_worker(port, state_dir=state)
             procs.append(proc2)
             _drive_logreg_passes(c, "lr", xs, ys, step_params, [1, 2])
             healed, _ = c.finalize("lr", {}, drop=False)
@@ -547,7 +532,7 @@ def test_flagship_sigkill_between_logreg_passes_bitwise(tmp_path, rng):
         assert int(healed["n_iter"][0]) == int(base["n_iter"][0])
     finally:
         for p in procs:
-            _stop_worker(p)
+            stop_daemon_worker(p)
 
 
 @pytest.mark.slow
@@ -564,17 +549,178 @@ def test_flagship_sigkill_without_state_dir_fails_loudly(tmp_path, rng):
     procs = []
     try:
         port = _free_port()
-        proc1 = _spawn_worker(port)  # NO state_dir
+        proc1, _ = spawn_daemon_worker(port)  # NO state_dir
         procs.append(proc1)
         with _client(("127.0.0.1", port)) as c:
             _drive_logreg_passes(c, "lr", xs, ys, {"reg": 0.0}, [0])
             proc1.kill()
             proc1.wait(timeout=30)
-            proc2 = _spawn_worker(port)
+            proc2, _ = spawn_daemon_worker(port)
             procs.append(proc2)
             with pytest.raises(RuntimeError, match="behind the fit"):
                 c.feed("lr", (xs[0], ys[0]), algo="logreg", partition=0,
                        pass_id=1)
     finally:
         for p in procs:
-            _stop_worker(p)
+            stop_daemon_worker(p)
+
+
+# ---------------- durable daemon-built KNN/ANN index snapshots ---------------
+#
+# VERDICT Missing #2 follow-through: a daemon-built index was the ONE
+# registration a restart could not bring back ("not re-creatable" — held
+# 8x the TTL in memory as a workaround). With a state_dir the finalize
+# now write-ahead-snapshots the built shard (core/checkpoint.py atomic
+# tmp+rename) and a restarted daemon resurrects it at first mention,
+# exactly like iterative jobs — so the special case retires: durable
+# registrations reap at the PLAIN TTL and come back from disk on the
+# next query.
+
+
+def test_knn_index_snapshot_restores_bitwise_after_kill(tmp_path, mesh8, rng):
+    """Kill-and-restart: an exact-KNN shard built on daemon #1 (with a
+    row_id_base — the sharded-serve id map must survive too) answers
+    kneighbors on daemon #2 over the same state_dir BITWISE-identically
+    to the pre-kill answers; drop_model deletes the snapshot."""
+    state = str(tmp_path / "state")
+    x = rng.normal(size=(200, 8)).astype(np.float64)
+    q = x[:16] + 0.01 * rng.normal(size=(16, 8))
+    parts = [np.ascontiguousarray(p) for p in np.array_split(x, 2)]
+    d1 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    with _client(d1) as c:
+        for pid, p in enumerate(parts):
+            c.feed("kj", p, algo="knn", partition=pid)
+            c.commit("kj", partition=pid)
+        c.finalize_knn("kj", register_as="kidx", mode="exact",
+                       row_id_base={0: 1000, 1: 5000})
+        base_d, base_i = c.kneighbors("kidx", q, k=5)
+    assert (base_i >= 1000).all()  # the id map is live pre-kill
+    assert [f for f in os.listdir(state) if f.startswith("model-")]
+    d1.stop()  # in-memory registry dies with the daemon
+
+    d2 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    try:
+        with _client(d2) as c:
+            got_d, got_i = c.kneighbors("kidx", q, k=5)  # lazy restore
+            np.testing.assert_array_equal(got_i, base_i)
+            np.testing.assert_array_equal(got_d, base_d)
+            assert c.drop_model("kidx")
+        assert not [f for f in os.listdir(state) if f.startswith("model-")], (
+            "drop_model left a resurrectable snapshot behind"
+        )
+    finally:
+        d2.stop()
+
+
+def test_ivf_index_snapshot_restores_bitwise_after_kill(tmp_path, mesh8, rng):
+    """The ANN variant: centroids, padded lists, the baked-in fit
+    metric AND the serving params (nprobe) all ride the snapshot — the
+    restored shard's approximate answers are bitwise-identical."""
+    state = str(tmp_path / "state")
+    kc, d_cols = 4, 6
+    centers = rng.normal(size=(kc, d_cols)) * 10
+    x = np.concatenate(
+        [c_ + rng.normal(size=(60, d_cols)) for c_ in centers]
+    ).astype(np.float32)
+    q = x[:24]
+    d1 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    with _client(d1) as c:
+        c.feed("aj", x, algo="knn", partition=0)
+        c.commit("aj", partition=0)
+        c.finalize_knn("aj", register_as="aidx", mode="ivf",
+                       nlist=kc, nprobe=2, seed=3)
+        base_d, base_i = c.kneighbors("aidx", q, k=5)
+    d1.stop()
+
+    d2 = DataPlaneDaemon(mesh=mesh8, state_dir=state).start()
+    try:
+        with _client(d2) as c:
+            got_d, got_i = c.kneighbors("aidx", q, k=5)
+            np.testing.assert_array_equal(got_i, base_i)
+            np.testing.assert_array_equal(got_d, base_d)
+            c.drop_model("aidx")
+    finally:
+        d2.stop()
+
+
+def test_durable_index_reaps_at_plain_ttl_volatile_keeps_8x(
+    tmp_path, mesh8, rng
+):
+    """The retired special case, pinned: a durable daemon's built index
+    is re-creatable (from disk) and holds the PLAIN ttl_scale; a
+    volatile daemon keeps the 8x hold — eviction there is forever."""
+    x = rng.normal(size=(60, 4)).astype(np.float64)
+    with DataPlaneDaemon(mesh=mesh8,
+                         state_dir=str(tmp_path / "s")) as durable:
+        with _client(durable) as c:
+            c.feed("dj", x, algo="knn", partition=0)
+            c.commit("dj", partition=0)
+            c.finalize_knn("dj", register_as="didx", mode="exact")
+        assert durable._models["didx"].ttl_scale == 1.0
+    with DataPlaneDaemon(mesh=mesh8) as volatile:
+        with _client(volatile) as c:
+            c.feed("vj", x, algo="knn", partition=0)
+            c.commit("vj", partition=0)
+            c.finalize_knn("vj", register_as="vidx", mode="exact")
+        assert volatile._models["vidx"].ttl_scale == 8.0
+
+
+def test_evicted_durable_index_resurrects_on_query(tmp_path, mesh8, rng):
+    """TTL/LRU eviction of a durable index frees the memory but not the
+    answer: the next kneighbors restores it from the snapshot, bitwise."""
+    state = str(tmp_path / "state")
+    x = rng.normal(size=(80, 5)).astype(np.float64)
+    q = x[:8] + 0.01 * rng.normal(size=(8, 5))
+    with DataPlaneDaemon(mesh=mesh8, state_dir=state) as d:
+        with _client(d) as c:
+            c.feed("ej", x, algo="knn", partition=0)
+            c.commit("ej", partition=0)
+            c.finalize_knn("ej", register_as="eidx", mode="exact")
+            base_d, base_i = c.kneighbors("eidx", q, k=4)
+            # Simulate the reaper's eviction (memory reclaimed, disk
+            # retention clock restarted).
+            with d._models_lock:
+                del d._models["eidx"]
+            d._touch_model_state("eidx")
+            got_d, got_i = c.kneighbors("eidx", q, k=4)
+            np.testing.assert_array_equal(got_i, base_i)
+            np.testing.assert_array_equal(got_d, base_d)
+            assert "eidx" in d._models  # restored registration is live
+            c.drop_model("eidx")
+
+
+def test_live_index_snapshot_mtime_refreshed_by_reaper(tmp_path, mesh8, rng):
+    """A LIVE durable index must never lose its snapshot to the 8×-TTL
+    sweep: the reaper refreshes live registrations' snapshot mtimes each
+    tick, so the retention clock counts from eviction (or death), never
+    from the build — a SIGKILL after a long serving life stays
+    restorable."""
+    state = str(tmp_path / "state")
+    x = rng.normal(size=(40, 4)).astype(np.float64)
+    d = DataPlaneDaemon(
+        mesh=mesh8, state_dir=state, ttl=0.5, reap_interval=0.05
+    ).start()
+    try:
+        with _client(d) as c:
+            c.feed("lj", x, algo="knn", partition=0)
+            c.commit("lj", partition=0)
+            c.finalize_knn("lj", register_as="lidx", mode="exact")
+            path = d._model_state_path("lidx")
+            # Backdate the snapshot FAR past the retention window while
+            # the model stays live (queries keep touching it).
+            os.utime(path, (1.0, 1.0))
+            import time as _time
+            deadline = _time.monotonic() + 5.0
+            while (_time.monotonic() < deadline
+                   and os.path.getmtime(path) < 1000.0):
+                c.kneighbors("lidx", x[:4], k=2)  # keep it live
+                _time.sleep(0.05)
+            assert os.path.exists(path), (
+                "the sweep reclaimed a LIVE index's snapshot"
+            )
+            assert os.path.getmtime(path) > 1000.0, (
+                "the reaper never refreshed the live snapshot's mtime"
+            )
+            c.drop_model("lidx")
+    finally:
+        d.stop()

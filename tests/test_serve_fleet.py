@@ -461,52 +461,28 @@ def test_router_repairs_restarted_replica(trio, pca_v1_v2):
 # ---------------------------------------------------------------------------
 
 
-def _spawn_daemon_workers(n: int):
-    """n replica daemons as real OS processes (tests/daemon_worker.py
-    contract: READY <port> on stdout, stdin-close shutdown). Spawned
-    together so the ~4 s jax imports overlap."""
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {
-        k: v for k, v in os.environ.items() if not k.startswith("SRML_")
-    }
-    env["JAX_PLATFORMS"] = "cpu"
-    # The parity contract is BITWISE vs the parent session's oracles, so
-    # the workers must run the same f64 profile conftest.py pins.
-    env["JAX_ENABLE_X64"] = "True"
-    env["SRML_TPU_ACCUM_DTYPE"] = "float64"
-    env["SRML_TPU_COMPUTE_DTYPE"] = "float64"
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p
-    )
-    procs = [
-        subprocess.Popen(
-            [sys.executable,
-             os.path.join(os.path.dirname(__file__), "daemon_worker.py")],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
-            cwd=repo_root, env=env,
-        )
-        for _ in range(n)
-    ]
-    eps = []
-    for proc in procs:
-        line = proc.stdout.readline()
-        assert line.startswith("READY"), f"daemon worker said {line!r}"
-        eps.append(("127.0.0.1", int(line.split()[1])))
-    return procs, eps
-
-
 @pytest.mark.fleet
 @pytest.mark.chaos
 @pytest.mark.slow
-def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2):
+def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2,
+                                                 worker_daemon_pair):
     """The acceptance flagship: 3 subprocess replicas, a rolling v1→v2
     swap concurrent with a SIGKILL of one replica, seeded client-side
     fault injection on top — and still: zero lost requests, p99 under
     the request deadline, every response bitwise-correct FOR ITS
-    VERSION."""
+    VERSION. The two SURVIVING replicas are the module's shared worker
+    pair (conftest.py — VERDICT carry #7: one spawn pays for the whole
+    module); only the SIGKILL victim is spawned here. Model names are
+    dropped from the survivors on the way out, whatever happened."""
+    from conftest import spawn_daemon_worker
+
     DEADLINE_S = 30.0  # generous: subprocess CPU daemons jit-compile lazily
     q, ref1, ref2 = pca_v1_v2["q"], pca_v1_v2["ref1"], pca_v1_v2["ref2"]
-    procs, eps = _spawn_daemon_workers(3)
+    victim, victim_port = spawn_daemon_worker()
+    procs = [victim]
+    eps = [("127.0.0.1", victim_port)] + [
+        ("127.0.0.1", port) for _, port in worker_daemon_pair
+    ]
     try:
         with ModelFleet(eps) as fleet:
             fleet.register("m", "pca", pca_v1_v2["v1"])
@@ -517,12 +493,22 @@ def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2):
             lock = threading.Lock()
             barrier = threading.Barrier(n_workers + 1)
 
+            # Set once fleet.rollout() has returned (the atomic flip is
+            # behind it): workers keep traffic flowing UNTIL then — the
+            # swap-under-fire overlap is guaranteed, not a race between
+            # a fixed request count and the rollout's wall clock — and
+            # then issue a couple of guaranteed post-flip requests.
+            flipped = threading.Event()
+
             def worker(i: int) -> None:
                 try:
                     with fleet.client() as fc:
                         fc.transform("m", q)  # warm sockets pre-barrier
                         barrier.wait()
-                        for n in range(n_reqs):
+                        n = 0
+                        while (
+                            n < n_reqs or not flipped.is_set()
+                        ) and n < n_reqs * 40:
                             t0 = time.perf_counter()
                             out = fc.transform(
                                 "m", q, route_key=f"w{i}-{n}",
@@ -531,6 +517,14 @@ def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2):
                             dt = time.perf_counter() - t0
                             with lock:
                                 latencies.append(dt)
+                                outputs.append(np.asarray(out["output"]))
+                            n += 1
+                        for extra in range(2):  # post-flip: must be v2
+                            out = fc.transform(
+                                "m", q, route_key=f"w{i}-post{extra}",
+                                deadline_s=DEADLINE_S,
+                            )
+                            with lock:
                                 outputs.append(np.asarray(out["output"]))
                 except Exception as e:  # pragma: no cover - failure path
                     with lock:
@@ -554,12 +548,13 @@ def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2):
                 killed = procs[0]
                 killed.kill()  # SIGKILL: a replica dies mid-swap
                 fleet.rollout("m", "pca", pca_v1_v2["v2"])
+                flipped.set()  # workers may now finish (post-flip reqs)
                 for t in threads:
                     t.join()
             killed.wait(timeout=10)
 
         assert errors == [], f"lost {len(errors)} request(s): {errors[:3]}"
-        assert len(outputs) == n_workers * n_reqs  # zero lost requests
+        assert len(outputs) >= n_workers * n_reqs  # zero lost requests
         latencies.sort()
         p99 = latencies[min(int(len(latencies) * 0.99), len(latencies) - 1)]
         assert p99 < DEADLINE_S, f"p99 {p99:.3f}s breached the deadline"
@@ -584,6 +579,17 @@ def test_chaos_rolling_swap_with_replica_sigkill(pca_v1_v2):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:  # pragma: no cover
                 p.kill()
+        # The shared pair outlives this test: release the versioned
+        # registrations so later users of the pair see a clean slate
+        # (drop_model is idempotent — a drained v1 is already gone).
+        for _, port in worker_daemon_pair:
+            try:
+                with DataPlaneClient("127.0.0.1", port, timeout=5.0,
+                                     max_op_attempts=2) as dc:
+                    dc.drop_model("m@v1")
+                    dc.drop_model("m@v2")
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------
