@@ -44,6 +44,15 @@ relative to a steady pass, and a bitwise check against an uninterrupted
 fit on the surviving topology; tools/perfcheck.py gates
 recovery-cost regressions against the CHAOS_r* trajectory.
 
+``python bench.py --forest`` (or SRML_BENCH_FOREST=1) runs the
+TREE-ENSEMBLE benchmark: a RandomForest classifier fit (quantile
+binning + fused per-depth histogram accumulate + vectorized split
+scoring — the first non-GEMM workload record) plus warm-jit transform
+QPS, differential against a sklearn-CPU RandomForest baseline when
+installed (fit/transform speedups + an absolute accuracy gate);
+tools/perfcheck.py check_forest gates it against the FOREST_r*
+trajectory (SKIP-not-pass without history).
+
 ``python bench.py --serve --fleet`` (or SRML_BENCH_FLEET=1) runs the
 FLEET benchmark: N replica daemons (each its own OS process — its own
 Python runtime and device dispatch, the deployment shape) × M client
@@ -797,6 +806,136 @@ def chaos_elastic_bench() -> None:
     print(json.dumps(record))
 
 
+def forest_bench() -> None:
+    """``--forest``: histogram tree-ensemble throughput (the first
+    non-GEMM workload record — FOREST_r*).
+
+    Fits a RandomForest classifier (models/random_forest.py: quantile
+    binning + fused per-depth histogram accumulate + vectorized split
+    scoring, all level-synchronous on device) on a clustered synthetic
+    classification set and measures
+
+      * ``value``: fit SCAN throughput, rows/s — rows x depth-passes
+        over the fit wall clock (each pass re-scans the dataset, the
+        honest analogue of the streaming-fit rows/s headline);
+      * ``transform_rows_per_s``: bucketed ``predict_matrix`` QPS over
+        repeated batches (warm jit — serving-path throughput);
+      * a held-out ``accuracy`` self-check, differential against a
+        sklearn-CPU RandomForest baseline when sklearn is installed
+        (``baseline.impl: "sklearn"``; ``accuracy_ok`` = ours within
+        0.05 of the baseline — an ABSOLUTE correctness gate for
+        tools/perfcheck.py check_forest, not history-relative).
+
+    One JSON line; ``tools/perfcheck.py`` gates fit/transform
+    throughput against the FOREST_r* trajectory (SKIP-not-pass without
+    history) and the accuracy gate absolutely."""
+    import jax
+
+    from spark_rapids_ml_tpu.models.random_forest import (
+        RandomForestClassificationModel,
+        fit_random_forest_classifier,
+    )
+
+    n = int(os.environ.get("SRML_BENCH_FOREST_ROWS", 200_000))
+    d = int(os.environ.get("SRML_BENCH_FOREST_COLS", 32))
+    trees = int(os.environ.get("SRML_BENCH_FOREST_TREES", 8))
+    depth = int(os.environ.get("SRML_BENCH_FOREST_DEPTH", 6))
+    bins = int(os.environ.get("SRML_BENCH_FOREST_BINS", 32))
+    classes = int(os.environ.get("SRML_BENCH_FOREST_CLASSES", 4))
+    n_test = max(n // 10, 1024)
+    rng = np.random.default_rng(11)
+    centers = rng.normal(size=(classes, d)) * 6.0
+    y_all = rng.integers(0, classes, size=n + n_test)
+    x_all = (
+        centers[y_all] + rng.normal(size=(n + n_test, d))
+    ).astype(np.float32)
+    x, y = x_all[:n], y_all[:n]
+    x_test, y_test = x_all[n:], y_all[n:]
+
+    def fit_ours():
+        t0 = time.perf_counter()
+        sol = fit_random_forest_classifier(
+            x, y, n_classes=classes, num_trees=trees, max_depth=depth,
+            max_bins=bins, seed=5,
+        )
+        return sol, time.perf_counter() - t0
+
+    # Warmup fit compiles the per-depth programs; the timed fit
+    # measures steady dispatch (the compile-storm split every BENCH
+    # record keeps).
+    fit_ours()
+    sol, fit_s = fit_ours()
+    model = RandomForestClassificationModel(arrays=sol.arrays)
+    acc = float(np.mean(model.predict(x_test) == y_test))
+
+    batch = x_test[:4096] if n_test >= 4096 else x_test
+    model.predict(batch)  # warm the predict ladder
+    reps = max(int(2_000_000 // max(batch.shape[0], 1)), 5)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        model.predict(batch)
+    transform_s = time.perf_counter() - t0
+    transform_rps = reps * batch.shape[0] / transform_s
+
+    baseline: dict = {"impl": None}
+    speedup_fit = speedup_transform = None
+    accuracy_ok = True
+    try:
+        from sklearn.ensemble import RandomForestClassifier as SkRF
+
+        t0 = time.perf_counter()
+        sk = SkRF(
+            n_estimators=trees, max_depth=depth, random_state=5, n_jobs=-1
+        ).fit(x, y)
+        sk_fit_s = time.perf_counter() - t0
+        sk.predict(batch)
+        t0 = time.perf_counter()
+        for _ in range(max(reps // 4, 2)):
+            sk.predict(batch)
+        sk_tr_s = time.perf_counter() - t0
+        sk_rps = max(reps // 4, 2) * batch.shape[0] / sk_tr_s
+        sk_acc = float(sk.score(x_test, y_test))
+        baseline = {
+            "impl": "sklearn",
+            "fit_s": round(sk_fit_s, 4),
+            "transform_rows_per_s": round(sk_rps, 1),
+            "accuracy": round(sk_acc, 4),
+        }
+        speedup_fit = round(sk_fit_s / fit_s, 3)
+        speedup_transform = round(transform_rps / sk_rps, 3)
+        accuracy_ok = acc >= sk_acc - 0.05
+    except ImportError:
+        # No sklearn on this image: the accuracy gate falls back to an
+        # absolute floor on the easy synthetic shape.
+        accuracy_ok = acc >= 0.9
+
+    record = {
+        "metric": (
+            f"forest_fit_rows_per_s_n{n}_d{d}_t{trees}"
+            f"_depth{depth}_b{bins}"
+        ),
+        "unit": "rows/s",
+        "mode": "forest",
+        "value": round(n * sol.n_passes / fit_s, 1),
+        "rows": n,
+        "n_cols": d,
+        "trees": trees,
+        "max_depth": depth,
+        "max_bins": bins,
+        "n_classes": classes,
+        "passes": sol.n_passes,
+        "fit_s": round(fit_s, 4),
+        "transform_rows_per_s": round(transform_rps, 1),
+        "accuracy": round(acc, 4),
+        "accuracy_ok": bool(accuracy_ok),
+        "baseline": baseline,
+        "speedup_fit": speedup_fit,
+        "speedup_transform": speedup_transform,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(record))
+
+
 def _fleet_daemon_worker() -> None:
     """``--fleet-daemon`` subcommand: one replica daemon as its own OS
     process (the deployment unit). Prints ``READY <port>``; serves until
@@ -1267,5 +1406,9 @@ if __name__ == "__main__":
         "SRML_BENCH_MULTICHIP", ""
     ) in ("1", "true"):
         multichip_bench()
+    elif "--forest" in sys.argv or os.environ.get(
+        "SRML_BENCH_FOREST", ""
+    ) in ("1", "true"):
+        forest_bench()
     else:
         main()

@@ -53,6 +53,12 @@ from spark_rapids_ml_tpu.models.knn import (
     ApproximateNearestNeighbors,
     ApproximateNearestNeighborsModel,
 )
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestClassifier,
+    RandomForestClassificationModel,
+    RandomForestRegressor,
+    RandomForestRegressionModel,
+)
 from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
 from spark_rapids_ml_tpu.pipeline import Pipeline, PipelineModel
 from spark_rapids_ml_tpu.tuning import (
@@ -81,6 +87,10 @@ __all__ = [
     "NearestNeighborsModel",
     "ApproximateNearestNeighbors",
     "ApproximateNearestNeighborsModel",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
     "StandardScaler",
     "StandardScalerModel",
     "Pipeline",

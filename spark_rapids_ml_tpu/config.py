@@ -313,6 +313,23 @@ _DEFAULTS: Dict[str, Any] = {
     "fit_daemon_death_timeout_s": _env(
         "FIT_DAEMON_DEATH_TIMEOUT_S", 15.0, float
     ),
+    # Histogram tree ensembles (models/random_forest.py; docs/protocol.md
+    # "The `rf` job algo"). Env keys are deployment-facing (SRML_FOREST_*),
+    # like SRML_SERVE_*.
+    # Row cap on the driver-side prefix sample that trains the quantile
+    # bin-edge sketch (the kmeans init_sample_rows twin): the edges are
+    # part of the model iterate, so every daemon bins identically.
+    "forest_seed_sample_rows": _env_named(
+        "SRML_FOREST_SEED_SAMPLE_ROWS", 65536, int
+    ),
+    # Per-device budget (MiB) for one frontier's (tree, node, feature,
+    # bin, stat) histogram tensor — over it, the fit refuses at the pass
+    # boundary that would allocate it (ForestCapacityError; the forest
+    # twin of SRML_GRAM_DEVICE_BUDGET_MB), never a mid-pass OOM. 0 =
+    # unbounded.
+    "forest_hist_budget_mb": _env_named(
+        "SRML_FOREST_HIST_BUDGET_MB", 256, int
+    ),
     # Fused Pallas scan+selection kernel for the bucketed IVF query
     # (ops/pallas_kernels.py ivf_scan_select_pallas): the per-list residual
     # GEMM and an EXACT per-slot top-k run in one kernel, scores

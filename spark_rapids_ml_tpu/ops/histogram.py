@@ -1,0 +1,331 @@
+"""Binned-feature histograms for tree ensembles — the first non-GEMM op
+family in the package (ROADMAP item 4a).
+
+Three pieces, all shaped for the accelerator rather than ported from a
+CPU tree library:
+
+* **Quantile-sketch binning** (:func:`quantile_bin_edges` host-side,
+  :func:`bin_matrix` on device): features quantize to uint8 bin ids
+  against per-feature edge vectors, so the per-node split search becomes
+  a dense histogram problem with a STATIC bin axis — the LightGBM/XGBoost
+  "hist" idea, which is also exactly what a fixed-shape compiler wants
+  (PAPERS.md 1703.08219: keep the whole pipeline inside one compiled
+  program; a sort-based exact split search is shape-dynamic and hostile
+  to XLA).
+
+* **Fused per-node histogram builder** (:func:`hist_update_fn`): one
+  jitted, donated dispatch per batch does bin → descend-to-frontier →
+  scatter into the ``(tree, node, feature, bin, stat)`` tensor. The
+  scatter is formulated as a one-hot × stats contraction (an einsum over
+  the row axis) instead of a gather/scatter loop — MXU-shaped, and the
+  per-shard partials reduce with ``parallel.mapreduce.reduce_sum``
+  (DrJAX psum; PAPERS.md 2403.07128) like every other sufficient
+  statistic in the package. Histograms are ADDITIVE, so the tensor rides
+  the daemon's cross-daemon merge/reduce_mesh plane completely unchanged.
+
+* **Vectorized best-split scoring** (:func:`best_splits_fn`): cumulative
+  sums along the bin axis give every (feature, threshold) candidate's
+  left/right statistics at once; Gini (classification) and variance
+  (regression) gains reduce to the shared ``Σg²/n`` form, scored and
+  arg-maxed for ALL frontier nodes of ALL trees in one device program.
+
+Stat layout (the ``S`` axis): classification keeps per-class counts
+(``S = n_classes``; the count is their sum), regression keeps
+``(count, Σy, Σy²)`` (``S = 3``). Both are plain sums of per-row terms,
+so bootstrap resampling is a per-(tree, row) WEIGHT on those terms —
+Poisson(1) weights derived from a counter-based hash of the row's
+(partition, offset) identity, deterministic under task retries and
+independent of batch boundaries (models/random_forest.py owns the tree
+tables; docs/protocol.md "The `rf` job algo" has the wire contract).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.parallel import mapreduce as mr
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
+from jax.sharding import PartitionSpec as P
+
+#: Node-table sentinels (models/random_forest.py dense (tree, node)
+#: layout): an OPEN node is on the current frontier awaiting its split;
+#: a LEAF is closed (or was never created). Internal nodes store the
+#: split feature id (>= 0).
+OPEN = -2
+LEAF = -1
+
+#: Poisson(1) CDF at 0..5 — the lookup a uniform hash inverts to a
+#: bootstrap weight (w = #thresholds below u, capped at 6). The tail
+#: past 6 carries < 1e-4 of the mass.
+_POISSON1_CDF = (
+    0.36787944117144233,
+    0.7357588823428847,
+    0.9196986029286058,
+    0.9810118431238462,
+    0.9963401531726563,
+    0.9994058151824183,
+)
+
+
+def quantile_bin_edges(sample: np.ndarray, max_bins: int) -> np.ndarray:
+    """Per-feature quantile bin edges from a host-side sample.
+
+    Returns ``(d, max_bins - 1)`` float64 interior edges; bin id =
+    ``sum(x > edges)`` ∈ [0, max_bins). Duplicate edges (skewed or
+    constant features) simply leave some bins empty — the split scorer
+    sees zero-count candidates and never picks them. Deterministic: the
+    edges ARE part of the model iterate, so every daemon bins
+    identically once seeded (the kmeans-seed pattern)."""
+    sample = np.asarray(sample, dtype=np.float64)
+    if sample.ndim != 2 or sample.shape[0] == 0:
+        raise ValueError(f"edge sample must be (n, d) with n > 0, got {sample.shape}")
+    if not 2 <= int(max_bins) <= 256:
+        raise ValueError(
+            f"max_bins = {max_bins} out of range [2, 256] (bin ids are uint8)"
+        )
+    qs = np.linspace(0.0, 1.0, int(max_bins) + 1)[1:-1]
+    edges = np.quantile(sample, qs, axis=0).T  # (d, B-1)
+    return np.ascontiguousarray(edges, dtype=np.float64)
+
+
+def bin_matrix(x, edges):
+    """Device binning: ``(n, d)`` values against ``(d, B-1)`` edges →
+    ``(n, d)`` int32 bin ids (``sum(x > edge)``; uint8-range by the
+    max_bins cap). One broadcast compare + reduce — no sort, no loop."""
+    return jnp.sum(
+        x[:, :, None] > edges[None, :, :], axis=-1, dtype=jnp.int32
+    )
+
+
+def _hash_u32(h):
+    """splitmix-style avalanche on uint32 lanes (counter-based RNG: the
+    weight of a row must be a pure function of its identity, never of
+    batch boundaries or arrival order)."""
+    h = jnp.asarray(h, jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    return h ^ (h >> 16)
+
+
+def bootstrap_weights(row_key, n_trees: int, seed: int):
+    """Poisson(1) bootstrap weights, ``(T, n)`` float32, from per-row
+    uint32 identity keys: tree t's bag is an i.i.d.-looking but fully
+    deterministic function of (seed, t, row identity) — identical under
+    task retries, batch re-chunking, and daemon re-routing."""
+    keys = jnp.asarray(row_key, jnp.uint32)[None, :]
+    tweak = (
+        jnp.arange(n_trees, dtype=jnp.uint32)[:, None]
+        * jnp.uint32(0x9E3779B1)
+        + jnp.uint32(np.uint32(seed & 0xFFFFFFFF))
+    )
+    u = _hash_u32(keys ^ _hash_u32(tweak)).astype(jnp.float32) * jnp.float32(
+        1.0 / 4294967296.0
+    )
+    cdf = jnp.asarray(_POISSON1_CDF, jnp.float32)
+    return jnp.sum(
+        u[:, :, None] > cdf[None, None, :], axis=-1, dtype=jnp.int32
+    ).astype(jnp.float32)
+
+
+def descend_to_frontier(bins, feature, threshold, depth: int):
+    """Route every row to its heap node index at ``depth`` in every tree.
+
+    ``bins``: (n, d) int32; ``feature``/``threshold``: (T, N) int32 node
+    tables (heap layout: children of i are 2i+1 / 2i+2; OPEN/LEAF < 0).
+    Returns ``(idx (T, n) int32, alive (T, n) bool)`` — ``alive`` is
+    False for rows that hit a leaf above ``depth`` (they are settled and
+    contribute to no frontier histogram). A static Python loop of
+    ``depth`` steps: the trees grow level-synchronously, so one compiled
+    program per depth is the whole compile budget."""
+    T = feature.shape[0]
+    n = bins.shape[0]
+    d = bins.shape[1]
+    idx = jnp.zeros((T, n), jnp.int32)
+    alive = jnp.ones((T, n), jnp.bool_)
+    rows = jnp.arange(n, dtype=jnp.int32)[None, :]
+    for _ in range(depth):
+        f = jnp.take_along_axis(feature, idx, axis=1)
+        internal = f >= 0
+        bin_at = bins[rows, jnp.clip(f, 0, d - 1)]
+        thr = jnp.take_along_axis(threshold, idx, axis=1)
+        go_right = (bin_at > thr).astype(jnp.int32)
+        idx = jnp.where(internal, 2 * idx + 1 + go_right, idx)
+        alive = alive & internal
+    return idx, alive
+
+
+@functools.lru_cache(maxsize=64)
+def hist_update_fn(
+    mesh, n_trees: int, max_bins: int, depth: int,
+    n_classes: int, bootstrap: bool, seed: int, ad: str,
+):
+    """Build the fused per-depth histogram accumulate for one mesh:
+    ``(hist, edges, feature, threshold, x, y, mask, row_key) -> hist``
+    with ``hist`` donated. One device dispatch does bin → descend →
+    weight → one-hot contraction → cross-shard ``reduce_sum``; the
+    returned (T, W, d, B, S) tensor is replicated (it is the pass's
+    sufficient statistic, exactly like a Gram block).
+
+    ``n_classes = 0`` selects the regression stat layout (count, Σy,
+    Σy²); otherwise per-class counts. ``ad`` is the accumulation dtype
+    (config ``accum_dtype``) — all one-hot factors are exact small
+    integers in it, so fold order cannot perturb classification
+    histograms and integer-labeled regression is bitwise-reproducible."""
+    accum = jnp.dtype(ad)
+    W = 1 << depth
+
+    def shard(hist, edges, feature, threshold, x, y, mask, row_key):
+        n = x.shape[0]
+        bins = bin_matrix(x.astype(edges.dtype), edges)
+        idx, alive = descend_to_frontier(bins, feature, threshold, depth)
+        node_f = jnp.take_along_axis(feature, idx, axis=1)
+        # Contributing rows: unpadded, not settled at a shallower leaf,
+        # and standing on a node that is actually OPEN this pass.
+        w = (
+            alive & (node_f == OPEN) & (mask > 0)[None, :]
+        ).astype(accum)
+        if bootstrap:
+            w = w * bootstrap_weights(row_key, n_trees, seed).astype(accum)
+        pos = jnp.clip(idx - (W - 1), 0, W - 1)
+        node_oh = (
+            jax.nn.one_hot(pos, W, dtype=accum) * w[:, :, None]
+        )  # (T, n, W)
+        bin_oh = jax.nn.one_hot(bins, max_bins, dtype=accum)  # (n, d, B)
+        if n_classes > 0:
+            stat = jax.nn.one_hot(
+                jnp.clip(y.astype(jnp.int32), 0, n_classes - 1),
+                n_classes, dtype=accum,
+            )  # (n, C)
+        else:
+            ya = y.astype(accum)
+            stat = jnp.stack(
+                [jnp.ones((n,), accum), ya, ya * ya], axis=1
+            )  # (n, 3)
+        # (n, d, B, S) per-row terms, then T batched GEMM-shaped
+        # contractions over the row axis — the "scatter" as a matmul.
+        sb = bin_oh[:, :, :, None] * stat[:, None, None, :]
+        h = jnp.einsum("tnw,ndbs->twdbs", node_oh, sb)
+        return hist + mr.reduce_sum(h, DATA_AXIS)
+
+    f = mr.map_fn(
+        shard,
+        mesh=mesh,
+        in_specs=(
+            P(), P(), P(), P(),
+            P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+        ),
+        out_specs=P(),
+    )
+    # One ledger name pools every depth's accounting (per-depth programs
+    # are distinct shape-signatures under it — the ledger's own keying).
+    return ledgered_jit("histogram.update", f, donate_argnums=(0,))
+
+
+def zero_hist(n_trees: int, depth: int, n_cols: int, max_bins: int,
+              n_stats: int, ad) -> jnp.ndarray:
+    """Zero (T, 2^depth, d, B, S) accumulator for one frontier pass."""
+    return jnp.zeros(
+        (n_trees, 1 << depth, n_cols, max_bins, n_stats), jnp.dtype(ad)
+    )
+
+
+def feature_subset_mask(n_trees: int, width: int, depth: int, n_cols: int,
+                        m: int, seed: int):
+    """Deterministic per-node feature subset (featureSubsetStrategy):
+    ``(T, W, d)`` bool with exactly ``min(m, d)`` True per (tree, node),
+    chosen by ranking counter-based hashes of (seed, tree, global node
+    id, feature) — no RNG state to thread through replays."""
+    if m >= n_cols:
+        return jnp.ones((n_trees, width, n_cols), jnp.bool_)
+    t = jnp.arange(n_trees, dtype=jnp.uint32)[:, None, None]
+    node = (
+        jnp.uint32(width - 1)
+        + jnp.arange(width, dtype=jnp.uint32)[None, :, None]
+    )
+    f = jnp.arange(n_cols, dtype=jnp.uint32)[None, None, :]
+    r = _hash_u32(
+        f
+        ^ _hash_u32(node * jnp.uint32(0x85EBCA6B))
+        ^ _hash_u32(
+            t * jnp.uint32(0xC2B2AE35)
+            + jnp.uint32(np.uint32(seed & 0xFFFFFFFF))
+        )
+    )
+    order = jnp.argsort(r, axis=-1)
+    rank = jnp.argsort(order, axis=-1)
+    return rank < m
+
+
+@functools.lru_cache(maxsize=64)
+def best_splits_fn(
+    n_trees: int, depth: int, n_classes: int, subset_m: int, seed: int,
+    min_instances: int, ad: str,
+):
+    """Vectorized split scorer for one frontier:
+    ``hist (T, W, d, B, S) -> (score, feature, bin, left, right, total)``
+    with ``score (T, W)`` the best impurity-improvement over every
+    (feature, threshold-bin) candidate in the node's feature subset,
+    ``left``/``right``/``total (T, W, S)`` the chosen split's child and
+    node statistics (what the grower writes into the value table).
+
+    The scores share one algebraic form: maximizing the Gini /
+    variance gain is maximizing ``Σg²(left)/n(left) + Σg²(right)/
+    n(right)`` (g = class counts for classification, Σy for regression)
+    — the parent term is a per-node constant, reported via ``total``.
+    Degenerate candidates (empty side, under ``min_instances``, feature
+    outside the node's subset, duplicate-edge empty bins) score -inf."""
+    accum = jnp.dtype(ad)
+
+    def scorer(hist):
+        T, W, d, B, S = hist.shape
+        cum = jnp.cumsum(hist, axis=3)
+        tot = cum[:, :, 0, B - 1, :]  # (T, W, S) — identical per feature
+        left = cum[:, :, :, : B - 1, :]  # (T, W, d, B-1, S)
+        right = tot[:, :, None, None, :] - left
+        if n_classes > 0:
+            n_l = jnp.sum(left, axis=-1)
+            n_r = jnp.sum(right, axis=-1)
+            g_l = jnp.sum(left * left, axis=-1)
+            g_r = jnp.sum(right * right, axis=-1)
+        else:
+            n_l, n_r = left[..., 0], right[..., 0]
+            g_l = left[..., 1] * left[..., 1]
+            g_r = right[..., 1] * right[..., 1]
+        n_tot = n_l + n_r
+        score = (
+            g_l / jnp.maximum(n_l, 1) + g_r / jnp.maximum(n_r, 1)
+        )
+        # Parent constant subtracted so "score > 0" IS "gain > 0".
+        if n_classes > 0:
+            g_t = jnp.sum(tot * tot, axis=-1)
+            n_t = jnp.sum(tot, axis=-1)
+        else:
+            g_t = tot[..., 1] * tot[..., 1]
+            n_t = tot[..., 0]
+        score = score - (g_t / jnp.maximum(n_t, 1))[:, :, None, None]
+        mi = jnp.asarray(float(min_instances), accum)
+        valid = (n_l >= mi) & (n_r >= mi)
+        mask = feature_subset_mask(T, W, depth, d, subset_m, seed)
+        valid = valid & mask[:, :, :, None]
+        score = jnp.where(valid, score, -jnp.inf)
+        flat = score.reshape(T, W, d * (B - 1))
+        best = jnp.argmax(flat, axis=-1)
+        best_score = jnp.take_along_axis(flat, best[:, :, None], -1)[..., 0]
+        best_f = (best // (B - 1)).astype(jnp.int32)
+        best_b = (best % (B - 1)).astype(jnp.int32)
+        pick = lambda a: jnp.take_along_axis(  # noqa: E731 - local gather
+            jnp.take_along_axis(
+                a, best_f[:, :, None, None, None], axis=2
+            ),
+            best_b[:, :, None, None, None], axis=3,
+        )[:, :, 0, 0, :]
+        return best_score, best_f, best_b, pick(left), pick(right), tot
+
+    return ledgered_jit("histogram.best_splits", scorer)

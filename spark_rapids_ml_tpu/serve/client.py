@@ -745,6 +745,9 @@ class DataPlaneClient:
             a = arrays.get("centers")
             if a is not None:
                 n_cols = int(np.asarray(a).shape[1])
+            elif arrays.get("bin_edges") is not None:
+                # Forest iterate: edges are (n_cols, max_bins - 1).
+                n_cols = int(np.asarray(arrays["bin_edges"]).shape[0])
             elif arrays.get("w") is not None:
                 n_cols = int(np.asarray(arrays["w"]).shape[0])
         if n_cols is not None:

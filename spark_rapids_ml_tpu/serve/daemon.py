@@ -535,6 +535,25 @@ class _Job:
                 self.b = jnp.zeros((), self._accum)
                 self.update = _stream_grad_hess_fn(mesh, config.get("accum_dtype"))
             self.state = self._logreg_zero_state()
+        elif algo == "rf":
+            # Histogram tree ensembles (models/random_forest.py;
+            # docs/protocol.md "The `rf` job algo"): multi-pass like
+            # kmeans/logreg — one pass per tree depth. The iterate is the
+            # (bin edges + node tables) bundle, installed by the driver's
+            # set_iterate BEFORE the first scan (the kmeans-seed pattern:
+            # a peer daemon not pre-seeded rejects its feeds loudly); the
+            # pass state is ONE additive (tree, node, feature, bin, stat)
+            # histogram tensor, so the cross-daemon merge/reduce_mesh
+            # plane carries it with zero edits.
+            from spark_rapids_ml_tpu.models import random_forest as rf_mod
+
+            self.rf_spec = rf_mod.forest_spec_from_params(params, n_cols)
+            # Depth-0 capacity gate at creation (the Gram-capacity
+            # contract): a clean first-feed error, never a mid-pass OOM.
+            rf_mod.require_hist_capacity(self.rf_spec, 0, n_cols)
+            self.rf_tables = None  # installed via set_iterate / restore
+            self.state = ()
+            self.update = None
         elif algo == "knn":
             # KNN's "sufficient statistic" IS the dataset (the model is the
             # database, SURVEY §2.3) — rows accumulate host-side per
@@ -545,7 +564,9 @@ class _Job:
             self.part_rows: Dict[int, list] = {}  # partition → row blocks
             self.update = None
         else:
-            raise ValueError(f"unknown algo {algo!r} (pca|linreg|kmeans|logreg|knn)")
+            raise ValueError(
+                f"unknown algo {algo!r} (pca|linreg|kmeans|logreg|rf|knn)"
+            )
 
     def _kmeans_zero_state(self):
         from spark_rapids_ml_tpu.models.kmeans import stream_zero_state
@@ -568,6 +589,26 @@ class _Job:
     def _zero_state(self):
         if self.algo == "knn":
             return []
+        if self.algo == "rf":
+            if self.rf_tables is None:
+                return ()  # no iterate yet — feeds are rejected anyway
+            from spark_rapids_ml_tpu.models import random_forest as rf_mod
+            from spark_rapids_ml_tpu.ops import histogram as hist_ops
+
+            depth = int(self.rf_tables["depth"][0])
+            if rf_mod.open_frontier_nodes(
+                self.rf_tables["feature"], depth
+            ) == 0:
+                # Grown out (or this depth is fully closed): no scan
+                # will ever fold here — skip the frontier alloc AND its
+                # capacity gate (the final boundary's peer sync must
+                # not trip on a histogram nobody will build).
+                return ()
+            rf_mod.require_hist_capacity(self.rf_spec, depth, self.n_cols)
+            return hist_ops.zero_hist(
+                self.rf_spec.num_trees, depth, self.n_cols,
+                self.rf_spec.max_bins, self.rf_spec.n_stats, self._accum,
+            )
         if self.algo == "pca":
             return gram_ops.init_stats(self.n_cols)
         if self.algo == "linreg":
@@ -593,6 +634,15 @@ class _Job:
                     "w": np.asarray(jax.device_get(self.w)),
                     "b": np.asarray(jax.device_get(self.b)).reshape(-1),
                 }
+        if self.algo == "rf":
+            if self.rf_tables is None:
+                raise ValueError(
+                    "forest job has no iterate yet (the driver's "
+                    "set_iterate installs bin edges + node tables first)"
+                )
+            # Host-side tables: copies, so a later in-place grow cannot
+            # mutate an already-shipped ledger/snapshot payload.
+            return {k: np.array(v) for k, v in self.rf_tables.items()}
         raise ValueError(
             f"algo {self.algo!r} is single-pass; it has no iterate"
         )
@@ -637,6 +687,16 @@ class _Job:
                 self.b = jnp.asarray(
                     b if n_classes > 2 else b.reshape(()), self._accum
                 )
+        elif self.algo == "rf":
+            from spark_rapids_ml_tpu.models import random_forest as rf_mod
+
+            self.rf_tables = rf_mod.validate_forest_arrays(
+                arrays, self.rf_spec, self.n_cols
+            )
+            # The pass accumulator is NOT rebuilt here: set_iterate's
+            # generic tail zeroes it right after this install (with the
+            # tables — and therefore the frontier depth — already in
+            # place), and the durable-restore path rebuilds it itself.
         else:
             raise ValueError(
                 f"algo {self.algo!r} is single-pass; set_iterate not applicable"
@@ -648,9 +708,11 @@ class _Job:
         excluded: at a boundary it is zero by construction, so the
         snapshot is O(iterate) — the cheap-persistence property
         core/checkpoint.py already proved for the O(d²) case."""
-        if self.algo not in ("kmeans", "logreg"):
+        if self.algo not in ("kmeans", "logreg", "rf"):
             return {}
         if self.algo == "kmeans" and self.centers is None:
+            return {}
+        if self.algo == "rf" and self.rf_tables is None:
             return {}
         return self._iterate_arrays()
 
@@ -785,7 +847,7 @@ class _Job:
     ) -> None:
         if x.shape[1] != self.n_cols:
             raise ValueError(f"batch width {x.shape[1]} != job n_cols {self.n_cols}")
-        if self.algo in ("linreg", "logreg") and y is None:
+        if self.algo in ("linreg", "logreg", "rf") and y is None:
             raise ValueError(f"{self.algo} feed needs a label column")
         n = x.shape[0]
         if self.algo == "knn":
@@ -858,6 +920,17 @@ class _Job:
                 with _DEVICE_LOCK:  # same device section seed_centers locks
                     c0 = init_fn(x, self.k, np.random.default_rng(self.seed))
                     self.centers = jnp.asarray(c0, self._accum)
+            if self.algo == "rf" and self.rf_tables is None:
+                # The forest iterate (bin edges + node tables) must be
+                # installed before any scan — the kmeans-seed contract:
+                # a peer daemon the driver never configured fails its
+                # tasks loudly here instead of binning differently.
+                raise ValueError(
+                    "rf feed before the forest iterate is installed; the "
+                    "driver sends set_iterate (bin edges + node tables) "
+                    "to every configured daemon before the first scan "
+                    "(spark.srml.daemon.addresses)"
+                )
             stage = None
             fresh_stage = False
             if partition is None:
@@ -878,6 +951,13 @@ class _Job:
                 if self._is_replay(feed_id, stage):
                     return
                 state = stage.state
+            # Bootstrap-bag identity (rf): the batch's rows are
+            # (partition, offset..offset+n) — the stage's running count
+            # (or the pass count for direct feeds), read BEFORE this
+            # fold so replays of a restarted stage mint identical keys.
+            rf_offset = (
+                stage.rows if stage is not None else self.pass_rows
+            )
             with _DEVICE_LOCK:
                 xs = jax.device_put(xb, self.x_sharding)
                 ms = jax.device_put(mb, self.v_sharding)
@@ -885,6 +965,21 @@ class _Job:
                     state = self.update(state, xs, ms)
                 elif self.algo == "kmeans":
                     state = self.update(state, self.centers, xs, ms)
+                elif self.algo == "rf":
+                    from spark_rapids_ml_tpu.models import (
+                        random_forest as rf_mod,
+                    )
+
+                    yb = np.zeros((target,), dtype=np.float64)
+                    yb[:n] = np.asarray(y, np.float64).reshape(-1)
+                    kb = np.zeros((target,), dtype=np.uint32)
+                    kb[:n] = rf_mod.row_identity_keys(partition, rf_offset, n)
+                    ys = jax.device_put(yb, self.v_sharding)
+                    ks = jax.device_put(kb, self.v_sharding)
+                    state = rf_mod.accumulate_histogram(
+                        state, self.rf_tables, xs, ys, ms, ks,
+                        self.rf_spec, self.mesh, n_valid=n,
+                    )
                 elif self.algo == "logreg":
                     yb = np.zeros((target,), dtype=np.float32)
                     yb[:n] = np.asarray(y).reshape(-1)
@@ -1184,6 +1279,10 @@ class _Job:
             self.touched = self._clock()
             if self.algo == "kmeans" and self.centers is None:
                 raise ValueError("kmeans job has no centers yet (seed first)")
+            if self.algo == "rf" and self.rf_tables is None:
+                raise ValueError(
+                    "forest job has no iterate yet (set_iterate first)"
+                )
             return self._iterate_arrays(), {"iteration": self.iteration}
 
     def set_iterate(self, arrays: Dict[str, np.ndarray], iteration: int) -> None:
@@ -1219,7 +1318,7 @@ class _Job:
             if self.dropped:
                 raise KeyError("job was finalized/dropped")
             self.touched = self._clock()
-            if self.algo not in ("kmeans", "logreg"):
+            if self.algo not in ("kmeans", "logreg", "rf"):
                 raise ValueError(
                     f"algo {self.algo!r} is single-pass; step not applicable"
                 )
@@ -1243,6 +1342,32 @@ class _Job:
                     "step with no rows fed this pass (duplicate step retry, "
                     "or executors have not fed yet)"
                 )
+            if self.algo == "rf":
+                from spark_rapids_ml_tpu.models import random_forest as rf_mod
+
+                if self.rf_tables is None:
+                    raise ValueError(
+                        "step before the forest iterate is installed"
+                    )
+                with _DEVICE_LOCK:
+                    grown = rf_mod.grow_level(
+                        self.rf_tables, self.state, self.rf_spec
+                    )
+                    # _zero_state answers () for a grown-out forest: no
+                    # doubled-frontier alloc (or capacity gate) for a
+                    # fit that will never scan again.
+                    self.state = self._zero_state()
+                self.iteration += 1
+                info = {
+                    "iteration": self.iteration,
+                    "depth": grown["depth"],
+                    "open_nodes": grown["open_nodes"],
+                    "splits": grown["splits"],
+                    "pass_rows": self.pass_rows,
+                }
+                self.pass_rows = 0
+                self.touched = self._clock()  # exit stamp (see fold)
+                return self._cache_step(step_id, info)
             if self.algo == "kmeans":
                 from spark_rapids_ml_tpu.models.kmeans import apply_lloyd_update
 
@@ -1519,6 +1644,20 @@ class _Job:
                 "intercept": b,
                 "n_iter": np.asarray([self.iteration]),
             }
+        if self.algo == "rf":
+            if self.rf_tables is None:
+                raise ValueError(
+                    "finalize before any feed: no forest iterate"
+                )
+            out = {
+                k: np.array(v) for k, v in self.rf_tables.items()
+                if k != "depth"
+            }
+            out["n_classes"] = np.asarray(
+                [self.rf_spec.n_classes], np.int64
+            )
+            out["n_iter"] = np.asarray([self.iteration])
+            return out
         if self.algo == "pca" and params.get("raw_moments"):
             # Raw accumulated moments, no eigensolve — a StandardScaler
             # fit is a strict subset of the PCA statistics (count, Σx,
@@ -1592,8 +1731,21 @@ def _model_class(algo: str):
         from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
 
         return StandardScalerModel
+    if algo == "rf_classifier":
+        from spark_rapids_ml_tpu.models.random_forest import (
+            RandomForestClassificationModel,
+        )
+
+        return RandomForestClassificationModel
+    if algo == "rf_regressor":
+        from spark_rapids_ml_tpu.models.random_forest import (
+            RandomForestRegressionModel,
+        )
+
+        return RandomForestRegressionModel
     raise ValueError(
-        f"unknown model algo {algo!r} (pca|kmeans|linreg|logreg|scaler)"
+        f"unknown model algo {algo!r} "
+        "(pca|kmeans|linreg|logreg|scaler|rf_classifier|rf_regressor)"
     )
 
 
@@ -1702,6 +1854,8 @@ def _model_width(algo: str, arrays: Dict[str, np.ndarray]) -> Optional[int]:
             if c is None:
                 c = arrays["centers"]
             return int(np.asarray(c).shape[1])
+        if algo in ("rf_classifier", "rf_regressor"):
+            return int(np.asarray(arrays["bin_edges"]).shape[0])
     except (KeyError, IndexError):
         return None
     return None
@@ -2131,7 +2285,9 @@ class DataPlaneDaemon:
         """Arm pass-boundary snapshots on an iterative job. Single-pass
         jobs (pca/linreg/knn) have no boundary before finalize — their
         recovery unit is the whole (re-runnable) scan, driver-side."""
-        if self._state_dir is None or job.algo not in ("kmeans", "logreg"):
+        if self._state_dir is None or job.algo not in (
+            "kmeans", "logreg", "rf",
+        ):
             return
         job.snapshot_cb = lambda j, _n=name: self._save_job_state(_n, j)
 
@@ -2156,6 +2312,13 @@ class DataPlaneDaemon:
                 # a tampered/truncated snapshot errors cleanly here
                 # instead of crashing inside the next feed's update.
                 job._install_iterate(arrays)
+                if job.algo == "rf":
+                    # The restored forest reopens at its boundary with a
+                    # pass histogram of the INSTALLED depth's frontier
+                    # shape (the wire path gets this from set_iterate's
+                    # generic tail, which a restore never runs).
+                    with _DEVICE_LOCK:
+                        job.state = job._zero_state()
             job.iteration = int(meta["iteration"])
             job.rows = int(meta["rows"])
             job.touched = self._clock()
@@ -2733,7 +2896,7 @@ class DataPlaneDaemon:
         input_col = _opt(req, "input_col", "features")
         x = table_column_to_matrix(table, input_col, req.get("n_cols"))
         y = None
-        if str(_opt(req, "algo", "pca")) in ("linreg", "logreg"):
+        if str(_opt(req, "algo", "pca")) in ("linreg", "logreg", "rf"):
             label_col = _opt(req, "label_col", "label")
             if label_col not in table.column_names:
                 raise KeyError(f"label column {label_col!r} not in batch")
@@ -2778,9 +2941,24 @@ class DataPlaneDaemon:
         # Single parse shared by label validation and the job-mismatch
         # guard below, so the two can't disagree on the coercion rule.
         req_classes = int((req.get("params") or {}).get("n_classes") or 2)
-        if req_algo in ("linreg", "logreg"):
+        if req_algo in ("linreg", "logreg", "rf"):
             if y is None:
                 raise ValueError(f"{req_algo} feed needs a label array")
+            if req_algo == "rf":
+                # rf params carry n_classes = 0 for regression (the
+                # shared req_classes parse's or-2 default is a logreg
+                # convention — re-read the raw value here); a
+                # classifier feed's labels validate like multinomial
+                # logreg (integers in [0, C)) BEFORE any job registers.
+                rf_classes = int(
+                    (req.get("params") or {}).get("n_classes") or 0
+                )
+                if rf_classes > 0:
+                    from spark_rapids_ml_tpu.models.logistic_regression import (
+                        validate_multiclass_labels,
+                    )
+
+                    validate_multiclass_labels(y, rf_classes)
             if req_algo == "logreg":
                 if req_classes > 2:
                     from spark_rapids_ml_tpu.models.logistic_regression import (
@@ -2830,6 +3008,14 @@ class DataPlaneDaemon:
                     raise ValueError(
                         f"job {name!r} has n_classes={job.n_classes}; "
                         f"feed carried n_classes={req_classes}"
+                    )
+            if req_algo == "rf":
+                want = int((req.get("params") or {}).get("n_classes") or 0)
+                if want != job.rf_spec.n_classes:
+                    raise ValueError(
+                        f"job {name!r} has n_classes="
+                        f"{job.rf_spec.n_classes}; feed carried "
+                        f"n_classes={want}"
                     )
             try:
                 job.fold(

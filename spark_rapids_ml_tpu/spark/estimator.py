@@ -821,8 +821,14 @@ class _SparkAdapter:
         core = self._core
         algo = self._daemon_algo
         # A scaler fit is a strict subset of the pca job's statistics —
-        # it feeds the pca protocol and finalizes raw moments.
-        wire_algo = "pca" if algo == "scaler" else algo
+        # it feeds the pca protocol and finalizes raw moments. Both
+        # forest estimators speak the ONE "rf" job protocol (the params'
+        # n_classes picks Gini vs variance daemon-side).
+        wire_algo = (
+            "pca" if algo == "scaler"
+            else "rf" if algo in ("rf_classifier", "rf_regressor")
+            else algo
+        )
         spark = getattr(df, "sparkSession", None)
         host, port, token = daemon_session.resolve(spark)
         # Resilience tuning for every client this fit opens (driver AND,
@@ -851,11 +857,15 @@ class _SparkAdapter:
             "inputCol" if core.hasParam("inputCol") else "featuresCol"
         )
         label_col = (
-            core.getOrDefault("labelCol") if algo in ("linreg", "logreg") else None
+            core.getOrDefault("labelCol")
+            if algo in ("linreg", "logreg", "rf_classifier", "rf_regressor")
+            else None
         )
         cols = [input_col] + ([label_col] if label_col else [])
         sel = df.select(*cols)
-        multi_pass = algo in ("kmeans", "logreg")
+        multi_pass = algo in (
+            "kmeans", "logreg", "rf_classifier", "rf_regressor",
+        )
         if multi_pass:
             sel = sel.persist()
 
@@ -897,6 +907,30 @@ class _SparkAdapter:
                 peer_clients[did] = c
             return c
 
+        def seed_peer_daemons(seed_fn):
+            """Register + pre-seed every CONFIGURED peer daemon
+            (spark.srml.daemon.addresses) before pass 0 — the one
+            implementation of the alias-proof discovery both seeded
+            protocols (kmeans centers, forest iterate) share: peers key
+            by self-reported instance id (address spellings alias), a
+            client that never registers closes here (including on an
+            unreachable/unauthorized peer), registered ones are closed
+            by the fit's outer finally."""
+            for ph, pp in daemon_session.resolve_all(spark):
+                pc = DataPlaneClient(ph, pp, token=token, **ckw)
+                registered = False
+                try:
+                    pid_ = pc.server_id() or f"{ph}:{pp}"
+                    if pid_ == primary_id or pid_ in peers:
+                        continue  # an alias of a daemon already seeded
+                    peers[pid_] = (ph, pp)
+                    peer_clients[pid_] = pc
+                    registered = True
+                    seed_fn(pc)
+                finally:
+                    if not registered:
+                        pc.close()
+
         # Driver-held recovery ledger: the last-known-good iterate and
         # the pass it opens, snapshotted from the same get_iterate pull
         # the peer sync already makes at every boundary. On a daemon
@@ -932,29 +966,79 @@ class _SparkAdapter:
                 client.seed_kmeans(
                     job, seed_tbl, k=k, input_col=input_col, params=feed_params
                 )
-                for ph, pp in daemon_session.resolve_all(spark):
-                    pc = DataPlaneClient(ph, pp, token=token, **ckw)
-                    registered = False
-                    try:
-                        pid_ = pc.server_id() or f"{ph}:{pp}"
-                        if pid_ == primary_id or pid_ in peers:
-                            continue  # an alias of a daemon already seeded
-                        peers[pid_] = (ph, pp)
-                        peer_clients[pid_] = pc
-                        registered = True
-                        pc.seed_kmeans(
-                            job, seed_tbl, k=k, input_col=input_col,
-                            params=feed_params,
-                        )
-                    finally:
-                        # registered clients are closed by the outer
-                        # finally; everything else closes here (incl. on
-                        # an unreachable/unauthorized peer)
-                        if not registered:
-                            pc.close()
+                seed_peer_daemons(
+                    lambda pc: pc.seed_kmeans(
+                        job, seed_tbl, k=k, input_col=input_col,
+                        params=feed_params,
+                    )
+                )
                 if ledger_on:
                     # Ledger seed: pass 0 opens with the seeded centers —
                     # a pass-0 replay re-installs exactly these.
+                    ledger["arrays"], ledger["iteration"] = (
+                        client.get_iterate(job)
+                    )
+            if algo in ("rf_classifier", "rf_regressor"):
+                from spark_rapids_ml_tpu.bridge.arrow import (
+                    table_column_to_matrix,
+                )
+                from spark_rapids_ml_tpu.models import (
+                    random_forest as rf_mod,
+                )
+                from spark_rapids_ml_tpu.ops.histogram import (
+                    quantile_bin_edges,
+                )
+
+                # numClasses from an O(1)-result label probe (the logreg
+                # pattern); 0 = regression (variance splits).
+                n_classes = (
+                    _probe_num_classes(sel, label_col)
+                    if algo == "rf_classifier" else 0
+                )
+                feed_params = {
+                    "num_trees": core.getNumTrees(),
+                    "max_depth": core.getMaxDepth(),
+                    "max_bins": core.getMaxBins(),
+                    "n_classes": n_classes,
+                    "subset": core.getFeatureSubsetStrategy(),
+                    "seed": core.getSeed(),
+                    "bootstrap": core.getBootstrap(),
+                    "min_instances": core.getMinInstancesPerNode(),
+                }
+                # Deterministic driver-side binning seed: a bounded
+                # prefix sample (ONE tiny Spark job — the kmeans-seed /
+                # numCols-probe pattern, RapidsPCA.scala:73-74) trains
+                # the quantile sketch, and set_iterate installs the
+                # SAME (edges + empty node tables) iterate on every
+                # configured daemon before pass 0 — all hosts bin
+                # bitwise-identically; an unlisted peer daemon fails
+                # its tasks loudly (iterate unseeded), exactly the
+                # kmeans contract.
+                sample_n = int(config.get("forest_seed_sample_rows"))
+                seed_tbl = _df_to_arrow(sel.limit(sample_n), [input_col])
+                sample = table_column_to_matrix(seed_tbl, input_col, None)
+                if sample.shape[0] == 0:
+                    raise ValueError("cannot fit on an empty DataFrame")
+                rf_n_cols = int(sample.shape[1])
+                rf_spec = rf_mod.forest_spec_from_params(
+                    feed_params, rf_n_cols
+                )
+                init_arrays = rf_mod.init_forest_arrays(
+                    rf_spec, quantile_bin_edges(sample, rf_spec.max_bins)
+                )
+                client.set_iterate(
+                    job, init_arrays, 0, algo=wire_algo,
+                    n_cols=rf_n_cols, params=feed_params,
+                )
+                seed_peer_daemons(
+                    lambda pc: pc.set_iterate(
+                        job, init_arrays, 0, algo=wire_algo,
+                        n_cols=rf_n_cols, params=feed_params,
+                    )
+                )
+                if ledger_on:
+                    # Ledger seed: a pass-0 replay re-installs exactly
+                    # the seeded (edges + empty tables) iterate.
                     ledger["arrays"], ledger["iteration"] = (
                         client.get_iterate(job)
                     )
@@ -1283,9 +1367,15 @@ class _SparkAdapter:
                         primary_id = new_id
                     arrays = ledger["arrays"]
                     if arrays is not None:
+                        # Registration-table shape dispatch: which array
+                        # carries the feature width per iterate layout
+                        # (kmeans centers / forest bin edges / logreg w).
                         n_cols = int(
                             arrays["centers"].shape[1]
-                            if "centers" in arrays else arrays["w"].shape[0]
+                            if "centers" in arrays
+                            else arrays["bin_edges"].shape[0]
+                            if "bin_edges" in arrays
+                            else arrays["w"].shape[0]
                         )
                         iteration = int(ledger["iteration"])
                         client.set_iterate(
@@ -1490,6 +1580,53 @@ class _SparkAdapter:
                     k=core.getK(),
                     n_rows=n_rows,
                 )
+            elif algo in ("rf_classifier", "rf_regressor"):
+                info = {"open_nodes": 1, "iteration": 0, "depth": 0}
+                rows = 0
+
+                def rf_pass(pass_id):
+                    n = run_pass(pass_id)
+                    if n == 0:
+                        raise ValueError("cannot fit on an empty DataFrame")
+                    with trace_span("step"):
+                        inf = client.step(job)
+                    # The step's histogram must cover exactly the rows
+                    # the scan acked (the kmeans/logreg fence): a job
+                    # resurrected mid-pass answers short here instead of
+                    # splitting on partial histograms.
+                    if int(inf["pass_rows"]) != n:
+                        raise _split_brain(
+                            f"step (pass {pass_id})", n,
+                            int(inf["pass_rows"]), _fed_detail(),
+                        )
+                    # Boundary sync INSIDE the recovery unit: peers open
+                    # the next depth with the primary's grown node
+                    # tables, and the ledger snapshots the same pull —
+                    # a daemon dying here rewinds to the previous
+                    # boundary and the whole scan+step+sync replays.
+                    sync_and_record()
+                    return n, inf
+
+                # One histogram pass per tree depth, until every
+                # frontier closed (or maxDepth landed its last split).
+                for it in range(core.getMaxDepth() + 1):
+                    rows, info = with_recovery(lambda pid=it: rf_pass(pid))
+                    if int(info["open_nodes"]) == 0:
+                        break
+                arrays, _ = with_recovery(lambda: finalize_guarded({}))
+                from spark_rapids_ml_tpu.models.random_forest import (
+                    RandomForestClassificationModel,
+                    RandomForestRegressionModel,
+                )
+
+                arrays = dict(arrays)
+                arrays.pop("n_iter", None)
+                cls = (
+                    RandomForestClassificationModel
+                    if algo == "rf_classifier"
+                    else RandomForestRegressionModel
+                )
+                model = cls(arrays=arrays)
             else:  # logreg
                 info = {"loss": float("nan"), "iteration": 0}
                 step_params = {
@@ -2094,6 +2231,10 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegression as _LogisticRegression,
 )
 from spark_rapids_ml_tpu.models.pca import PCA as _PCA
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestClassifier as _RandomForestClassifier,
+    RandomForestRegressor as _RandomForestRegressor,
+)
 from spark_rapids_ml_tpu.models.scaler import StandardScaler as _StandardScaler
 
 SparkPCA = _make_wrapper(
@@ -2129,4 +2270,17 @@ SparkStandardScaler = _make_wrapper(
     "SparkStandardScaler", _StandardScaler,
     "StandardScaler over PySpark DataFrames (ArrayType features column).",
     daemon_algo="scaler",
+)
+SparkRandomForestClassifier = _make_wrapper(
+    "SparkRandomForestClassifier", _RandomForestClassifier,
+    "RandomForest classification over PySpark DataFrames — histogram "
+    "trees on binned features, one daemon pass per depth (the `rf` job "
+    "protocol).",
+    daemon_algo="rf_classifier",
+)
+SparkRandomForestRegressor = _make_wrapper(
+    "SparkRandomForestRegressor", _RandomForestRegressor,
+    "RandomForest regression over PySpark DataFrames — variance-split "
+    "histogram trees on binned features (the `rf` job protocol).",
+    daemon_algo="rf_regressor",
 )

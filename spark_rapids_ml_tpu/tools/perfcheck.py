@@ -420,6 +420,92 @@ def check_chaos_elastic(
     return ok, lines
 
 
+def check_forest(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --forest`` record (histogram tree ensembles —
+    FOREST_r*). Correctness gates are ABSOLUTE: ``accuracy_ok`` (ours
+    within 0.05 of the sklearn-CPU baseline, or over the 0.9 synthetic
+    floor when sklearn is absent) and a non-empty fit (passes >= 1,
+    positive throughput) FAIL regardless of history. The THROUGHPUT
+    gates are trajectory-relative: fit scan rows/s (``value``) and
+    ``transform_rows_per_s`` must each stay within ``max_regression``
+    of the metric-matched FOREST_r* median. No history → throughput
+    gates SKIP with a note (first record seeds the trajectory) — never
+    a silent pass."""
+    lines: List[str] = []
+    if fresh.get("mode") != "forest":
+        return False, [
+            "record has no mode=forest — not a bench.py --forest record?"
+        ]
+    ok = True
+    value = float(fresh.get("value") or 0.0)
+    passes = int(fresh.get("passes") or 0)
+    if passes < 1 or value <= 0.0:
+        ok = False
+        lines.append(
+            "forest correctness [FAIL] the fit grew no levels "
+            f"(passes={passes}, value={value}) — the bench never ran"
+        )
+    base = fresh.get("baseline") or {}
+    if not bool(fresh.get("accuracy_ok")):
+        ok = False
+        lines.append(
+            f"forest accuracy [FAIL] held-out accuracy "
+            f"{fresh.get('accuracy')} failed the absolute gate (baseline "
+            f"{base.get('impl') or 'synthetic floor'}: "
+            f"{base.get('accuracy', 0.9)}) — no throughput number matters"
+        )
+    else:
+        lines.append(
+            f"forest accuracy [OK] {fresh.get('accuracy')} vs "
+            f"{base.get('impl') or 'synthetic floor'} baseline "
+            f"{base.get('accuracy', 0.9)}"
+        )
+    matching = [
+        h for h in history
+        if h.get("mode") == "forest"
+        and h.get("metric") == fresh.get("metric")
+        # Never mix backends in one trajectory (the check_multichip
+        # simulated/real rule): a CPU-sandbox record gated against a
+        # TPU median is a spurious regression, and the converse hides
+        # a real one.
+        and h.get("backend") == fresh.get("backend")
+    ]
+    if not matching:
+        lines.append(
+            f"forest throughput [SKIP] no FOREST_r* history matches "
+            f"metric {fresh.get('metric')!r} on backend "
+            f"{fresh.get('backend')!r} — recorded {value:,.0f} "
+            f"fit rows/s, {fresh.get('transform_rows_per_s')} transform "
+            "rows/s, nothing gated"
+        )
+        return ok, lines
+    for key, fval in (
+        ("value", value),
+        ("transform_rows_per_s",
+         float(fresh.get("transform_rows_per_s") or 0.0)),
+    ):
+        hist_vals = [
+            float(h[key]) for h in matching if h.get(key) is not None
+        ]
+        if not hist_vals:
+            lines.append(f"forest {key} [SKIP] no history values")
+            continue
+        med = _median(hist_vals)
+        floor = (1.0 - max_regression) * med
+        verdict = "OK" if fval >= floor else "REGRESSION"
+        lines.append(
+            f"forest {key} [{verdict}] {fval:,.1f} vs median {med:,.1f} "
+            f"over {len(matching)} record(s) (gate at -{max_regression:.0%})"
+        )
+        if fval < floor:
+            ok = False
+    return ok, lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.perfcheck",
@@ -483,13 +569,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     fleet = str(fresh.get("metric", "")).startswith("serve_fleet_")
     chaos = str(fresh.get("metric", "")).startswith("chaos_elastic_")
+    forest = str(fresh.get("metric", "")).startswith("forest_")
     default_glob = (
-        "CHAOS_r*.json" if chaos
+        "FOREST_r*.json" if forest
+        else "CHAOS_r*.json" if chaos
         else "FLEET_r*.json" if fleet
         else "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
     )
     history = load_history(args.history or [default_glob])
-    if chaos:
+    if forest:
+        ok, lines = check_forest(
+            fresh, history, max_regression=args.max_regression,
+        )
+    elif chaos:
         ok, lines = check_chaos_elastic(
             fresh, history, max_regression=args.max_regression,
         )

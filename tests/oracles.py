@@ -206,6 +206,86 @@ def logreg(x: np.ndarray, y: np.ndarray, C: float, tol: float = 1e-10, max_iter:
 
 
 # ---------------------------------------------------------------------------
+# Random forest / decision tree accuracy
+# (sklearn.ensemble.RandomForestClassifier; fallback: one exact-split
+# Gini CART tree — an INDEPENDENT implementation: exhaustive real-valued
+# thresholds, recursive, no binning — the differential point being that
+# the histogram approximation should not cost accuracy on easy data)
+# ---------------------------------------------------------------------------
+
+
+def _np_tree_fit(x, y, n_classes, depth, min_rows=2):
+    counts = np.bincount(y.astype(int), minlength=n_classes)
+    leaf = ("leaf", int(np.argmax(counts)))
+    if depth == 0 or len(y) < min_rows or counts.max() == len(y):
+        return leaf
+    n, d = x.shape
+    parent = 1.0 - np.sum((counts / len(y)) ** 2)
+    best = (0.0, None)
+    for j in range(d):
+        order = np.argsort(x[:, j], kind="stable")
+        xs, ys = x[order, j], y[order].astype(int)
+        # candidate thresholds: midpoints between distinct neighbors
+        distinct = np.nonzero(np.diff(xs))[0]
+        if distinct.size > 64:  # bound the scan; keep the oracle honest
+            distinct = distinct[:: max(1, distinct.size // 64)]
+        onehot = np.eye(n_classes)[ys]
+        cum = np.cumsum(onehot, axis=0)
+        for i in distinct:
+            cl = cum[i]
+            cr = counts - cl
+            nl, nr = i + 1.0, n - i - 1.0
+            gl = 1.0 - np.sum((cl / nl) ** 2)
+            gr = 1.0 - np.sum((cr / nr) ** 2)
+            gain = parent - (nl * gl + nr * gr) / n
+            if gain > best[0] + 1e-12:
+                best = (gain, (j, (xs[i] + xs[i + 1]) / 2.0))
+    if best[1] is None:
+        return leaf
+    j, thr = best[1]
+    mask = x[:, j] <= thr
+    return (
+        "split", j, thr,
+        _np_tree_fit(x[mask], y[mask], n_classes, depth - 1, min_rows),
+        _np_tree_fit(x[~mask], y[~mask], n_classes, depth - 1, min_rows),
+    )
+
+
+def _np_tree_predict(tree, x):
+    out = np.empty(x.shape[0], dtype=np.int64)
+    for i, row in enumerate(x):
+        node = tree
+        while node[0] == "split":
+            _, j, thr, left, right = node
+            node = left if row[j] <= thr else right
+        out[i] = node[1]
+    return out
+
+
+def forest_accuracy(
+    x_train, y_train, x_test, y_test, n_estimators=20, max_depth=8, seed=0
+):
+    """Oracle test accuracy for a classification problem: sklearn's
+    RandomForestClassifier when installed, else one exact-split CART
+    tree (same Gini objective, no binning, no bagging — a fair accuracy
+    bar on the easy synthetic data the differential tests use)."""
+    x_train = np.asarray(x_train, np.float64)
+    x_test = np.asarray(x_test, np.float64)
+    y_train = np.asarray(y_train).astype(int)
+    y_test = np.asarray(y_test).astype(int)
+    if HAVE_SKLEARN:
+        from sklearn.ensemble import RandomForestClassifier
+
+        m = RandomForestClassifier(
+            n_estimators=n_estimators, max_depth=max_depth, random_state=seed
+        ).fit(x_train, y_train)
+        return float(m.score(x_test, y_test))
+    n_classes = int(max(y_train.max(), y_test.max())) + 1
+    tree = _np_tree_fit(x_train, y_train, n_classes, max_depth)
+    return float(np.mean(_np_tree_predict(tree, x_test) == y_test))
+
+
+# ---------------------------------------------------------------------------
 # KMeans inertia (sklearn.cluster.KMeans with n_init restarts)
 # ---------------------------------------------------------------------------
 

@@ -230,20 +230,22 @@ def test_wire_ops_are_clamped_and_documented():
 
 
 def test_serve_config_keys_have_env_alias_and_docs():
-    """Every ``serve_*`` / ``fleet_*`` config key is an operator API: it
-    must have its deployment-facing ``SRML_<KEY>`` env alias wired in
-    config.py AND appear in docs/protocol.md (the "Serving scheduler" /
-    "Fleet & versioned serving" contracts — the mirror of the wire-op
-    clamp+docs gate): a knob cannot be added silently, without an env
-    spelling or documentation. The fleet keys (``fleet_*`` +
-    ``serve_version_*``) joined the gate with the fleet PR."""
+    """Every ``serve_*`` / ``fleet_*`` / ``rf_*`` / ``forest_*`` config
+    key is an operator API: it must have its deployment-facing
+    ``SRML_<KEY>`` env alias wired in config.py AND appear in
+    docs/protocol.md (the "Serving scheduler" / "Fleet & versioned
+    serving" / "The `rf` job algo" contracts — the mirror of the
+    wire-op clamp+docs gate): a knob cannot be added silently, without
+    an env spelling or documentation. The fleet keys (``fleet_*`` +
+    ``serve_version_*``) joined the gate with the fleet PR; the forest
+    keys (``forest_*``/``rf_*``) with the tree-ensemble PR."""
     text = (PKG / "config.py").read_text()
     keys = sorted(set(re.findall(
-        r'^\s+"((?:serve|fleet)_[a-z0-9_]+)"\s*:', text, re.M
+        r'^\s+"((?:serve|fleet|rf|forest)_[a-z0-9_]+)"\s*:', text, re.M
     )))
     assert len(keys) >= 5, (
-        f"only {len(keys)} serve_*/fleet_* config keys found — the "
-        "scheduler/fleet config blocks or this regex regressed"
+        f"only {len(keys)} serve_*/fleet_*/forest_* config keys found — "
+        "the scheduler/fleet/forest config blocks or this regex regressed"
     )
     assert any(k.startswith("fleet_") for k in keys), (
         "no fleet_* config keys found — the fleet config block or this "
@@ -253,11 +255,15 @@ def test_serve_config_keys_have_env_alias_and_docs():
         "no serve_version_* config keys found — the versioned-serving "
         "fence config or this regex regressed"
     )
+    assert any(k.startswith(("forest_", "rf_")) for k in keys), (
+        "no forest_*/rf_* config keys found — the tree-ensemble config "
+        "block or this regex regressed"
+    )
     docs = (PKG.parent / "docs" / "protocol.md").read_text()
     missing_env = [k for k in keys if f"SRML_{k.upper()}" not in text]
     assert missing_env == [], (
-        "serve_*/fleet_* config keys without an SRML_ env alias in "
-        "config.py: " + ", ".join(missing_env)
+        "serve_*/fleet_*/forest_* config keys without an SRML_ env alias "
+        "in config.py: " + ", ".join(missing_env)
     )
     undocumented = [
         k for k in keys
@@ -265,8 +271,8 @@ def test_serve_config_keys_have_env_alias_and_docs():
                 and re.search(rf"\bSRML_{k.upper()}\b", docs))
     ]
     assert undocumented == [], (
-        "serve_*/fleet_* config keys (or their SRML_ env aliases) absent "
-        "from docs/protocol.md: " + ", ".join(undocumented)
+        "serve_*/fleet_*/forest_* config keys (or their SRML_ env "
+        "aliases) absent from docs/protocol.md: " + ", ".join(undocumented)
     )
 
 

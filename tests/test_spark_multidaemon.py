@@ -476,7 +476,14 @@ def test_ivf_two_daemons_shared_quantizer(rng, mesh8, two_daemons):
 def test_ivf_two_daemons_partial_probe_recall(rng, mesh8, two_daemons):
     """Sharded IVF at nprobe < nlist (the production operating point):
     recall against brute force stays at the single-index level on
-    clustered data."""
+    clustered data — pinned DIFFERENTIALLY, not just by an absolute
+    floor: the same data fitted on ONE daemon (same nlist/nprobe/seed)
+    sets the bar, and the sharded recall must not fall more than eps
+    below it. This is the protocol.md equivalence claim ("ivf shards
+    probing one shared quantizer produce the single-index candidate
+    set") measured end to end: identical quantizers mean the union of
+    per-shard probes covers the same lists, so recall parity is the
+    observable consequence (VERDICT carry #6)."""
     from spark_rapids_ml_tpu.spark.estimator import (
         SparkApproximateNearestNeighbors,
     )
@@ -489,23 +496,46 @@ def test_ivf_two_daemons_partial_probe_recall(rng, mesh8, two_daemons):
     ).astype(np.float32)
     x = x[rng.permutation(len(x))]
     q = x[:64]
+    d2 = ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1, kind="stable")[:, :k]
+
+    def recall_of(idx):
+        return float(np.mean(
+            [len(set(idx[i]) & set(want[i])) / k for i in range(len(q))]
+        ))
+
+    def ann():
+        return (
+            SparkApproximateNearestNeighbors()
+            .setK(k).setNlist(kc).setNprobe(4).setSeed(11)
+        )
+
+    single = simdf_from_numpy(
+        x, n_partitions=4,
+        session=SimSparkSession({"spark.srml.daemon.address": _addr(a)}),
+    )
+    m_single = ann().fit(single)
+    _, idx_single = m_single.kneighbors(q)
+    recall_single = recall_of(idx_single)
+    m_single.release()
 
     session, env_plan = _split_session(a, b)
     split = simdf_from_numpy(x, n_partitions=4, session=session,
                              env_plan=env_plan)
-    model = (
-        SparkApproximateNearestNeighbors()
-        .setK(k).setNlist(kc).setNprobe(4)
-        .fit(split)
+    m_sharded = ann().fit(split)
+    assert m_sharded.shards is not None and len(m_sharded.shards) == 2
+    _, idx_sharded = m_sharded.kneighbors(q)
+    recall_sharded = recall_of(idx_sharded)
+    m_sharded.release()
+
+    assert recall_sharded > 0.9, recall_sharded
+    # The equivalence pin: sharding may not cost recall beyond noise.
+    eps = 0.05
+    assert recall_sharded >= recall_single - eps, (
+        f"sharded recall {recall_sharded:.3f} fell more than {eps} below "
+        f"the single-index recall {recall_single:.3f} -- the shared-"
+        "quantizer candidate-set equivalence (docs/protocol.md) is broken"
     )
-    _, idx = model.kneighbors(q)
-    d2 = ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
-    want = np.argsort(d2, axis=1, kind="stable")[:, :k]
-    recall = np.mean(
-        [len(set(idx[i]) & set(want[i])) / k for i in range(len(q))]
-    )
-    assert recall > 0.9, recall
-    model.release()
 
 
 def test_exact_knn_three_daemons_matches_single(rng, mesh8):
