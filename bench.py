@@ -936,6 +936,111 @@ def forest_bench() -> None:
     print(json.dumps(record))
 
 
+def kernels_bench() -> None:
+    """``--kernels``: fused-vs-unfused microbench, ONE JSON record line
+    per kernel — the per-kernel receipt behind the fusion PR's headline.
+
+    Each record carries the fused path's throughput (``value``), the
+    unfused XLA two-step's (``unfused_rows_per_s``), and their ratio
+    (``speedup``); ``tools/perfcheck.py check_kernels`` gates the fused
+    path as NEVER-SLOWER-THAN-UNFUSED on the same backend. Off-TPU the
+    fused kernels run the Pallas interpreter, which measures nothing
+    about the TPU kernel — those records are marked ``interpret`` and
+    perfcheck reads them as SKIP, never pass (the shapes also shrink to
+    smoke size there). Kernels covered: the single-pass streaming
+    count/colsum/Gram (``gram_colsum_pallas`` vs the XLA mask two-step)
+    and the streaming distance+top-k (``dist_topk_pallas`` vs
+    ``sq_euclidean`` → ``lax.top_k``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import gram as gram_ops
+    from spark_rapids_ml_tpu.ops import pallas_kernels as pk
+    from spark_rapids_ml_tpu.ops.distances import sq_euclidean
+    from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    tpu = not interpret
+    n = int(os.environ.get("SRML_BENCH_KERNELS_ROWS",
+                           1 << 17 if tpu else 1 << 12))
+    d = int(os.environ.get("SRML_BENCH_KERNELS_COLS", 1024 if tpu else 256))
+    q = int(os.environ.get("SRML_BENCH_KERNELS_QUERIES", 1024 if tpu else 64))
+    k = int(os.environ.get("SRML_BENCH_KERNELS_K", 16 if tpu else 8))
+    reps = int(os.environ.get("SRML_BENCH_KERNELS_REPS", 8 if tpu else 2))
+    cd = jnp.bfloat16 if tpu else jnp.float32
+    cd_name = jnp.dtype(cd).name
+
+    x = jax.random.normal(jax.random.key(0), (n, d), jnp.float32).astype(cd)
+    queries = jax.random.normal(
+        jax.random.key(1), (q, d), jnp.float32
+    ).astype(cd)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    mask = jnp.ones((n,), jnp.float32)
+
+    def timed(fn, *args) -> float:
+        jax.block_until_ready(fn(*args))  # compile + warm outside the clock
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    @ledgered_jit("bench.kernels_gram_fused")
+    def gram_fused(xb):
+        return pk.gram_colsum_pallas(xb, n, interpret=interpret)
+
+    @ledgered_jit("bench.kernels_gram_unfused")
+    def gram_unfused(xb):
+        return gram_ops.local_stats(
+            xb, compute_dtype=cd_name, accum_dtype="float32",
+            use_pallas=False,
+        )
+
+    @ledgered_jit("bench.kernels_topk_fused")
+    def topk_fused(qs, xb):
+        return pk.dist_topk_pallas(qs, xb, ids, mask, k, interpret=interpret)
+
+    @ledgered_jit("bench.kernels_topk_unfused")
+    def topk_unfused(qs, xb):
+        d2 = sq_euclidean(qs, xb, accum_dtype=jnp.float32)
+        neg, idx = jax.lax.top_k(-d2, k)
+        return -neg, idx
+
+    for kernel, fused_s, unfused_s, rows, shape in (
+        (
+            "gram_colsum",
+            timed(gram_fused, x),
+            timed(gram_unfused, x),
+            n,
+            f"n{n}_d{d}_{cd_name}",
+        ),
+        (
+            "dist_topk",
+            timed(topk_fused, queries, x),
+            timed(topk_unfused, queries, x),
+            n,  # db rows scanned per query batch
+            f"n{n}_d{d}_q{q}_k{k}_{cd_name}",
+        ),
+    ):
+        fused_rps = rows / fused_s
+        unfused_rps = rows / unfused_s
+        print(json.dumps({
+            "metric": f"kernel_{kernel}_{shape}",
+            "mode": "kernels",
+            "kernel": kernel,
+            "value": round(fused_rps, 1),
+            "unit": "rows/s",
+            "unfused_rows_per_s": round(unfused_rps, 1),
+            "speedup": round(fused_rps / unfused_rps, 4),
+            "fused_s": round(fused_s, 6),
+            "unfused_s": round(unfused_s, 6),
+            "backend": backend,
+            "interpret": interpret,
+        }))
+
+
 def _fleet_daemon_worker() -> None:
     """``--fleet-daemon`` subcommand: one replica daemon as its own OS
     process (the deployment unit). Prints ``READY <port>``; serves until
@@ -1410,5 +1515,9 @@ if __name__ == "__main__":
         "SRML_BENCH_FOREST", ""
     ) in ("1", "true"):
         forest_bench()
+    elif "--kernels" in sys.argv or os.environ.get(
+        "SRML_BENCH_KERNELS", ""
+    ) in ("1", "true"):
+        kernels_bench()
     else:
         main()

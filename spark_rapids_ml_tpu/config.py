@@ -245,6 +245,17 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_warmup_on_register": _env_named(
         "SRML_SERVE_WARMUP_ON_REGISTER", False, _as_bool
     ),
+    # True AOT at registration (docs/protocol.md "AOT at registration"):
+    # when a warmup runs (warmup-on-register or the `warmup` op), models
+    # that publish a `_serve_aot_plan` have their serving programs
+    # `lower().compile()`d and the executables HELD on the served
+    # instance — nothing executes, no zero-batch dispatches, and a
+    # serving call at a primed shape runs the held executable directly
+    # (zero compiles, zero jit-cache traces on the latency path). Models
+    # without a plan (and shapes outside the ladder) degrade to the
+    # trace-warmup/lazy-compile behavior. The warmup ack's `aot` field
+    # reports which mode ran.
+    "serve_aot": _env_named("SRML_SERVE_AOT", True, _as_bool),
     # Admission bound: max queued requests per served model; overflow
     # (and requests whose deadline the backlog would miss) are shed with
     # the busy/retry_after_s contract instead of queueing to death.

@@ -720,6 +720,25 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
     _serve_algo = "kmeans"
     _serve_outputs = (("prediction", "predictionCol", "int"),)
 
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py; see PCAModel's)."""
+        if self.centers is None:
+            return None
+        d = int(np.asarray(self.centers).shape[1])
+        if int(n_cols) != d:
+            raise ValueError(
+                f"warmup n_cols={int(n_cols)} does not match the "
+                f"model's fitted width {d}"
+            )
+        from spark_rapids_ml_tpu.parallel.sharding import bucket_rows
+
+        return [(
+            self._predictor(),
+            (jax.ShapeDtypeStruct(
+                (bucket_rows(int(n_rows)), d), jnp.dtype(dtype)
+            ),),
+        )]
+
     def transform_matrix(self, x: np.ndarray) -> dict:
         """Role-keyed device transform (daemon ``transform`` op surface)."""
         if self.centers is None:

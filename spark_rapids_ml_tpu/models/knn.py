@@ -46,14 +46,18 @@ from spark_rapids_ml_tpu.core.params import (
     TypeConverters,
 )
 from spark_rapids_ml_tpu.core.persistence import MLReadable, MLWritable
-from spark_rapids_ml_tpu.ops.distances import sq_euclidean
+from spark_rapids_ml_tpu.ops.distances import fused_topk_fits, sq_euclidean
 from spark_rapids_ml_tpu.ops.pallas_kernels import (
     ivf_scan_select_pallas,
     probe_select_pallas,
 )
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel import mapreduce as mr
-from spark_rapids_ml_tpu.parallel.sharding import pad_rows, row_sharding
+from spark_rapids_ml_tpu.parallel.sharding import (
+    bucket_rows,
+    pad_rows,
+    row_sharding,
+)
 from spark_rapids_ml_tpu.utils.profiling import trace_span
 from spark_rapids_ml_tpu.parallel.compat import shard_map
 from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
@@ -64,12 +68,34 @@ from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
 # ---------------------------------------------------------------------------
 
 
+def _exact_fused_enabled() -> bool:
+    """The production gate for the fused exact-kneighbors kernel: the
+    ``use_pallas`` config on a TPU backend, f32 accumulation (the kernel
+    emits f32 scores). Tests force the kernel off-backend by passing
+    ``use_pallas=True`` to :func:`_exact_knn_fn` directly (the kernel then
+    runs in interpret mode — the same pattern as ``ann_fused_scan="on"``)."""
+    from spark_rapids_ml_tpu.ops.gram import _pallas_backend_ok
+
+    return bool(
+        _pallas_backend_ok()
+        and jnp.dtype(config.get("accum_dtype")) == jnp.float32
+    )
+
+
 @functools.lru_cache(maxsize=32)
-def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
+def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2",
+                  use_pallas: bool = False):
     """metric "l2": ascending squared-Euclidean (callers post-process to
     euclidean/sqeuclidean/cosine — the latter two are monotone transforms
     on appropriately normalized inputs). metric "ip": descending inner
-    product (MIPS); returned "distances" are the similarities."""
+    product (MIPS); returned "distances" are the similarities.
+
+    ``use_pallas``: route the l2 shard scan through the fused streaming
+    distance+top-k kernel (ops/pallas_kernels.dist_topk_pallas) — the
+    (q, m_local) distance matrix never reaches HBM and the per-shard
+    selection is exact with (distance, id) tie-breaking, bitwise the
+    ``merge_topk`` order. Off-TPU the kernel runs in interpret mode
+    (goldens); infeasible shapes fall back to the XLA two-step in-trace."""
     compute_dtype = jnp.dtype(cd)
     accum_dtype = jnp.dtype(ad)
 
@@ -84,6 +110,21 @@ def _exact_knn_fn(mesh: Mesh, k: int, cd: str, ad: str, metric: str = "l2"):
         # then all of its rows. The union of per-shard top-min(k, m_local)
         # still contains the global top-k (k <= n total valid rows).
         kl = min(k, m_local)
+        if (
+            use_pallas
+            and metric == "l2"
+            and fused_topk_fits(
+                queries.shape[0], m_local, db.shape[1], kl, accum_dtype
+            )
+        ):
+            from spark_rapids_ml_tpu.ops.pallas_kernels import dist_topk_pallas
+
+            fd, fi = dist_topk_pallas(
+                queries.astype(compute_dtype), db.astype(compute_dtype),
+                row_ids, mask, kl,
+                interpret=jax.default_backend() != "tpu",
+            )
+            return mr.reduce_topk(fd.astype(accum_dtype), fi, k, DATA_AXIS)
         if metric == "ip":
             from spark_rapids_ml_tpu.ops.gram import mm_precision
 
@@ -331,7 +372,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
         if metric == "cosine":
             queries = _normalized_rows(queries, zero_slot=1)
         q = queries.shape[0]
-        bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
+        bucket = bucket_rows(q, 64)
         qp, _ = pad_rows(queries, bucket)
         with trace_span("knn query"):
             from spark_rapids_ml_tpu.parallel.sharding import replicated_array
@@ -339,6 +380,7 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
             fn = _exact_knn_fn(
                 mesh, k, config.get("compute_dtype"), config.get("accum_dtype"),
                 metric="ip" if metric == "inner_product" else "l2",
+                use_pallas=_exact_fused_enabled(),
             )
             d2, idx = jax.device_get(
                 fn(self._db_sharded, self._db_mask, self._db_ids,
@@ -356,6 +398,45 @@ class NearestNeighborsModel(Model, _NNParams, MLWritable, MLReadable):
             # so the cosine distance (1 - cos) is half the squared L2.
             return np.clip(d2[:q] / 2.0, 0, None), idx
         return np.sqrt(np.maximum(d2[:q], 0)), idx
+
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py; see PCAModel's):
+        the sharded exact-kneighbors program, lowered against the
+        device-RESIDENT index arrays plus an abstract replicated query
+        spec. Serve buckets are powers of two ≥ 64, exactly the row
+        counts ``kneighbors`` pads to, so the primed shape IS the served
+        shape. ``k`` defaults to the fitted k (what the scheduler keys
+        un-k'd traffic to). ``_ensure_index`` here DELIBERATELY
+        front-loads the index's device upload into the registration warm
+        — the ack's "servable at full speed" contract covers residency,
+        not just compiles; the first query would pay it otherwise."""
+        if self.database is None:
+            return None
+        if int(n_cols) != int(self.database.shape[1]):
+            raise ValueError(
+                f"warmup n_cols={int(n_cols)} does not match the "
+                f"index's fitted width {int(self.database.shape[1])}"
+            )
+        from jax.sharding import NamedSharding
+
+        mesh = self._mesh or default_mesh()
+        self._ensure_index(mesh)
+        metric = self.getMetric()
+        k = self.getK() if k is None else int(k)
+        fn = _exact_knn_fn(
+            mesh, k, config.get("compute_dtype"), config.get("accum_dtype"),
+            metric="ip" if metric == "inner_product" else "l2",
+            use_pallas=_exact_fused_enabled(),
+        )
+        # MIRROR kneighbors' query padding (max(64, next-pow2)), not the
+        # raw scheduler bucket: a sub-64 or non-pow2 ladder entry would
+        # otherwise prime a shape the query path never dispatches.
+        qspec = jax.ShapeDtypeStruct(
+            (bucket_rows(int(n_rows), 64), int(self._db_sharded.shape[1])),
+            jnp.dtype(dtype),
+            sharding=NamedSharding(mesh, P()),
+        )
+        return [(fn, (self._db_sharded, self._db_mask, self._db_ids, qspec))]
 
     def _transform(self, dataset):
         x = as_matrix(dataset, self.getFeaturesCol())
@@ -391,6 +472,70 @@ class IVFFlatIndex(NamedTuple):
 # maxlen, so balance is also a throughput win.
 IVF_MAX_LOAD_FACTOR = 2.0
 _IVF_SPILL_CANDIDATES = 4
+
+
+def _ivf_assign_chunk_fns(nlist: int):
+    """The two chunked quantizer-assignment jits shared by the host and
+    device IVF builders, with the fused Pallas routes behind the standard
+    ``use_pallas`` gate: the primary assignment rides
+    ``assign_min_dist_pallas`` (distance tile + argmin fused — the (m,
+    nlist) matrix never reaches HBM) and the spill-candidate pass rides the
+    EXACT ``dist_topk_pallas`` (replacing the XLA ``approx_min_k``'s 0.95
+    recall, whose only consumer is capacity balancing — exact preference
+    order is strictly better there). Infeasible shapes (a remainder chunk,
+    a non-lane-aligned nlist) fall back to the XLA ops in-trace."""
+    from spark_rapids_ml_tpu.ops.gram import _pallas_backend_ok
+
+    T = min(_IVF_SPILL_CANDIDATES, nlist)
+
+    @ledgered_jit("knn.ivf_assign")
+    def _argmin_chunk(chunk, centroids):
+        # The kmeans gate owns this kernel's full applicability story
+        # (f32, d ≤ 512 VMEM bound, tile divisibility); the extra m % 8
+        # keeps a sub-1024 REMAINDER chunk (where m % min(1024, m) is
+        # vacuously 0) off the non-sublane-aligned block shapes the
+        # kernel's other callers never exercise.
+        from spark_rapids_ml_tpu.models.kmeans import _pallas_assign_applicable
+
+        m = chunk.shape[0]
+        if m % 8 == 0 and _pallas_assign_applicable(
+            m, nlist, chunk.shape[1], jnp.float32
+        ):
+            from spark_rapids_ml_tpu.ops.pallas_kernels import (
+                assign_min_dist_pallas,
+            )
+
+            idx, _ = assign_min_dist_pallas(
+                chunk, centroids, interpret=jax.default_backend() != "tpu"
+            )
+            return idx
+        d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
+        return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    @ledgered_jit("knn.ivf_candidates")
+    def _cand_chunk(chunk, centroids):
+        m = chunk.shape[0]
+        if _pallas_backend_ok() and fused_topk_fits(
+            m, nlist, chunk.shape[1], T
+        ):
+            from spark_rapids_ml_tpu.ops.pallas_kernels import dist_topk_pallas
+
+            _, idx = dist_topk_pallas(
+                chunk, centroids,
+                jnp.arange(nlist, dtype=jnp.int32),
+                jnp.ones((nlist,), jnp.float32), T,
+                interpret=jax.default_backend() != "tpu",
+            )
+            return idx
+        d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
+        # approx_min_k, not top_k: exact top-k lowers to a full per-row
+        # sort of the nlist-wide row — minutes at 1M×1024 — and the
+        # preference order only feeds capacity balancing (the primary
+        # assignment stays an EXACT argmin).
+        _, idx = jax.lax.approx_min_k(d2, T, recall_target=0.95)
+        return idx.astype(jnp.int32)
+
+    return _argmin_chunk, _cand_chunk
 
 
 def _balance_assignments(cand: np.ndarray, nlist: int, cap: int) -> np.ndarray:
@@ -527,21 +672,7 @@ def build_ivf_flat(
     n = x.shape[0]
     T = min(_IVF_SPILL_CANDIDATES, nlist)
     cdev = jnp.asarray(centroids, jnp.float32)
-
-    @ledgered_jit("knn.ivf_assign")
-    def _argmin_chunk(chunk, cdev):
-        d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
-        return jnp.argmin(d2, axis=1).astype(jnp.int32)
-
-    @ledgered_jit("knn.ivf_candidates")
-    def _cand_chunk(chunk, cdev):
-        d2 = sq_euclidean(chunk, cdev, accum_dtype=jnp.float32)
-        # approx_min_k, not top_k: exact top-k lowers to a full per-row
-        # sort of the nlist-wide row — minutes at 1M×1024 — and the
-        # preference order only feeds capacity balancing (the primary
-        # assignment above stays an EXACT argmin).
-        _, idx = jax.lax.approx_min_k(d2, T, recall_target=0.95)
-        return idx.astype(jnp.int32)
+    _argmin_chunk, _cand_chunk = _ivf_assign_chunk_fns(nlist)
 
     step = 1 << 18
 
@@ -684,21 +815,7 @@ def build_ivf_flat_device(
         centroids, _, _ = fn(sample, jnp.ones((n_train,), jnp.float32), centers0)
         centroids = centroids.astype(jnp.float32)
 
-    T = min(_IVF_SPILL_CANDIDATES, nlist)
-
-    @ledgered_jit("knn.ivf_assign")
-    def _argmin_chunk(chunk, centroids):
-        d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
-        return jnp.argmin(d2, axis=1).astype(jnp.int32)
-
-    @ledgered_jit("knn.ivf_candidates")
-    def _cand_chunk(chunk, centroids):
-        d2 = sq_euclidean(chunk, centroids, accum_dtype=jnp.float32)
-        # approx_min_k (not top_k: that is a full per-row sort — minutes
-        # at 1M×1024); the preference order only feeds capacity balancing —
-        # the unbalanced path's primary assignment stays an EXACT argmin.
-        _, idx = jax.lax.approx_min_k(d2, T, recall_target=0.95)
-        return idx.astype(jnp.int32)
+    _argmin_chunk, _cand_chunk = _ivf_assign_chunk_fns(nlist)
 
     # Chunked assignment for ANY n (a whole-x call would materialize the
     # (n, nlist) distance matrix); at most two compiled shapes (full chunk
@@ -1839,7 +1956,7 @@ class ApproximateNearestNeighborsModel(Model, _ANNParams, MLWritable, MLReadable
         if metric == "cosine":
             queries = _normalized_rows(queries, zero_slot=1)  # index at fit
         q = queries.shape[0]
-        bucket = max(64, 1 << (q - 1).bit_length()) if q else 64
+        bucket = bucket_rows(q, 64)
         qp, _ = pad_rows(queries, bucket)
         with trace_span("ivf query"):
             if self._shard_mesh is not None:

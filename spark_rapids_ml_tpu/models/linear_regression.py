@@ -466,6 +466,25 @@ class LinearRegressionModel(Model, _LinearRegressionParams, MLWritable, MLReadab
     _serve_algo = "linreg"
     _serve_outputs = (("prediction", "predictionCol", "double"),)
 
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py; see PCAModel's)."""
+        if self.coefficients is None:
+            return None
+        from spark_rapids_ml_tpu.parallel.sharding import bucket_rows
+
+        d = int(np.asarray(self.coefficients).reshape(-1).shape[0])
+        if int(n_cols) != d:
+            raise ValueError(
+                f"warmup n_cols={int(n_cols)} does not match the "
+                f"model's fitted width {d}"
+            )
+        return [(
+            self._predictor(),
+            (jax.ShapeDtypeStruct(
+                (bucket_rows(int(n_rows)), d), jnp.dtype(dtype)
+            ),),
+        )]
+
     def _predictor(self):
         """Jitted y = x @ w + b with coefficients device-resident (the
         per-batch-upload fix of SURVEY.md §7(d), same pattern as

@@ -1205,6 +1205,28 @@ class LogisticRegressionModel(Model, _LogisticRegressionParams, MLWritable, MLRe
         ("prediction", "predictionCol", "double"),
     )
 
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py; see PCAModel's) —
+        the device half only: the raw→probability map is host
+        elementwise and compiles nothing."""
+        if self.coefficients is None:
+            return None
+        from spark_rapids_ml_tpu.parallel.sharding import bucket_rows
+
+        c = np.asarray(self.coefficients)
+        d = int(c.shape[-1] if c.ndim == 2 else c.shape[0])
+        if int(n_cols) != d:
+            raise ValueError(
+                f"warmup n_cols={int(n_cols)} does not match the "
+                f"model's fitted width {d}"
+            )
+        return [(
+            self._raw_scorer(),
+            (jax.ShapeDtypeStruct(
+                (bucket_rows(int(n_rows)), d), jnp.dtype(dtype)
+            ),),
+        )]
+
     def _raw_scorer(self):
         """Jitted per-class margins with W, b device-resident — the device
         scoring path the daemon ``transform`` op serves (the reference ran

@@ -598,6 +598,35 @@ class PCAModel(Model, _PCAParams, MLWritable, MLReadable):
     _serve_algo = "pca"
     _serve_outputs = (("output", "outputCol", "vec"),)
 
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py): the serving jits
+        one padded bucket of ``n_rows`` wire-dtype rows dispatches, with
+        their abstract arg specs — ``lower().compile()``d when the model
+        registers so the first request pays zero compiles. The primed row
+        count is what ``run_bucketed`` will actually dispatch for an
+        ``n_rows`` batch (its 256-row floor applies), so small serve
+        buckets dedupe onto the one real shape instead of compiling
+        unreachable executables."""
+        if self.pc is None:
+            return None
+        if int(n_cols) != int(self.pc.shape[0]):
+            # Raise, don't degrade: the trace warmup surfaced a wrong
+            # width as a shape error too — acking it would pre-mark a
+            # shape no real traffic can produce.
+            raise ValueError(
+                f"warmup n_cols={int(n_cols)} does not match the "
+                f"model's fitted width {int(self.pc.shape[0])}"
+            )
+        from spark_rapids_ml_tpu.parallel.sharding import bucket_rows
+
+        return [(
+            self._projector(),
+            (jax.ShapeDtypeStruct(
+                (bucket_rows(int(n_rows)), int(self.pc.shape[0])),
+                jnp.dtype(dtype),
+            ),),
+        )]
+
     def transform_matrix(self, x: np.ndarray) -> dict:
         """Role-keyed transform of a bare (n, d) matrix on device — the
         serving surface the data-plane daemon's ``transform`` op calls

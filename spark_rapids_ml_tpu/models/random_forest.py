@@ -704,6 +704,27 @@ class _ForestModelBase(Model, MLWritable, MLReadable):
         )
         return run_bucketed(lambda xb: fn(xb)[0], x)
 
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py; see PCAModel's) —
+        shared by the classifier and regressor surfaces (one jit serves
+        both predict and predict_proba slices)."""
+        if self.arrays is None:
+            return None
+        from spark_rapids_ml_tpu.parallel.sharding import bucket_rows
+
+        d = int(np.asarray(self.arrays["bin_edges"]).shape[0])
+        if int(n_cols) != d:
+            raise ValueError(
+                f"warmup n_cols={int(n_cols)} does not match the "
+                f"model's fitted width {d}"
+            )
+        return [(
+            self._predictor(),
+            (jax.ShapeDtypeStruct(
+                (bucket_rows(int(n_rows)), d), jnp.dtype(dtype)
+            ),),
+        )]
+
     def transform_matrix(self, x: np.ndarray) -> dict:
         """Role-keyed device transform (daemon ``transform`` op surface):
         bucketer-padded like every served model, so it coalesces through

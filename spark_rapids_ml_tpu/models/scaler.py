@@ -156,6 +156,22 @@ class StandardScalerModel(Model, _ScalerParams, MLWritable, MLReadable):
     _serve_outputs = (("output", "outputCol", "vec"),)
     _serve_params = ("withMean", "withStd")
 
+    def _serve_aot_plan(self, n_rows, n_cols, dtype="float32", k=None):
+        """AOT-at-registration plan (serve/daemon.py): the scaler is host
+        elementwise — nothing compiles, so the plan is trivially
+        complete (an empty list, not None: AOT "succeeds" with zero
+        executables rather than degrading to trace warmup). A wrong
+        ``n_cols`` still raises — the ack must not bless a width the
+        transform will reject."""
+        if self.mean is not None:
+            d = int(np.asarray(self.mean).shape[0])
+            if int(n_cols) != d:
+                raise ValueError(
+                    f"warmup n_cols={int(n_cols)} does not match the "
+                    f"model's fitted width {d}"
+                )
+        return []
+
     def transform_matrix(self, x: np.ndarray) -> dict:
         """Role-keyed transform of a bare matrix (host elementwise — the
         op is bandwidth-trivial relative to any model GEMM)."""

@@ -33,3 +33,35 @@ def sq_euclidean(
     y2 = jnp.sum(jnp.square(y.astype(accum_dtype)), axis=1)
     d = x2[:, None] + y2[None, :] - 2.0 * xy
     return jnp.maximum(d, 0.0)
+
+
+def fused_topk_fits(q: int, m: int, d: int, k: int, accum_dtype=jnp.float32) -> bool:
+    """Shape/dtype/VMEM feasibility of the fused streaming distance+top-k
+    kernel (:func:`~spark_rapids_ml_tpu.ops.pallas_kernels.dist_topk_pallas`)
+    — the SHAPE half of the gate; callers AND it with the backend/config
+    half (``ops.gram._pallas_backend_ok``, or force it on for interpret-mode
+    goldens). f64 accumulation stays on the XLA two-step: the kernel
+    computes and emits f32 scores.
+
+    Deliberately NO feature-width alignment gate: d rides whole blocks
+    (never tiled across the grid), and Mosaic masks a non-128 lane tail —
+    the same shipped contract as the arbitrary-d IVF scan/probe kernels
+    (``ivf_scan_select_pallas``/``probe_select_pallas``); the gram gate's
+    d % 128 is about its resident (d, d) accumulator tiling, which this
+    kernel does not have."""
+    from spark_rapids_ml_tpu.ops import pallas_kernels as pk
+
+    if jnp.dtype(accum_dtype) != jnp.float32:
+        return False
+    if not 0 < k <= min(pk.DIST_TOPK_MAX_K, m):
+        return False
+    bm = min(pk.DIST_TOPK_BLOCK_M, -(-m // 8) * 8)
+    qb = min(pk.DIST_TOPK_BLOCK_Q, -(-q // 8) * 8)
+    # Per grid step: the (bm, d) db block + (d, qb) query panel (each
+    # double-buffered by the pipeline, ≤ f32), the f32 score tile, and the
+    # (k_pad + bm, qb) merge planes (f32 distances + i32 ids).
+    return (
+        2 * (bm * d + d * qb) * 4
+        + bm * qb * 4
+        + (bm + 2 * (-(-k // 8) * 8)) * qb * 8
+    ) <= 64 * 2**20

@@ -378,8 +378,13 @@ def streaming_update_rows(mesh: Mesh, compute_dtype=None, accum_dtype=None):
     placement (halving transfer bytes for bfloat16) so the hot loop never
     touches float32 row data. On TPU with ``use_pallas`` the per-shard stats
     use the single-HBM-pass fused kernel
-    (:func:`~spark_rapids_ml_tpu.ops.pallas_kernels.gram_colsum_pallas`);
-    elsewhere an iota-derived mask reuses the XLA path.
+    (:func:`~spark_rapids_ml_tpu.ops.pallas_kernels.gram_colsum_pallas`),
+    which emits count/colsum/gram together; on a single-data-device mesh
+    with float32 accumulation the donated streaming state is additionally
+    SEEDED into the kernel's VMEM accumulators, so the whole per-batch
+    ``state += batch_stats`` is one Pallas dispatch — the separate XLA add
+    that round-tripped the (d, d) state through HBM per batch is gone.
+    Elsewhere an iota-derived mask reuses the XLA path.
     """
     dcd, dad = _dtypes()
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else dcd
@@ -398,6 +403,11 @@ def _streaming_update_rows_cached(
     # builder call and first trace would cache the wrong executable forever.
     cd = jnp.dtype(compute_dtype)
     ad = jnp.dtype(accum_dtype)
+    # The seeded one-dispatch path folds the donated state INSIDE the
+    # kernel, which is only correct when no cross-shard psum sits between
+    # the partial and the state add — i.e. a single data device — and when
+    # the state dtype is the kernel's f32 accumulator dtype.
+    n_data = mesh.shape[DATA_AXIS]
 
     def shard_update(count, colsum, gram, x, n_valid):
         m = x.shape[0]
@@ -407,7 +417,12 @@ def _streaming_update_rows_cached(
         if _pallas_rows_applicable(x.shape, cd, use_pallas):
             from spark_rapids_ml_tpu.ops.pallas_kernels import gram_colsum_pallas
 
-            g, cs = gram_colsum_pallas(xc, nv_local)
+            if n_data == 1 and ad == jnp.dtype(jnp.float32):
+                g, cs, c = gram_colsum_pallas(
+                    xc, nv_local, state=(gram, colsum, count)
+                )
+                return c, cs, g
+            g, cs, _ = gram_colsum_pallas(xc, nv_local)
             g = g.astype(ad)
             cs = cs.astype(ad)
         else:

@@ -122,11 +122,25 @@ GRAM_COLSUM_BLOCK_N = 512
 GRAM_COLSUM_VMEM_BUDGET = 64 * 2**20  # max (d, d) f32 resident accumulator
 
 
-def _gram_colsum_kernel(nvalid_ref, x_ref, g_ref, cs_ref, *, block_n):
+def _gram_colsum_kernel(nvalid_ref, x_ref, *refs, block_n, seeded):
+    if seeded:
+        g0_ref, cs0_ref, c0_ref, g_ref, cs_ref, c_ref = refs
+    else:
+        g_ref, cs_ref, c_ref = refs
+
     @pl.when(pl.program_id(0) == 0)
     def _init():
-        g_ref[:] = jnp.zeros_like(g_ref)
-        cs_ref[:] = jnp.zeros_like(cs_ref)
+        if seeded:
+            # Accumulators start from the caller's streaming state, so the
+            # whole per-batch update (state + batch stats) is ONE dispatch
+            # with no separate add kernel reading the (d, d) state again.
+            g_ref[:] = g0_ref[:]
+            cs_ref[:] = cs0_ref[:]
+            c_ref[:] = c0_ref[:]
+        else:
+            g_ref[:] = jnp.zeros_like(g_ref)
+            cs_ref[:] = jnp.zeros_like(cs_ref)
+            c_ref[:] = jnp.zeros_like(c_ref)
 
     row0 = pl.program_id(0) * block_n
     nv = nvalid_ref[0]
@@ -148,6 +162,9 @@ def _gram_colsum_kernel(nvalid_ref, x_ref, g_ref, cs_ref, *, block_n):
             precision=_dot_prec(xb.dtype),
         )
         cs_ref[:] += jnp.sum(xb.astype(jnp.float32), axis=0, keepdims=True)
+        lane = jax.lax.broadcasted_iota(jnp.int32, c_ref.shape, 1)
+        valid = jnp.minimum(nv - row0, block_n).astype(jnp.float32)
+        c_ref[:] += jnp.where(lane == 0, valid, 0.0)
 
 
 @functools.partial(
@@ -157,9 +174,11 @@ def gram_colsum_pallas(
     x: jax.Array,
     n_valid: jax.Array,
     block_n: int = GRAM_COLSUM_BLOCK_N,
+    state=None,
     interpret: bool = False,
 ):
-    """One-HBM-pass fused XᵀX + column sum of the first ``n_valid`` rows.
+    """One-HBM-pass fused count + column sum + XᵀX of the first ``n_valid``
+    rows — the full streaming-moment statistic in a single kernel.
 
     x: (n, d) in the compute dtype (bfloat16 engages the MXU at full rate;
     the GEMM accumulates in float32 either way). Rows ≥ n_valid are treated
@@ -170,7 +189,15 @@ def gram_colsum_pallas(
     the streaming equivalent of the reference's dgemmCov hot loop
     (rapidsml_jni.cu:109-127) with its mean-stats pass fused in.
 
-    Returns (gram (d, d) float32, colsum (d,) float32).
+    ``state``: optional ``(gram, colsum, count)`` f32 streaming state the
+    accumulators are SEEDED from (loaded into VMEM at the first grid step),
+    so the per-batch ``state += batch_stats`` of the streaming fit is this
+    one dispatch — the separate XLA add that re-read and re-wrote the
+    (d, d) state per batch is gone (ops/gram.streaming_update_rows consumes
+    this under donation on single-data-device meshes).
+
+    Returns (gram (d, d) float32, colsum (d,) float32, count () float32 —
+    exact up to 2^24 rows per accumulator lifetime).
     """
     n, d = x.shape
     bn = min(block_n, n)
@@ -179,20 +206,38 @@ def gram_colsum_pallas(
     if d * d * 4 > GRAM_COLSUM_VMEM_BUDGET:
         raise ValueError(f"d={d}: (d, d) f32 accumulator exceeds the VMEM budget")
     nv = jnp.asarray(n_valid, jnp.int32).reshape((1,))
-    gram, colsum = pl.pallas_call(
-        functools.partial(_gram_colsum_kernel, block_n=bn),
+    seeded = state is not None
+    extra_in = []
+    extra_specs = []
+    if seeded:
+        g0, cs0, c0 = state
+        extra_in = [
+            g0.astype(jnp.float32),
+            cs0.astype(jnp.float32).reshape(1, d),
+            jnp.zeros((1, 128), jnp.float32)
+            .at[0, 0].set(jnp.asarray(c0, jnp.float32)),
+        ]
+        extra_specs = [
+            pl.BlockSpec((d, d), lambda i, nv: (0, 0)),
+            pl.BlockSpec((1, d), lambda i, nv: (0, 0)),
+            pl.BlockSpec((1, 128), lambda i, nv: (0, 0)),
+        ]
+    gram, colsum, count = pl.pallas_call(
+        functools.partial(_gram_colsum_kernel, block_n=bn, seeded=seeded),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n // bn,),
-            in_specs=[pl.BlockSpec((bn, d), lambda i, nv: (i, 0))],
+            in_specs=[pl.BlockSpec((bn, d), lambda i, nv: (i, 0))] + extra_specs,
             out_specs=[
                 pl.BlockSpec((d, d), lambda i, nv: (0, 0)),
                 pl.BlockSpec((1, d), lambda i, nv: (0, 0)),
+                pl.BlockSpec((1, 128), lambda i, nv: (0, 0)),
             ],
         ),
         out_shape=[
             jax.ShapeDtypeStruct((d, d), jnp.float32),
             jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
@@ -203,8 +248,8 @@ def gram_colsum_pallas(
         if not interpret
         else None,
         interpret=interpret,
-    )(nv, x)
-    return gram, colsum[0]
+    )(nv, x, *extra_in)
+    return gram, colsum[0], count[0, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -557,6 +602,160 @@ def assign_min_dist_pallas(
         interpret=interpret,
     )(x, centers, c2)
     return best_i, best_d
+
+
+# ---------------------------------------------------------------------------
+# Fused streaming distance + EXACT top-k: kneighbors without the (q, m) matrix
+# ---------------------------------------------------------------------------
+
+
+DIST_TOPK_BLOCK_M = 1024
+DIST_TOPK_BLOCK_Q = 256
+#: Extraction-pass unroll bound: each of the k selection passes is a pair
+#: of sublane reduces over the (block_m + k_pad, qb) tile, statically
+#: unrolled — past this, selection cost and program size outgrow the GEMM
+#: and the two-step XLA path wins anyway.
+DIST_TOPK_MAX_K = 64
+
+
+def _dist_topk_kernel(rows_ref, r2_ref, ids_ref, qT_ref, q2_ref,
+                      d_ref, i_ref, *, k):
+    """One candidate block per inner grid step: distance GEMM + merge into
+    the running per-query top-k, the (bm, qb) score tile never leaving VMEM.
+
+    Layout is the round-3 selection lesson (benchmarks/README.md) applied
+    to the EXACT kneighbors path: candidates ride the SUBLANES, queries the
+    LANES, so every one of the k extraction passes reduces over the cheap
+    VPU direction. The running (k_pad, qb) best-distance/best-id planes are
+    VMEM-resident across the whole candidate grid — nothing of size (q, m)
+    is ever written to HBM, the fusion the XLA ``sq_euclidean`` →
+    ``lax.top_k`` two-step cannot express (it materializes the full
+    distance matrix between the two ops).
+
+    Selection is k lexicographic (distance, id) min-extraction passes over
+    the concatenation of the running best and the fresh block: ids are
+    globally unique for valid rows, so each pass's equality mask removes
+    exactly one element, and ties resolve to the LOWEST id — the
+    ``merge_topk`` host-merge contract, pinned by the duplicate-distance
+    regression test so sharded and single-daemon answers stay comparable.
+    Invalid/padded rows carry (+inf, -1) and sort past every real
+    candidate; slots with no finite candidate emit exactly (+inf, -1).
+    """
+    jb = pl.program_id(1)
+
+    @pl.when(jb == 0)
+    def _init():
+        d_ref[:] = jnp.full_like(d_ref, jnp.inf)
+        i_ref[:] = jnp.full_like(i_ref, -1)
+
+    rows = rows_ref[:]  # (bm, d) compute dtype; padded rows zero
+    qT = qT_ref[:]  # (d, qb) compute dtype
+    qr = jax.lax.dot_general(
+        rows, qT, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=_dot_prec(rows.dtype),
+    )  # (bm, qb)
+    # Same term order as ops/distances.sq_euclidean ((x²+y²) − 2xy, clipped
+    # at 0) so fused and unfused distances differ only by GEMM tiling.
+    d2 = jnp.maximum(q2_ref[:] + r2_ref[:] - 2.0 * qr, 0.0)  # (bm, qb)
+    ids = jnp.broadcast_to(ids_ref[:], d2.shape)  # (bm, qb) int32
+    cat_d = jnp.concatenate([d_ref[:], d2], axis=0)  # (k_pad + bm, qb)
+    cat_i = jnp.concatenate([i_ref[:], ids], axis=0)
+    for j in range(k):
+        m = jnp.min(cat_d, axis=0, keepdims=True)  # (1, qb) sublane min
+        mi = jnp.min(
+            jnp.where(cat_d == m, cat_i, jnp.int32(0x7FFFFFFF)),
+            axis=0, keepdims=True,
+        )  # lowest id among distance ties: the (distance, id) order
+        d_ref[j : j + 1, :] = m
+        i_ref[j : j + 1, :] = jnp.where(m < jnp.inf, mi, jnp.int32(-1))
+        cat_d = jnp.where((cat_d == m) & (cat_i == mi), jnp.inf, cat_d)
+
+
+@functools.partial(
+    ledgered_jit, "pallas.dist_topk_pallas",
+    static_argnames=("k", "block_m", "block_q", "interpret"),
+)
+def dist_topk_pallas(
+    queries: jax.Array,
+    db: jax.Array,
+    row_ids: jax.Array,
+    mask: jax.Array,
+    k: int,
+    block_m: int = DIST_TOPK_BLOCK_M,
+    block_q: int = DIST_TOPK_BLOCK_Q,
+    interpret: bool = False,
+):
+    """Exact fused kneighbors core: per-query top-``k`` squared-Euclidean
+    neighbors of ``queries`` (q, d) against ``db`` (m, d), streaming db
+    blocks through one HBM pass with the running k-best VMEM-resident —
+    the (q, m) distance matrix is never materialized (the ledger's
+    ``memory_analysis`` receipt in tests/test_knn.py pins that).
+
+    ``row_ids``: (m,) int32 global ids of the db rows (-1 on padding);
+    ``mask``: (m,) {0,1} — masked rows score +inf and emit id -1, matching
+    the XLA path's missing-slot contract. Ties resolve by ascending
+    (distance, id) — bitwise the ``merge_topk``/``reduce_topk`` order, so
+    sharded and single-daemon kneighbors stay comparable. Distances are
+    true clipped f32 squared distances (not argmin-residuals).
+
+    Returns (dists (q, k) f32 ascending, ids (q, k) int32).
+    """
+    q, d = queries.shape
+    m = db.shape[0]
+    if k > DIST_TOPK_MAX_K:
+        raise ValueError(f"k={k} exceeds DIST_TOPK_MAX_K={DIST_TOPK_MAX_K}")
+    if k > m:
+        raise ValueError(f"k={k} exceeds database rows m={m}")
+    qb = min(block_q, _ceil_to(q, 8))
+    q_pad = _ceil_to(q, qb)
+    bm = min(block_m, _ceil_to(m, 8))
+    m_pad = _ceil_to(m, bm)
+    qf = queries.astype(jnp.float32)
+    q2 = jnp.sum(jnp.square(qf), axis=1)[None, :]  # (1, q) f32
+    qT = jnp.swapaxes(queries, 0, 1)  # (d, q) compute dtype
+    if q_pad != q:
+        qT = jnp.pad(qT, ((0, 0), (0, q_pad - q)))
+        q2 = jnp.pad(q2, ((0, 0), (0, q_pad - q)))
+    dbf = db.astype(jnp.float32)
+    r2 = jnp.where(
+        mask.astype(jnp.float32) > 0,
+        jnp.sum(jnp.square(dbf), axis=1),
+        jnp.inf,
+    )[:, None]  # (m, 1) f32; +inf never wins and decodes to id -1
+    ids = jnp.asarray(row_ids, jnp.int32)[:, None]
+    if m_pad != m:
+        db = jnp.pad(db, ((0, m_pad - m), (0, 0)))
+        r2 = jnp.pad(r2, ((0, m_pad - m), (0, 0)), constant_values=jnp.inf)
+        ids = jnp.pad(ids, ((0, m_pad - m), (0, 0)), constant_values=-1)
+    k_pad = _ceil_to(k, 8)
+    best_d, best_i = pl.pallas_call(
+        functools.partial(_dist_topk_kernel, k=k),
+        name="dist_topk",
+        grid=(q_pad // qb, m_pad // bm),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (j, 0)),
+            pl.BlockSpec((d, qb), lambda i, j: (0, i)),
+            pl.BlockSpec((1, qb), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, qb), lambda i, j: (0, i)),
+            pl.BlockSpec((k_pad, qb), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, q_pad), jnp.float32),
+            jax.ShapeDtypeStruct((k_pad, q_pad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 2**20,
+        )
+        if not interpret
+        else None,
+        interpret=interpret,
+    )(db, r2, ids, qT, q2)
+    return best_d[:k, :q].T, best_i[:k, :q].T
 
 
 # ---------------------------------------------------------------------------

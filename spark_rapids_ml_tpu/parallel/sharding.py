@@ -35,6 +35,15 @@ def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
     return x, mask
 
 
+def bucket_rows(n: int, min_bucket: int = 256) -> int:
+    """The row count :func:`run_bucketed` pads an ``n``-row batch to —
+    exposed so AOT serving plans (models' ``_serve_aot_plan``) prime the
+    shape the transform path will actually dispatch, not the raw
+    scheduler bucket (a 64-row serve bucket dispatches a 256-row device
+    program under the default ``min_bucket``)."""
+    return max(min_bucket, 1 << (n - 1).bit_length()) if n else min_bucket
+
+
 def run_bucketed(fn, x: np.ndarray, min_bucket: int = 256) -> np.ndarray:
     """Apply a jitted row-wise device fn to ``x`` padded to a power-of-two
     row bucket, returning the first n rows of the (host-fetched) result.
@@ -46,8 +55,7 @@ def run_bucketed(fn, x: np.ndarray, min_bucket: int = 256) -> np.ndarray:
 
     x = np.asarray(x)
     n = x.shape[0]
-    bucket = max(min_bucket, 1 << (n - 1).bit_length()) if n else min_bucket
-    xp, _ = pad_rows(x, bucket)
+    xp, _ = pad_rows(x, bucket_rows(n, min_bucket))
     out = jax.device_get(fn(xp))
     return np.asarray(out)[:n]
 

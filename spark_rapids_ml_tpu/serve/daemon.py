@@ -1779,6 +1779,9 @@ class _ServedModel:
         # Immutable once set — a version under one name never changes;
         # new versions get new names (the fleet's `model@vN` convention).
         self.version: Optional[int] = None
+        # AOT compile ledger (docs/protocol.md "AOT at registration"):
+        # None until aot_warm runs; then {"buckets", "compiled", "jits"}.
+        self.aot: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_model(
@@ -1801,7 +1804,76 @@ class _ServedModel:
         obj.id_map = None if id_map is None else np.asarray(id_map, np.int64)
         obj.ttl_scale = 8.0
         obj.version = None
+        obj.aot = None
         return obj
+
+    def aot_warm(
+        self, n_cols: int, buckets, k, dtype: str = "float32",
+    ) -> Optional[Dict[str, Any]]:
+        """True AOT of the serve bucket ladder: ``lower().compile()`` every
+        reachable bucket's serving program via the model's
+        ``_serve_aot_plan`` and hold the executables on the plan's jit
+        wrappers. For the transform models those wrappers live in
+        per-model-INSTANCE caches, so the executables die with the
+        registration (a version pin keeps ITS executables); the exact-KNN
+        plan's wrapper is the process-level ``_exact_knn_fn`` cache, where
+        executables are shape-keyed and shared exactly like that jit's own
+        dispatch cache (bounded by distinct index/query shapes, not by
+        registration churn). Nothing executes here: unlike the zero-batch
+        trace warmup, no garbage dispatch ever touches the device, and the
+        primed shapes are immune to jit-cache churn. Returns the ack's
+        ``{"buckets", "compiled"}`` (compiled = fresh executables built by
+        THIS call), or None when the model publishes no plan — the caller
+        then degrades to trace warmup."""
+        plan_fn = getattr(self.model, "_serve_aot_plan", None)
+        if plan_fn is None:
+            return None
+        jits: list = []
+        compiled = 0
+        buckets = [int(b) for b in buckets]
+        for bucket in buckets:
+            # Plan building may touch the device (the KNN plan's index
+            # upload) — that part single-files with live dispatches; the
+            # lower().compile() primes are pure host work and run
+            # unlocked so a registration never stalls serving traffic.
+            with _DEVICE_LOCK:
+                entries = plan_fn(bucket, int(n_cols), dtype=dtype, k=k)
+            if entries is None:
+                return None
+            for jit_obj, args in entries:
+                if jit_obj.aot_prime(*args):
+                    compiled += 1
+                if all(j is not jit_obj for j in jits):
+                    jits.append(jit_obj)
+        # Hit/miss BASELINES per wrapper: a shared wrapper (the KNN case
+        # above) carries other registrations' counts — this instance's
+        # ledger reports only what happened since ITS warm.
+        self.aot = {
+            "buckets": buckets,
+            "compiled": compiled,
+            "jits": [(j, j.aot_hits, j.aot_misses) for j in jits],
+        }
+        return {"buckets": buckets, "compiled": compiled}
+
+    def aot_status(self) -> Optional[Dict[str, Any]]:
+        """The served instance's compile ledger: primed buckets +
+        executables, and the serve-time hit/miss counts since this
+        registration's warm (a miss = a dispatch at a shape nothing
+        primed, OR a held executable that rejected its args and degraded
+        to the lazy jit — either way at most one lazy compile). None
+        when AOT never ran for this registration. Caveat for plans whose
+        wrapper is process-shared (exact KNN): two CONCURRENTLY-served
+        registrations with identical index/query shapes pool their
+        counts on the shared wrapper — the baselines separate
+        sequential churn, not simultaneous same-shape traffic."""
+        if self.aot is None:
+            return None
+        return {
+            "buckets": self.aot["buckets"],
+            "compiled": self.aot["compiled"],
+            "hits": sum(j.aot_hits - h0 for j, h0, _ in self.aot["jits"]),
+            "misses": sum(j.aot_misses - m0 for j, _, m0 in self.aot["jits"]),
+        }
 
     def transform(self, x: np.ndarray) -> Dict[str, np.ndarray]:
         # Serialize per-model: the jit caches aren't thread-safe to build
@@ -2723,11 +2795,15 @@ class DataPlaneDaemon:
         elif op == "model_status":
             with self._models_lock:
                 m = self._models.get(str(req.get("model")))
-            protocol.send_json(
-                conn,
-                {"ok": True, "exists": m is not None,
-                 "algo": None if m is None else m.algo},
-            )
+            status = {"ok": True, "exists": m is not None,
+                      "algo": None if m is None else m.algo}
+            # Additive: the registration's AOT compile ledger (primed
+            # buckets + serve-time hits/misses), absent when AOT never
+            # ran for this instance.
+            aot = None if m is None else m.aot_status()
+            if aot is not None:
+                status["aot"] = aot
+            protocol.send_json(conn, status)
         elif op == "drop_model":
             # Snapshot discard FIRST, and unconditionally (even with no
             # live model): drop is the release op, and an orphan model
@@ -3487,17 +3563,68 @@ class DataPlaneDaemon:
             else "transform"
         )
         try:
-            return self._scheduler.warmup(
-                name, served, int(width), kind=kind,
-                k=_resolve_k(served, None) if kind == "kneighbors" else None,
-                dtype="float32",
-            )
+            return self._warm_model(name, served, int(width), kind=kind,
+                                    k=_resolve_k(served, None)
+                                    if kind == "kneighbors" else None)
         except Exception as e:
             logger.warning(
                 "warmup-on-register for %r failed (first requests will "
                 "compile lazily): %s", name, e,
             )
             return None
+
+    def _warm_model(
+        self, name: str, served, n_cols: int, kind: str,
+        k: Optional[int], dtype: str = "float32",
+    ) -> Dict[str, Any]:
+        """One warm pass over the reachable bucket ladder, AOT-first
+        (docs/protocol.md "AOT at registration"): with ``serve_aot`` on
+        and a model that publishes a ``_serve_aot_plan``, every ladder
+        bucket's serving program is ``lower().compile()``d and the
+        executables held on the served instance — first-request compile
+        time leaves the latency path entirely, with no zero-batch device
+        dispatches. The scheduler's per-instance shape ledger is
+        pre-marked for the primed shapes, so the first real batch at a
+        warmed bucket counts as a compile HIT. Models without a plan (or
+        ``serve_aot`` off) run the PR-5 zero-batch trace warmup instead.
+        Returns the warmup ack info; its additive ``aot`` field says
+        which mode ran."""
+        from spark_rapids_ml_tpu import config
+
+        buckets = self._scheduler.reachable_buckets()
+        if bool(config.peek("serve_aot")):
+            # An AOT failure (a bucket that won't lower/compile) degrades
+            # to the trace warmup below, exactly like a no-plan model —
+            # the docs/protocol.md contract. Executables primed before
+            # the failure stay on their wrappers (harmless hits). NOT
+            # under _DEVICE_LOCK: the compiles are host-side, and a
+            # registration must not stall other models' live traffic for
+            # the whole ladder's compile time (aot_warm takes the lock
+            # only around plan building, which may upload index data).
+            try:
+                info = served.aot_warm(n_cols, buckets, k, dtype)
+            except Exception as e:
+                logger.warning(
+                    "AOT warmup for %r failed (degrading to trace "
+                    "warmup): %s", name, e,
+                )
+                info = None
+            if info is not None:
+                # Pre-mark the scheduler's shape ledger: the compiles for
+                # these shapes exist (they are the held executables), so
+                # the first dispatched batch must read as a hit, exactly
+                # like a trace-warmed shape. Done through the scheduler
+                # (its lock) — _dispatch mutates the same set.
+                self._scheduler.premark_shapes(
+                    served,
+                    [(kind, k, dtype, int(n_cols), int(b))
+                     for b in info["buckets"]],
+                )
+                return {**info, "aot": True}
+        out = self._scheduler.warmup(
+            name, served, int(n_cols), kind=kind, k=k, dtype=dtype,
+        )
+        return {**out, "aot": False}
 
     @staticmethod
     def _version_fence(req: Dict[str, Any], name: str, served
@@ -3609,7 +3736,7 @@ class DataPlaneDaemon:
                 f"unknown warmup kind {kind!r} (transform|kneighbors)"
             )
         k = req.get("k")
-        info = self._scheduler.warmup(
+        info = self._warm_model(
             name, served, int(n_cols), kind=str(kind),
             k=_resolve_k(served, k) if kind == "kneighbors" else None,
             dtype=str(_opt(req, "dtype", "float32")),

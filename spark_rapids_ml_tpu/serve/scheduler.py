@@ -373,6 +373,30 @@ class RequestScheduler:
 
     # -- warmup ------------------------------------------------------------
 
+    def reachable_buckets(self) -> list:
+        """The REACHABLE bucket ladder: every bucket some coalesced batch
+        can map to, i.e. up to ``_bucket_for(cap)`` — covers a cap below
+        the smallest bucket, where batches still pad to that bucket.
+        Buckets above the coalescing cap can never hold a coalesced batch
+        (oversize singles bypass the scheduler), so warming or AOT-
+        compiling them would be pure dead weight."""
+        top = self._bucket_for(self._cap_rows)
+        return [b for b in self._buckets if b <= top]
+
+    def premark_shapes(self, served, shape_keys) -> None:
+        """Mark AOT-warmed shapes in the served instance's compile-shape
+        ledger UNDER THE SCHEDULER'S LOCK — ``_dispatch`` creates/reads
+        the same ``_sched_seen`` set under ``_cv``, so an unlocked
+        mutation from the warmup thread could race a first live dispatch
+        and lose shapes in either direction (the compile-hit signal the
+        pre-mark exists to produce)."""
+        with self._cv:
+            ledger = getattr(served, "_sched_seen", None)
+            if ledger is None:
+                ledger = set()
+                served._sched_seen = ledger
+            ledger.update(shape_keys)
+
     def warmup(
         self,
         model: str,
@@ -391,11 +415,7 @@ class RequestScheduler:
         be pure dead weight. Returns ``{"buckets", "compiled"}`` —
         ``compiled`` counts the shapes this call saw for the first
         time."""
-        # The reachable ladder: every bucket some coalesced batch can
-        # map to, i.e. up to _bucket_for(cap) — covers a cap below the
-        # smallest bucket, where batches still pad to that bucket.
-        top = self._bucket_for(self._cap_rows)
-        ladder = [b for b in self._buckets if b <= top]
+        ladder = self.reachable_buckets()
         compiled = 0
         for bucket in ladder:
             x = np.zeros((bucket, int(n_cols)), dtype=np.dtype(dtype))

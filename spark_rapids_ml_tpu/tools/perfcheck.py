@@ -90,8 +90,14 @@ def check(
     history: List[Dict[str, Any]],
     max_regression: float = DEFAULT_MAX_REGRESSION,
     allow_compiles: Tuple[str, ...] = (),
+    require_xla: bool = False,
 ) -> Tuple[bool, List[str]]:
-    """(ok, report lines). ``fresh``/``history`` are parse_record output."""
+    """(ok, report lines). ``fresh``/``history`` are parse_record output.
+
+    ``require_xla``: a fresh record with NO ``xla`` breakdown at all is
+    SKIP-not-pass (overall FAIL) — set for plain BENCH records, where
+    every post-r06 bench embeds the ledger; the fleet/chaos record
+    families legitimately carry none and keep the soft SKIP."""
     lines: List[str] = []
     ok = True
 
@@ -124,6 +130,21 @@ def check(
         )
 
     xla = fresh.get("xla")
+    if require_xla and (not isinstance(xla, dict) or not xla):
+        # A BENCH record MISSING the xla breakdown entirely is
+        # SKIP-not-pass: since the jit ledger exists (r06), every bench
+        # run embeds it, so its absence means the record cannot prove
+        # the no-compile-storm property at all — the overall verdict
+        # must be FAIL, not a quiet pass on throughput alone.
+        # (Pre-ledger BENCH_r01–r05 are HISTORY, never the fresh record
+        # — they are unaffected.)
+        lines.append(
+            "compile storm [SKIP-not-pass] fresh record embeds no `xla` "
+            "ledger breakdown at all — post-r06 BENCH records must embed "
+            "warmup/steady (re-run bench.py with metrics on); nothing "
+            "gated, NOT a pass"
+        )
+        return False, lines
     steady = (xla or {}).get("steady")
     if not isinstance(steady, dict) or not steady:
         # An EMPTY steady dict means the ledger measured nothing (bench
@@ -506,6 +527,64 @@ def check_forest(
     return ok, lines
 
 
+#: Noise band for the fused-vs-unfused kernel gate: "never slower" with a
+#: small measurement allowance so a same-speed kernel doesn't flap the CI.
+KERNELS_MIN_SPEEDUP = 0.97
+
+
+def check_kernels(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --kernels`` record (metric ``kernel_*``): the
+    fused Pallas path must be never-slower-than-unfused ON THE SAME
+    BACKEND (``speedup`` ≥ ~1 within the noise band), plus the standard
+    throughput-vs-history gate on the fused rows/s. Interpret-mode
+    records (CPU sandbox: the fused kernel runs the Pallas interpreter,
+    which measures nothing about the TPU kernel) take the dryrun
+    convention of the multichip/fleet gates: annotated "NOT a pass",
+    nothing gated, exit 0 — the environment, not the kernel, is what
+    can't be measured (unlike a BENCH record missing its xla breakdown,
+    which is a fixable omission and FAILS via ``require_xla``)."""
+    lines: List[str] = []
+    if fresh.get("mode") != "kernels":
+        return False, [
+            "record has no mode=kernels — not a bench.py --kernels record?"
+        ]
+    if bool(fresh.get("interpret")):
+        lines.append(
+            f"kernel fusion [SKIP] {fresh.get('kernel')}: fused path ran "
+            f"the Pallas interpreter on backend {fresh.get('backend')!r} "
+            "— fused-vs-unfused is unmeasurable off-TPU; nothing gated, "
+            "NOT a pass"
+        )
+        return True, lines
+    ok = True
+    speedup = fresh.get("speedup")
+    if speedup is None:
+        return False, ["kernels record has no speedup field"]
+    verdict = "OK" if float(speedup) >= KERNELS_MIN_SPEEDUP else "REGRESSION"
+    lines.append(
+        f"kernel fusion [{verdict}] {fresh.get('kernel')}: fused "
+        f"{fresh.get('value'):,.1f} vs unfused "
+        f"{fresh.get('unfused_rows_per_s'):,.1f} {fresh.get('unit')} "
+        f"(speedup {float(speedup):.3f}x; floor {KERNELS_MIN_SPEEDUP}x — "
+        "fused must never be slower than unfused on the same backend)"
+    )
+    if float(speedup) < KERNELS_MIN_SPEEDUP:
+        ok = False
+    t_ok, t_lines = check(
+        fresh,
+        [h for h in history
+         if h.get("mode") == "kernels"
+         and h.get("backend") == fresh.get("backend")
+         and not bool(h.get("interpret"))],
+        max_regression=max_regression,
+    )
+    return ok and t_ok, lines + t_lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.perfcheck",
@@ -570,14 +649,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     fleet = str(fresh.get("metric", "")).startswith("serve_fleet_")
     chaos = str(fresh.get("metric", "")).startswith("chaos_elastic_")
     forest = str(fresh.get("metric", "")).startswith("forest_")
+    kernels = str(fresh.get("metric", "")).startswith("kernel_")
     default_glob = (
-        "FOREST_r*.json" if forest
+        "KERNELS_r*.json" if kernels
+        else "FOREST_r*.json" if forest
         else "CHAOS_r*.json" if chaos
         else "FLEET_r*.json" if fleet
         else "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
     )
     history = load_history(args.history or [default_glob])
-    if forest:
+    if kernels:
+        ok, lines = check_kernels(
+            fresh, history, max_regression=args.max_regression,
+        )
+    elif forest:
         ok, lines = check_forest(
             fresh, history, max_regression=args.max_regression,
         )
@@ -599,6 +684,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             fresh, history,
             max_regression=args.max_regression,
             allow_compiles=tuple(args.allow_compile),
+            # Only the fit-bench family must embed the ledger; plain
+            # `bench.py --serve` records (serve_transform_qps_*) land in
+            # this default branch too and legitimately carry no `xla` —
+            # they keep the soft SKIP like the fleet/chaos families.
+            require_xla=not str(fresh.get("metric", "")).startswith("serve_"),
         )
     for line in lines:
         print(line)

@@ -342,6 +342,14 @@ class LedgeredJit:
         self._fun = fun
         self._jit = jax.jit(fun, **jit_kwargs)
         self._entry = LEDGER.entry(name)
+        #: AOT executables by signature (aot_prime): a hit dispatches the
+        #: held ``Compiled`` directly — no jit-cache lookup, and by
+        #: construction no compile. hits/misses are the serve plane's
+        #: per-instance compile ledger (a miss = a call at a shape nothing
+        #: primed, i.e. a potential lazy compile on the latency path).
+        self._aot: Dict[Any, Any] = {}
+        self.aot_hits = 0
+        self.aot_misses = 0
         # Static args are value-keyed in the signature (each value is its
         # own compiled program); everything else is keyed like the jit
         # cache (shape/dtype for arrays, type for scalars).
@@ -361,6 +369,99 @@ class LedgeredJit:
     # working through the wrapper.
     def lower(self, *args: Any, **kwargs: Any):
         return self._jit.lower(*args, **kwargs)
+
+    def _sig(self, args, kwargs) -> Any:
+        sig_args = (
+            "t",
+            tuple(
+                _sig_of(a, static=i in self._static_nums)
+                for i, a in enumerate(args)
+            ),
+        )
+        if not kwargs:
+            return sig_args
+        return (
+            sig_args,
+            (
+                "d",
+                tuple(
+                    (str(k), _sig_of(v, static=k in self._static_names))
+                    for k, v in sorted(kwargs.items())
+                ),
+            ),
+        )
+
+    def aot_prime(self, *args: Any, **kwargs: Any) -> bool:
+        """``lower().compile()`` this signature NOW and hold the executable
+        on the wrapper — the "compile the whole program once, then serve"
+        move (Flare / Julia-to-TPU, PAPERS.md 1703.08219, 1810.09868): a
+        later call at the same signature dispatches the held ``Compiled``
+        directly, so no first-request compile (and no jit dispatch-cache
+        trace) ever sits on the latency path. ``args`` may be
+        ``jax.ShapeDtypeStruct``s — nothing executes here. The compile is
+        attributed to this entry in the ledger (it happens at registration
+        time, where it belongs). Returns True when this signature was
+        freshly compiled, False when already primed."""
+        sig = self._sig(args, kwargs)
+        if sig in self._aot:
+            return False
+        entry = self._entry
+        # Record the signature so a later real call is not booked as a
+        # fresh cache miss (the program it would have traced exists) —
+        # and populate the cost analysis HERE, since that later call's
+        # new=False branch will skip it (AOT-served shapes must not read
+        # as flops/bytes-less in the roofline).
+        rec, new = entry.record(sig)
+        if new:
+            with entry.lock:
+                ana = entry.analysis.get(sig)
+            if ana is None:
+                ana = self._analyze(args, kwargs, _device_timing())
+                with entry.lock:
+                    entry.analysis[sig] = ana
+            with entry.lock:
+                rec.update(
+                    {k: v for k, v in ana.items() if not k.startswith("_")}
+                )
+        _ensure_listener()
+        prev = getattr(_tls, "current", None)
+        _tls.current = (entry, sig)
+        try:
+            exe = self._jit.lower(*args, **kwargs).compile()
+        finally:
+            _tls.current = prev
+        self._aot[sig] = exe
+        return True
+
+    def _dispatch(self, sig: Any, args, kwargs):
+        """Run one call: the primed AOT executable when this signature has
+        one, the jit otherwise. An executable that rejects the concrete
+        args (sharding/layout drift) degrades to the jit — never fails a
+        request the lazy path would have served — but COUNTS as a miss
+        (the dispatch was not AOT-served; a clean ledger must not read
+        "fully warm" while every request quietly takes the lazy path)
+        and logs once per wrapper."""
+        exe = self._aot.get(sig)
+        if exe is None:
+            if self._aot:
+                self.aot_misses += 1
+            return self._jit(*args, **kwargs)
+        try:
+            out = exe(*args, **kwargs)
+        except Exception as e:
+            self.aot_misses += 1
+            if not getattr(self, "_aot_fallback_logged", False):
+                self._aot_fallback_logged = True
+                from spark_rapids_ml_tpu.utils.logging import get_logger
+
+                get_logger("xprof").warning(
+                    "AOT executable for %r rejected its arguments "
+                    "(%s); degrading to the lazy jit — subsequent "
+                    "rejections count as AOT misses silently", self.name, e,
+                )
+            return self._jit(*args, **kwargs)
+        self.aot_hits += 1
+        return out
 
     def _analyze(self, args, kwargs, timed: bool) -> Dict[str, Any]:
         """Once per signature (cached on the entry across resets):
@@ -423,9 +524,12 @@ class LedgeredJit:
         return out
 
     def __call__(self, *args: Any, **kwargs: Any):
-        if not _enabled():
-            return self._jit(*args, **kwargs)
         import jax
+
+        if not _enabled():
+            if self._aot and jax.core.trace_state_clean():
+                return self._dispatch(self._sig(args, kwargs), args, kwargs)
+            return self._jit(*args, **kwargs)
 
         # Inside another trace (a ledgered jit calling a ledgered jit —
         # every pallas.* kernel under a streaming update), this call is
@@ -433,28 +537,13 @@ class LedgeredJit:
         # never again, while the outer entry's cost analysis already
         # includes this kernel's flops. Recording here would book a
         # phantom call (and phantom flops) per compile, so the ledger
-        # counts device dispatches from Python only — direct calls.
+        # counts device dispatches from Python only — direct calls. (An
+        # AOT executable is likewise uncallable under a trace.)
         if not jax.core.trace_state_clean():
             return self._jit(*args, **kwargs)
 
         entry = self._entry
-        sig_args = (
-            "t",
-            tuple(
-                _sig_of(a, static=i in self._static_nums)
-                for i, a in enumerate(args)
-            ),
-        )
-        sig = sig_args if not kwargs else (
-            sig_args,
-            (
-                "d",
-                tuple(
-                    (str(k), _sig_of(v, static=k in self._static_names))
-                    for k, v in sorted(kwargs.items())
-                ),
-            ),
-        )
+        sig = self._sig(args, kwargs)
         timing = _device_timing()
         rec, new = entry.record(sig)
         if new:
@@ -480,7 +569,7 @@ class LedgeredJit:
         _tls.current = (entry, sig)
         t0 = time.perf_counter()
         try:
-            out = self._jit(*args, **kwargs)
+            out = self._dispatch(sig, args, kwargs)
             if timing:
                 out = jax.block_until_ready(out)
         finally:

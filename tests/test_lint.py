@@ -276,6 +276,56 @@ def test_serve_config_keys_have_env_alias_and_docs():
     )
 
 
+def _paren_span(text: str, start: int, window: int = 600) -> str:
+    """The balanced-paren argument span of a call starting at ``start``
+    (bounded window keeps the lint fast; calls here are short)."""
+    span = text[start: start + window]
+    depth = 0
+    for i, ch in enumerate(span):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return span[: i + 1]
+    return span
+
+
+def test_every_pallas_kernel_has_interpret_golden():
+    """Every ``pallas.*`` ledger name in ops/pallas_kernels.py must have
+    an ``interpret=True`` golden somewhere in tests/ that compares the
+    kernel against the plain-jax path — a fused kernel without a
+    bitwise/tolerance oracle is untestable on CPU CI, so a kernel cannot
+    land (or be renamed) without its golden following."""
+    src = (PKG / "ops" / "pallas_kernels.py").read_text()
+    kernels = re.findall(
+        r'ledgered_jit[,(]\s*\n?\s*"pallas\.([a-z0-9_]+)"', src
+    )
+    assert len(kernels) >= 8, (
+        f"only {len(kernels)} pallas.* ledger registrations found — the "
+        "registration pattern or this regex regressed"
+    )
+    tests_dir = Path(__file__).resolve().parent
+    texts = [p.read_text() for p in sorted(tests_dir.glob("*.py"))]
+    missing = []
+    for fn in sorted(set(kernels)):
+        ok = False
+        for text in texts:
+            for m in re.finditer(rf"\b{fn}\s*\(", text):
+                if "interpret=True" in _paren_span(text, m.start()):
+                    ok = True
+                    break
+            if ok:
+                break
+        if not ok:
+            missing.append(fn)
+    assert missing == [], (
+        "pallas kernels without an interpret=True golden in tests/ "
+        "(add a parity test against the plain-jax oracle): "
+        + ", ".join(missing)
+    )
+
+
 def test_no_bare_print_in_package():
     """Library code must log through the package logger (or record
     metrics), never print — stdout belongs to the host application (and

@@ -1,5 +1,6 @@
 """Pallas kernel parity tests (interpret mode — no TPU needed)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -30,12 +31,62 @@ def test_gram_colsum_parity(rng):
     n, d = 1024, 256
     x = rng.normal(size=(n, d)).astype(np.float32)
     for n_valid in (n, 700):  # full batch + boundary-straddling partial block
-        g, cs = gram_colsum_pallas(x, n_valid, block_n=256, interpret=True)
+        g, cs, cnt = gram_colsum_pallas(x, n_valid, block_n=256, interpret=True)
         xv = x[:n_valid]
         np.testing.assert_allclose(np.asarray(g), xv.T @ xv, rtol=1e-5, atol=1e-2)
         np.testing.assert_allclose(
             np.asarray(cs), xv.sum(axis=0), rtol=1e-5, atol=1e-2
         )
+        assert float(cnt) == float(n_valid)
+
+
+@pytest.mark.kernels
+def test_gram_colsum_seeded_state(rng):
+    """The one-dispatch streaming update: accumulators SEEDED from the
+    donated (gram, colsum, count) state must equal state + batch stats —
+    the fusion that removes the per-batch XLA state add."""
+    from spark_rapids_ml_tpu.ops.pallas_kernels import gram_colsum_pallas
+
+    n, d = 512, 128
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    g0 = rng.normal(size=(d, d)).astype(np.float32)
+    cs0 = rng.normal(size=(d,)).astype(np.float32)
+    state = (jnp.asarray(g0), jnp.asarray(cs0), jnp.asarray(37.0, jnp.float32))
+    g, cs, cnt = gram_colsum_pallas(
+        x, 300, block_n=256, state=state, interpret=True
+    )
+    xv = x[:300]
+    np.testing.assert_allclose(np.asarray(g), g0 + xv.T @ xv, rtol=1e-5, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(cs), cs0 + xv.sum(0), rtol=1e-5, atol=1e-2)
+    assert float(cnt) == 37.0 + 300
+
+
+@pytest.mark.kernels
+def test_gram_colsum_bf16_vs_f32_tolerance(rng):
+    """bf16-input/f32-accumulate golden for the fused streaming kernel:
+    the intended TPU speed mode must stay within GEMM-rounding tolerance
+    of the f32 oracle on the SAME (bf16-rounded) data."""
+    from spark_rapids_ml_tpu.ops.pallas_kernels import gram_colsum_pallas
+
+    n, d = 512, 128
+    x16 = jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16)
+    x = np.asarray(x16, np.float32)  # the rounded values ARE the data
+    g, cs, cnt = gram_colsum_pallas(x16, 300, block_n=256, interpret=True)
+    xv = x[:300]
+    np.testing.assert_allclose(np.asarray(g), xv.T @ xv, rtol=2e-2, atol=5e-1)
+    np.testing.assert_allclose(np.asarray(cs), xv.sum(0), rtol=2e-2, atol=2e-1)
+    assert float(cnt) == 300.0
+    # PCA-components golden: the top-k eigenvectors of the bf16-kernel
+    # centered Gram must span the f64 oracle's subspace (sign-invariant
+    # |cos| per column — the PCASuite tolerance philosophy).
+    k = 4
+    n_v, mean = 300, xv.mean(0)
+    gc = np.asarray(g, np.float64) - n_v * np.outer(mean, mean)
+    ref = np.cov(xv.T.astype(np.float64))
+    w1, v1 = np.linalg.eigh(gc / (n_v - 1))
+    w2, v2 = np.linalg.eigh(ref)
+    dots = np.abs(np.sum(v1[:, ::-1][:, :k] * v2[:, ::-1][:, :k], axis=0))
+    assert np.all(dots > 1 - 5e-2), dots
 
 
 def test_gram_colsum_block_validation(rng):
@@ -71,6 +122,140 @@ def test_streaming_update_rows_matches_mask_path(rng):
         s_mask = upd_mask(s_mask, jnp.asarray(x), jnp.asarray(mask))
     for a, b in zip(s_rows, s_mask):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.kernels
+def test_dist_topk_parity(rng):
+    # Exact fused distance+top-k vs a lexsort oracle: true clipped
+    # distances, ascending order, (distance, id) tie-breaking on crafted
+    # duplicate rows, masked rows -> (+inf, -1), non-multiple-of-8 shapes.
+    from spark_rapids_ml_tpu.ops.pallas_kernels import dist_topk_pallas
+
+    q, m, d, k = 65, 300, 24, 7
+    qs = rng.normal(size=(q, d)).astype(np.float32)
+    db = rng.normal(size=(m, d)).astype(np.float32)
+    db[50] = db[201]  # duplicate rows straddling blocks: exact tie
+    mask = np.ones(m, np.float32)
+    mask[-17:] = 0.0
+    ids = np.arange(m, dtype=np.int32)
+    dk, ik = dist_topk_pallas(
+        jnp.asarray(qs), jnp.asarray(db), ids, mask, k,
+        block_m=64, block_q=32, interpret=True,
+    )
+    d2 = np.maximum(
+        (qs**2).sum(1)[:, None] + (db**2).sum(1)[None, :] - 2 * qs @ db.T, 0
+    )
+    d2[:, mask == 0] = np.inf
+    order = np.lexsort((np.broadcast_to(ids, d2.shape), d2), axis=1)[:, :k]
+    np.testing.assert_array_equal(
+        np.asarray(ik), np.take_along_axis(np.broadcast_to(ids, d2.shape), order, 1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dk), np.take_along_axis(d2, order, 1), rtol=1e-4, atol=1e-3
+    )
+    assert np.all(np.diff(np.asarray(dk), axis=1) >= 0)
+
+
+@pytest.mark.kernels
+def test_dist_topk_missing_slots(rng):
+    # Fewer valid rows than k: the tail must carry the documented
+    # (+inf, -1) missing contract, exactly like the XLA masked path.
+    from spark_rapids_ml_tpu.ops.pallas_kernels import dist_topk_pallas
+
+    qs = rng.normal(size=(8, 16)).astype(np.float32)
+    db = rng.normal(size=(10, 16)).astype(np.float32)
+    mask = np.zeros(10, np.float32)
+    mask[:4] = 1.0
+    dk, ik = dist_topk_pallas(
+        jnp.asarray(qs), jnp.asarray(db), np.arange(10, dtype=np.int32),
+        mask, 7, block_m=8, block_q=8, interpret=True,
+    )
+    assert np.all(np.asarray(ik)[:, 4:] == -1)
+    assert np.all(np.isinf(np.asarray(dk)[:, 4:]))
+    assert np.all(np.asarray(ik)[:, :4] >= 0)
+
+
+@pytest.mark.kernels
+@pytest.mark.parametrize("q", [1, 63, 64, 65])
+def test_dist_topk_bucket_boundary_dtype_ladder(rng, q):
+    """kneighbors-index goldens at the serve bucket ladder boundaries
+    (b=64: 1, b-1, b, b+1 — the PR 5 scheduler-test shape grid), per rung
+    of the compute_dtype ladder: at EACH dtype the fused kernel's indices
+    must equal the unfused sq_euclidean→top_k two-step's (same rounding,
+    same (distance, id) tie order), and bf16 distances must stay within
+    GEMM-rounding tolerance of the f32 ones. bf16-vs-f32 INDEX swaps at
+    near-ties are the documented precision trade, not a kernel bug."""
+    from spark_rapids_ml_tpu.ops.distances import sq_euclidean
+    from spark_rapids_ml_tpu.ops.pallas_kernels import dist_topk_pallas
+
+    m, d, k = 96, 32, 5
+    qs = rng.normal(size=(q, d)).astype(np.float32)
+    db = rng.normal(size=(m, d)).astype(np.float32)
+    ids = np.arange(m, dtype=np.int32)
+    mask = np.ones(m, np.float32)
+    by_dtype = {}
+    for dt in (jnp.float32, jnp.bfloat16):
+        qd, dbd = jnp.asarray(qs, dt), jnp.asarray(db, dt)
+        fd, fi = dist_topk_pallas(
+            qd, dbd, ids, mask, k, block_m=32, block_q=32, interpret=True
+        )
+        d2 = sq_euclidean(qd, dbd, accum_dtype=jnp.float32)
+        neg, pos = jax.lax.top_k(-d2, k)
+        np.testing.assert_array_equal(np.asarray(fi), np.asarray(pos))
+        np.testing.assert_allclose(
+            np.asarray(fd), np.maximum(-np.asarray(neg), 0), rtol=1e-5, atol=1e-4
+        )
+        by_dtype[np.dtype(dt).name] = np.asarray(fd)
+    np.testing.assert_allclose(
+        by_dtype["bfloat16"], by_dtype["float32"], rtol=5e-2, atol=0.5
+    )
+
+
+@pytest.mark.kernels
+def test_streaming_update_rows_seeded_kernel_matches_mask_path(rng):
+    """The donated one-dispatch streaming update (state seeded into the
+    kernel, single data device) must match the XLA mask path over several
+    accumulating batches — and the spy proves the seeded branch ran."""
+    import jax
+
+    from spark_rapids_ml_tpu.ops import gram as gram_ops
+    from spark_rapids_ml_tpu.ops import pallas_kernels as pk
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(data=1, model=1, devices=jax.devices()[:1])
+    m, d = 512, 128
+    x = rng.normal(size=(m, d)).astype(np.float32)
+    n_valid = m - 100
+    ran = {"seeded": False}
+    orig_ok = gram_ops._pallas_rows_applicable
+    orig_kernel = pk.gram_colsum_pallas
+
+    def spy(xx, nv, block_n=pk.GRAM_COLSUM_BLOCK_N, state=None,
+            interpret=False):
+        ran["seeded"] |= state is not None
+        return orig_kernel(xx, nv, block_n=block_n, state=state,
+                           interpret=True)
+
+    gram_ops._pallas_rows_applicable = lambda shape, cd, use_pallas=None: True
+    pk.gram_colsum_pallas = spy
+    try:
+        gram_ops._streaming_update_rows_cached.cache_clear()
+        upd = gram_ops._streaming_update_rows_cached(
+            mesh, "float32", "float32", True
+        )
+        s = gram_ops.init_stats(d, accum_dtype="float32")
+        for _ in range(3):
+            s = upd(s, jnp.asarray(x), n_valid)
+        s = [np.asarray(v) for v in s]
+    finally:
+        gram_ops._pallas_rows_applicable = orig_ok
+        pk.gram_colsum_pallas = orig_kernel
+        gram_ops._streaming_update_rows_cached.cache_clear()
+    assert ran["seeded"], "the seeded one-dispatch branch never ran"
+    xv = x[:n_valid]
+    np.testing.assert_allclose(s[0], 3.0 * n_valid)
+    np.testing.assert_allclose(s[1], 3 * xv.sum(0), rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(s[2], 3 * (xv.T @ xv), rtol=1e-5, atol=1e-2)
 
 
 def test_assign_parity(rng):

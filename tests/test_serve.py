@@ -549,3 +549,138 @@ def test_sample_rows_rejects_nonpositive_n(daemon, rng):
             c.sample_rows("sampz", 0)
         with pytest.raises(RuntimeError, match="positive"):
             c.sample_rows("sampz", -5)
+
+
+# ---------------------------------------------------------------------------
+# AOT at registration (docs/protocol.md "AOT at registration")
+# ---------------------------------------------------------------------------
+
+
+def test_aot_on_register_zero_compile_misses(mesh8, rng):
+    """The AOT acceptance claim: after ensure_model with AOT on, the
+    first client transform at EVERY reachable bucket reports zero
+    compile misses in the served instance's compile ledger (every
+    dispatch runs a held executable), and the registration ack's
+    warmup object carries aot: true."""
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.pca import PCA
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+    d, k = 24, 4
+    data = rng.standard_normal((256, d)).astype(np.float32)
+    arrays = PCA().setK(k).fit({"features": data})._model_data()
+    with config.option("serve_batching", True), \
+            config.option("serve_warmup_on_register", True), \
+            config.option("serve_aot", True):
+        with DataPlaneDaemon(mesh=mesh8) as daemon:
+            with DataPlaneClient(*daemon.address) as c:
+                c.ensure_model("aot-m", "pca", arrays)
+                served = daemon._models["aot-m"]
+                st = served.aot_status()
+                # Distinct device programs: sub-256 buckets collapse onto
+                # the 256-row floor shape run_bucketed dispatches.
+                want = len({max(256, b) for b in st["buckets"]})
+                assert st is not None and st["compiled"] == want, st
+                assert st["hits"] == 0 and st["misses"] == 0
+                solo = PCA().setK(k).fit({"features": data})
+                for bucket in st["buckets"]:
+                    q = rng.standard_normal((bucket, d)).astype(np.float32)
+                    out = c.transform("aot-m", q)["output"]
+                    ref = solo.transform({"features": q})
+                    np.testing.assert_allclose(
+                        out, np.asarray(ref["pca_features"], out.dtype),
+                        rtol=1e-5, atol=1e-6,
+                    )
+                st = served.aot_status()
+                assert st["misses"] == 0, st
+                assert st["hits"] >= len(st["buckets"]), st
+
+
+def test_aot_warmup_op_ack_field(mesh8, rng):
+    """The `warmup` op's ack gains the additive aot field: true when the
+    ladder was AOT-compiled, false on the trace fallback (serve_aot
+    off) — and a model without a plan degrades, never fails."""
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.pca import PCA
+    from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon
+
+    d = 16
+    arrays = PCA().setK(2).fit(
+        {"features": rng.standard_normal((64, d)).astype(np.float32)}
+    )._model_data()
+    with config.option("serve_batching", True):
+        with DataPlaneDaemon(mesh=mesh8) as daemon:
+            with DataPlaneClient(*daemon.address) as c:
+                c.ensure_model("m", "pca", arrays)
+                with config.option("serve_aot", True):
+                    info = c.warmup("m", n_cols=d, dtype="float32")
+                assert info["aot"] is True
+                with config.option("serve_aot", False):
+                    info = c.warmup("m", n_cols=d, dtype="float32")
+                assert info["aot"] is False
+
+
+def test_aot_primed_shapes_keep_cost_analysis(rng):
+    """aot_prime pre-records its signature so later calls aren't fresh
+    misses — but the ledger's cost analysis must still be populated for
+    primed shapes, or the roofline reads flops/bytes-less for exactly
+    the AOT-served hot entries."""
+    import jax
+
+    from spark_rapids_ml_tpu.utils.xprof import ledgered_jit, snapshot
+
+    @ledgered_jit("test_serve.aot_analysis")
+    def f(x):
+        return x @ x.T
+
+    assert f.aot_prime(
+        jax.ShapeDtypeStruct((64, 8), np.dtype("float32"))
+    ) is True
+    f(rng.normal(size=(64, 8)).astype(np.float32))
+    rec = snapshot()["test_serve.aot_analysis"]["signatures"][0]
+    assert rec["flops"] is not None
+    assert rec["bytes_accessed"] is not None
+
+
+def test_knn_aot_plan_pads_like_kneighbors(mesh8, rng):
+    """A sub-64 (or non-pow2) serve bucket must prime the shape the
+    query path actually dispatches — kneighbors pads queries to
+    max(64, next-pow2), not the raw scheduler bucket."""
+    import jax
+
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(data=1, model=1, devices=jax.devices()[:1])
+    db = rng.normal(size=(320, 16)).astype(np.float32)
+    m = NearestNeighbors(mesh=mesh).setK(5).fit({"features": db})
+    for bucket in (8, 32, 48):
+        ((jit_obj, args),) = m._serve_aot_plan(bucket, 16, dtype="float32")
+        assert args[-1].shape[0] == 64  # the real padded query shape
+        jit_obj.aot_prime(*args)
+    h0, m0 = jit_obj.aot_hits, jit_obj.aot_misses
+    m.kneighbors(rng.normal(size=(8, 16)).astype(np.float32))
+    m.kneighbors(rng.normal(size=(40, 16)).astype(np.float32))
+    assert jit_obj.aot_hits == h0 + 2
+    assert jit_obj.aot_misses == m0
+
+
+def test_aot_warmup_wrong_width_still_errors(mesh8, rng):
+    """A warmup with a wrong n_cols must keep erroring to the client
+    (the pre-AOT contract): the plan's width check raises, AOT degrades
+    to trace warmup, and the zero-batch dispatch surfaces the shape
+    mismatch — never a success ack that pre-marks a shape no real
+    traffic can produce."""
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    d = 16
+    arrays = PCA().setK(2).fit(
+        {"features": rng.standard_normal((64, d)).astype(np.float32)}
+    )._model_data()
+    with config.option("serve_batching", True):
+        with DataPlaneDaemon(mesh=mesh8) as daemon:
+            with _client(daemon) as c:
+                c.ensure_model("m", "pca", arrays)
+                with pytest.raises(RuntimeError):
+                    c.warmup("m", n_cols=d - 6, dtype="float32")

@@ -382,16 +382,22 @@ def test_perfcheck_skips_throughput_without_matching_history():
 
 
 def test_perfcheck_reads_the_repo_trajectory():
-    """The shipped BENCH_r*.json wrapper format parses, and its five
-    rounds agree with each other within the gate (the trajectory IS flat
-    — that is this PR's motivation)."""
+    """The shipped BENCH_r*.json wrapper format parses: the five flat
+    TPU rounds (r01–r05, one shared d2048_k32 metric — the flat line
+    that motivated the fusion PR) agree with each other within the
+    gate, and later rounds (r06+: sandbox shapes under their own
+    metrics) parse alongside without perturbing that trajectory."""
     import glob
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parent.parent
     history = perfcheck.load_history([str(root / "BENCH_r0*.json")])
-    assert len(history) == 5
-    values = [h["value"] for h in history]
+    assert len(history) >= 6  # r01–r05 TPU + r06 (first embedded-ledger round)
+    values = [
+        h["value"] for h in history
+        if h.get("metric") == "pca_fit_streaming_rows_per_sec_per_chip_d2048_k32"
+    ]
+    assert len(values) == 5
     ok, lines = perfcheck.check(
         _record(min(values)), history
     )
