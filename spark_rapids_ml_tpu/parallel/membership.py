@@ -76,7 +76,9 @@ class MeshMembership:
         snapshots disagree on it)."""
         with self._lock:
             members: List[Dict[str, str]] = []
-            for mid, m in self._members.items():
+            # sorted(): the members list reaches wire acks (mesh_info) —
+            # registration order varies per process and must not leak.
+            for mid, m in sorted(self._members.items()):
                 if m["handle"]() is not None:
                     members.append({"id": mid, "boot_id": m["boot_id"]})
             return {"epoch": self._epoch, "members": members}

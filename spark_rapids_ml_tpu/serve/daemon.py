@@ -188,7 +188,10 @@ _SHEDDABLE_OPS = (
 #: under the chaos/multidaemon suites). Every device-touching section
 #: (fold/step/merge/finalize/build/serve) takes this lock INNERMOST —
 #: after any job/model lock, never before one — so lock order stays
-#: acyclic.
+#: acyclic. This contract is machine-checked: srml-check's
+#: `device-lock`/`lock-order`/`compile-outside-lock` rules
+#: (tools/analyze.py, docs/static_analysis.md) fail tier-1 on a dispatch
+#: outside the lock, a lock acquired under it, or a compile inside it.
 _DEVICE_LOCK = threading.Lock()
 
 #: Every op _dispatch understands — the clamp for metric labels: a

@@ -37,7 +37,9 @@ Core pieces (docs/protocol.md "Serving scheduler"):
   ``serve_batch_window_ms`` or the coalesced rows reach
   ``serve_max_batch_rows``, dispatches ONCE under the model lock +
   ``_DEVICE_LOCK`` (via ``_ServedModel``), and scatters per-request row
-  slices back to the waiting connection threads.
+  slices back to the waiting connection threads. (The lock discipline
+  here is machine-checked by srml-check's lock rules —
+  docs/static_analysis.md.)
 * **Warmup** — :meth:`RequestScheduler.warmup` pre-compiles the bucket
   ladder for a served model (the additive ``warmup`` wire op), so
   first-request latency is predictable instead of hiding a compile.

@@ -1,6 +1,10 @@
 """Operator CLIs (``python -m spark_rapids_ml_tpu.tools.<name>``).
 
-These are deliberately thin shells over the wire ops any client can
-speak (``health`` / ``metrics``, docs/protocol.md) — the same numbers a
-real scrape pipeline would collect, rendered for a human terminal.
+``top`` and ``trace`` are deliberately thin shells over the wire ops any
+client can speak (``health`` / ``metrics``, docs/protocol.md) — the same
+numbers a real scrape pipeline would collect, rendered for a human
+terminal. ``perfcheck`` gates bench records against the BENCH_r*
+trajectory, and ``analyze`` (srml-check, docs/static_analysis.md) is the
+AST invariant analyzer for the lock/donation/determinism/wire contracts
+— both are CI gates first, CLIs second.
 """

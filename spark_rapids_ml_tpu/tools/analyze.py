@@ -1,0 +1,1873 @@
+"""srml-check: AST-based invariant analyzer for the package's contracts.
+
+The system's hardest guarantees — bitwise-equal reduce folds, single-filed
+device dispatch through ``_DEVICE_LOCK``, donated-buffer streaming state,
+the additive wire contract — were enforced by convention plus grep-shaped
+lints (tests/test_lint.py), and each regressed at least once before a
+human caught it in review. This module is the mechanical reviewer: it
+parses the whole package with ``ast``, resolves a lightweight per-function
+context (enclosing ``with`` locks, bound jit handles, call targets), and
+runs a registry of rules the regex gates cannot express (a string built by
+concatenation or f-string dodges a regex; it cannot dodge the AST).
+
+Rule catalog (docs/static_analysis.md has the full rationale):
+
+Lock discipline (the PR 13 "compile outside the lock" hardening class):
+  ``device-lock``          device-dispatching calls in serve/daemon.py /
+                           serve/scheduler.py must be lexically under
+                           ``with _DEVICE_LOCK``.
+  ``compile-outside-lock`` compile-path calls (``lower``/``compile``/
+                           ``aot_prime``/``cost_analysis``) must NOT hold
+                           the device lock — compiles are host work and
+                           stall serving traffic.
+  ``lock-order``           ``_DEVICE_LOCK`` is innermost by contract:
+                           acquiring any other lock under it, or inverting
+                           an ordering observed elsewhere, is a deadlock
+                           hazard.
+
+Donation (the donated streaming-state contract, ops/gram.py):
+  ``use-after-donate``     a name passed at a ``donate_argnums`` position
+                           of a ledgered jit is device-donated; reading it
+                           again before reassignment is a use-after-free.
+
+Determinism (the PR 7 unsorted-fold class):
+  ``unsorted-iter``        iterating an un-``sorted()`` dict/set in the
+                           bitwise-contract modules (ops/, models/,
+                           parallel/, daemon fold/merge paths).
+  ``wallclock-entropy``    ``time.time`` / ``random.*`` / unseeded
+                           ``np.random.*`` in the bitwise-contract modules.
+
+Wire contract (AST upgrade of the regex clamp gate):
+  ``wire-op-clamp``        every op string the daemon dispatches must be in
+                           ``_KNOWN_OPS`` and docs/protocol.md.
+  ``ack-contract``         ack-dict fields may only be added, never removed,
+                           versus the checked-in snapshot
+                           (tools/analyze_contract.json).
+
+Ported regex gates (the engine's first three rules; test_lint.py test
+names are preserved as thin invokers):
+  ``bare-print``           no ``print(`` in library code (tools/ and
+                           ``__main__`` tails exempt).
+  ``bare-collective``      no ``lax.psum``-family call outside parallel/.
+  ``socket-timeout``       every ``socket.create_connection`` passes an
+                           explicit timeout.
+
+Suppression: an inline ``# srml: disable=<rule>[,<rule>...]`` pragma on
+the finding's line suppresses it (add a justification comment); accepted
+legacy findings live in tools/analyze_baseline.json keyed by
+(rule, file, enclosing symbol, count) so they survive line drift. The
+tier-1 gate is therefore "zero NEW findings"; baseline entries that no
+longer match anything are reported as stale warnings so the baseline only
+ever shrinks.
+
+CLI::
+
+    python -m spark_rapids_ml_tpu.tools.analyze            # human output
+    python -m spark_rapids_ml_tpu.tools.analyze --json     # machine output
+    python -m spark_rapids_ml_tpu.tools.analyze --rule device-lock
+    python -m spark_rapids_ml_tpu.tools.analyze --write-baseline
+    python -m spark_rapids_ml_tpu.tools.analyze --write-contract
+
+Exit status: 0 = zero unsuppressed findings, 1 = findings, 2 = usage.
+This module imports only the standard library (no jax, no package
+imports), so it runs in milliseconds anywhere, CI included.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+PKG_ROOT = Path(__file__).resolve().parent.parent
+REPO_ROOT = PKG_ROOT.parent
+BASELINE_PATH = Path(__file__).resolve().parent / "analyze_baseline.json"
+CONTRACT_PATH = Path(__file__).resolve().parent / "analyze_contract.json"
+
+#: Modules whose device dispatch must single-file through _DEVICE_LOCK.
+DEVICE_MODULES = ("serve/daemon.py", "serve/scheduler.py")
+#: Directories under the bitwise-determinism contract (identical inputs
+#: must fold to identical bits on every host/process).
+BITWISE_DIRS = ("ops", "models", "parallel")
+#: Daemon/scheduler function-name fragments that put a function on the
+#: fold/merge path (the daemon's slice of the bitwise contract).
+FOLD_NAME_FRAGMENTS = ("merge", "fold", "reduce", "finalize", "commit", "step")
+
+_PRAGMA_RE = re.compile(r"#\s*srml:\s*disable=([a-z0-9_,\- ]+)")
+
+
+# ---------------------------------------------------------------------------
+# findings, pragmas, baseline
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: id, location, enclosing symbol, one-line why."""
+
+    rule: str
+    file: str
+    line: int
+    symbol: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message} (in {self.symbol})"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+
+def format_findings(findings: Sequence[Finding]) -> str:
+    return "\n".join(f.format() for f in findings)
+
+
+class Baseline:
+    """Accepted legacy findings, keyed (rule, file, symbol) with a count.
+
+    Keying by enclosing symbol instead of line number survives unrelated
+    edits above the finding; the count bounds how many findings of one
+    rule a symbol may carry, so NEW findings in an already-baselined
+    function still fail. ``stale()`` reports entries whose code is gone —
+    the baseline is a ratchet and must only ever shrink.
+    """
+
+    def __init__(self, entries: Optional[Sequence[Dict[str, Any]]] = None):
+        self.entries: Dict[Tuple[str, str, str], int] = {}
+        for e in entries or []:
+            key = (str(e["rule"]), str(e["file"]), str(e["symbol"]))
+            self.entries[key] = self.entries.get(key, 0) + int(e.get("count", 1))
+        self._matched: Dict[Tuple[str, str, str], int] = {}
+
+    @classmethod
+    def load(cls, path: Path = BASELINE_PATH) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        return cls(data.get("entries", []))
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+        b = cls()
+        for f in findings:
+            key = (f.rule, f.file, f.symbol)
+            b.entries[key] = b.entries.get(key, 0) + 1
+        return b
+
+    def as_json(self) -> str:
+        entries = [
+            {"rule": r, "file": fp, "symbol": s, "count": c}
+            for (r, fp, s), c in sorted(self.entries.items())
+        ]
+        return json.dumps({"version": 1, "entries": entries}, indent=2) + "\n"
+
+    def suppresses(self, f: Finding) -> bool:
+        key = (f.rule, f.file, f.symbol)
+        if self._matched.get(key, 0) < self.entries.get(key, 0):
+            self._matched[key] = self._matched.get(key, 0) + 1
+            return True
+        return False
+
+    def stale(self) -> List[str]:
+        """Entries (or counts) that matched nothing in the last run."""
+        out = []
+        for key, cap in sorted(self.entries.items()):
+            used = self._matched.get(key, 0)
+            if used < cap:
+                rule, fp, sym = key
+                out.append(
+                    f"stale baseline entry: {rule} in {fp} ({sym}) — "
+                    f"{cap - used} of {cap} accepted finding(s) no longer "
+                    "exist; shrink tools/analyze_baseline.json"
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module model
+# ---------------------------------------------------------------------------
+
+
+class Module:
+    """One parsed source file plus the lazy per-line pragma map."""
+
+    def __init__(self, relpath: str, source: str, display_path: Optional[str] = None):
+        self.relpath = relpath.replace("\\", "/")
+        self.source = source
+        self.display_path = display_path or self.relpath
+        self.tree = ast.parse(source, filename=self.relpath)
+        self.lines = source.split("\n")
+        self._pragmas: Optional[Dict[int, Set[str]]] = None
+        # Parent links let rules walk ancestors (loop/guard detection).
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._srml_parent = parent  # type: ignore[attr-defined]
+
+    @property
+    def pragmas(self) -> Dict[int, Set[str]]:
+        if self._pragmas is None:
+            self._pragmas = {}
+            for i, line in enumerate(self.lines, start=1):
+                m = _PRAGMA_RE.search(line)
+                if m:
+                    rules = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                    self._pragmas[i] = rules
+        return self._pragmas
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.pragmas.get(line)
+        return rules is not None and (rule in rules or "all" in rules)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = getattr(node, "_srml_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_srml_parent", None)
+
+    def enclosing_symbol(self, node: ast.AST) -> str:
+        parts = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(anc.name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(expr: ast.AST) -> Optional[str]:
+    """The last identifier of a call target: ``x`` for ``a.b.x`` or ``x``."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def const_str(expr: ast.AST) -> Optional[str]:
+    """Constant-fold an expression to a string where statically possible —
+    plain constants, ``"a" + "b"`` concatenation, and constant-only
+    f-strings — so wire-op strings cannot dodge the clamp by being built
+    instead of written (the hole the old regex gate had)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left, right = const_str(expr.left), const_str(expr.right)
+        if left is not None and right is not None:
+            return left + right
+    if isinstance(expr, ast.JoinedStr):
+        parts = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                inner = const_str(v.value)
+                if inner is None:
+                    return None
+                parts.append(inner)
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+_LOCKISH_RE = re.compile(r"(_lock$|_LOCK$|^lock$|^_cv$|_cond$)")
+
+
+def lock_name(expr: ast.AST) -> Optional[str]:
+    """Normalized lock identity of a ``with`` context expression, or None
+    when it does not look like a lock. ``self._models_lock`` →
+    ``_models_lock``; ``_DEVICE_LOCK`` → ``_DEVICE_LOCK``."""
+    name = terminal_name(expr)
+    if name is not None and _LOCKISH_RE.search(name):
+        return name
+    return None
+
+
+def in_main_guard(mod: Module, node: ast.AST) -> bool:
+    """True when the node sits under ``if __name__ == "__main__":``."""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.If):
+            for sub in ast.walk(anc.test):
+                if isinstance(sub, ast.Name) and sub.id == "__name__":
+                    return True
+    return False
+
+
+def iter_functions(mod: Module) -> Iterator[ast.AST]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def held_locks(mod: Module, node: ast.AST) -> List[str]:
+    """Locks lexically held at ``node``, outermost first (item order of a
+    multi-item ``with A, B:`` preserved) — the resolved ``with``-stack
+    WITHIN the node's own function. The walk stops at the first function
+    boundary: a closure defined under ``with _DEVICE_LOCK`` runs later,
+    when the lock is long released, so an enclosing function's ``with``
+    must not read as held inside the closure."""
+    withs: List[ast.With] = []
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            break
+        if isinstance(anc, ast.With):
+            withs.append(anc)
+    stack: List[str] = []
+    for w in reversed(withs):  # outermost with first, items left-to-right
+        for item in w.items:
+            ln = lock_name(item.context_expr)
+            if ln is not None:
+                stack.append(ln)
+    return stack
+
+
+def node_pos(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+def node_end(node: ast.AST) -> Tuple[int, int]:
+    return (
+        getattr(node, "end_lineno", getattr(node, "lineno", 0)),
+        getattr(node, "end_col_offset", getattr(node, "col_offset", 0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# jit-handle registry (cross-module semantic context)
+# ---------------------------------------------------------------------------
+
+
+def _ledgered_jit_donate(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums of a ``ledgered_jit(...)`` / ``functools.partial(
+    ledgered_jit, ...)`` expression, () when present without donation,
+    None when the call is not a ledgered_jit registration at all."""
+    fn = terminal_name(call.func)
+    args = call.args
+    if fn == "partial" and args and terminal_name(args[0]) == "ledgered_jit":
+        pass
+    elif fn == "ledgered_jit":
+        pass
+    else:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            positions: List[int] = []
+            val = kw.value
+            elts = val.elts if isinstance(val, (ast.Tuple, ast.List)) else [val]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    positions.append(e.value)
+            return tuple(positions)
+    return ()
+
+
+def _pkg_module_relpath(dotted: str, known: Set[str]) -> Optional[str]:
+    """``spark_rapids_ml_tpu.ops.gram`` (or ``ops.gram``) → the project
+    relpath ``ops/gram.py`` when that module is in the analyzed set."""
+    parts = dotted.split(".")
+    for start in range(len(parts)):
+        rel = "/".join(parts[start:]) + ".py"
+        if rel in known:
+            return rel
+    return None
+
+
+@dataclass
+class JitRegistry:
+    """Package-wide view of where jit handles come from.
+
+    ``module_handles``: per-module map of MODULE-LEVEL names that ARE a
+                   ledgered jit (name → donated arg positions, possibly
+                   empty). Scoped per module: the decorated inner ``def
+                   update`` every streaming factory carries must not make
+                   every ``update`` in the package look like a dispatch.
+    ``factories``: functions that RETURN a ledgered jit handle (name →
+                   donated positions of the handle they return) — e.g.
+                   ``gram.streaming_update(mesh)`` or kmeans'
+                   ``_stream_step_fn``. Resolved to a fixpoint so a
+                   factory that delegates to another factory (the
+                   lru_cache split: ``_stream_softmax_stats_fn`` →
+                   ``_stream_softmax_stats_cached``) is still a factory.
+                   A call to a factory is host work; a call to what it
+                   returned is a device dispatch.
+    """
+
+    module_handles: Dict[str, Dict[str, Tuple[int, ...]]] = field(
+        default_factory=dict
+    )
+    factories: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    #: every handle name at any scope — only for resolving `return <name>`
+    #: inside factory detection, never for call-site matching.
+    _any_scope: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, modules: Sequence[Module]) -> "JitRegistry":
+        reg = cls()
+        #: (factory-candidate def, its own return values), for the fixpoint.
+        candidates: List[Tuple[Module, ast.AST, List[ast.AST]]] = []
+        for mod in modules:
+            mh = reg.module_handles.setdefault(mod.relpath, {})
+            for node in ast.walk(mod.tree):
+                # name = ledgered_jit("x", f, donate_argnums=...)
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    don = _ledgered_jit_donate(node.value)
+                    if don is not None:
+                        for t in node.targets:
+                            tn = terminal_name(t)
+                            if tn:
+                                reg._any_scope[tn] = don
+                                if _enclosing_function(mod, node) is None:
+                                    mh[tn] = don
+                # @functools.partial(ledgered_jit, "x", donate_argnums=...)
+                # def update(...): ...   /   @ledgered_jit("x")
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if isinstance(dec, ast.Call):
+                            don = _ledgered_jit_donate(dec)
+                            if don is not None:
+                                reg._any_scope[node.name] = don
+                                if _enclosing_function(mod, node) is None:
+                                    mh[node.name] = don
+                    returns = [
+                        ret.value
+                        for ret in ast.walk(node)
+                        if isinstance(ret, ast.Return)
+                        and ret.value is not None
+                        and _enclosing_function(mod, ret) is node
+                    ]
+                    if returns:
+                        candidates.append((mod, node, returns))
+        # Factory fixpoint: direct ledgered_jit returns, returns of a known
+        # handle name, and returns of a call to an already-known factory.
+        changed = True
+        while changed:
+            changed = False
+            for mod, node, returns in candidates:
+                if node.name in reg.factories:
+                    continue
+                for val in returns:
+                    don: Optional[Tuple[int, ...]] = None
+                    if isinstance(val, ast.Call):
+                        don = _ledgered_jit_donate(val)
+                        if don is None:
+                            fn = terminal_name(val.func)
+                            if fn in reg.factories:
+                                don = reg.factories[fn]
+                    else:
+                        rn = terminal_name(val)
+                        if rn is not None and rn in reg._any_scope:
+                            don = reg._any_scope[rn]
+                    if don is not None:
+                        reg.factories[node.name] = don
+                        changed = True
+                        break
+        return reg
+
+    def bound_handles(
+        self, mod: Module
+    ) -> Dict[str, List[Tuple[Optional[ast.AST], Tuple[int, ...]]]]:
+        """Dotted names in ``mod`` bound from a factory call or a handle:
+        ``self.update = gram_ops.streaming_update(mesh)`` binds
+        ``self.update`` as a dispatch handle donating position 0. Bare
+        names carry their binding function as a visibility scope (a local
+        ``update = _stream_step_fn(...)`` must not make a sibling
+        function's unrelated ``update`` look like a dispatch); attribute
+        bindings (``self.update``) cross methods and stay module-wide."""
+        bound: Dict[str, List[Tuple[Optional[ast.AST], Tuple[int, ...]]]] = {}
+        own = self.module_handles.get(mod.relpath, {})
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            don: Optional[Tuple[int, ...]] = None
+            if isinstance(value, ast.Call):
+                fn = terminal_name(value.func)
+                if fn in self.factories:
+                    don = self.factories[fn]
+            else:
+                vn = terminal_name(value)
+                if vn in own:
+                    don = own[vn]
+            if don is None:
+                continue
+            for t in node.targets:
+                dn = dotted_name(t)
+                if dn:
+                    scope = (
+                        None if "." in dn else _enclosing_function(mod, node)
+                    )
+                    bound.setdefault(dn, []).append((scope, don))
+        return bound
+
+    def imported_handles(self, mod: Module, known_mods: Set[str]) -> Dict[str, Tuple[int, ...]]:
+        """Module-level handles visible in ``mod`` through imports:
+        ``from ...models.kmeans import apply_lloyd_update`` (direct name)
+        and ``from ... import gram as gram_ops`` + ``gram_ops.<handle>``
+        (the dotted spelling is resolved at the call site)."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                src = _pkg_module_relpath(node.module, known_mods)
+                if src is None:
+                    continue
+                src_handles = self.module_handles.get(src, {})
+                for alias in node.names:
+                    if alias.name in src_handles:
+                        out[alias.asname or alias.name] = src_handles[alias.name]
+        return out
+
+    def module_aliases(self, mod: Module, known_mods: Set[str]) -> Dict[str, str]:
+        """Import aliases that name whole analyzed modules:
+        ``from spark_rapids_ml_tpu.ops import gram as gram_ops`` →
+        ``{"gram_ops": "ops/gram.py"}``."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    src = _pkg_module_relpath(
+                        f"{node.module}.{alias.name}", known_mods
+                    )
+                    if src is not None:
+                        out[alias.asname or alias.name] = src
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    src = _pkg_module_relpath(alias.name, known_mods)
+                    if src is not None:
+                        out[alias.asname or alias.name.split(".")[-1]] = src
+        return out
+
+
+def _enclosing_function(mod: Module, node: ast.AST) -> Optional[ast.AST]:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, "Rule"] = {}
+
+
+@dataclass
+class Rule:
+    id: str
+    summary: str
+    check: Callable[["Project"], List[Finding]]
+
+
+def rule(rule_id: str, summary: str):
+    def deco(fn: Callable[["Project"], List[Finding]]) -> Callable:
+        RULES[rule_id] = Rule(rule_id, summary, fn)
+        return fn
+
+    return deco
+
+
+class Project:
+    """The analyzed file set plus its cross-module context.
+
+    ``files`` maps package-relative posix paths (``serve/daemon.py``) to
+    source text, so tests can assemble synthetic projects; ``from_package``
+    loads the real tree. ``protocol_doc``/``contract`` feed the wire rules
+    and are optional for fixtures. ``strict_floors`` arms the self-check
+    floors (minimum dispatched-op counts etc.) that only make sense
+    against the real package.
+    """
+
+    def __init__(
+        self,
+        files: Dict[str, str],
+        protocol_doc: Optional[str] = None,
+        contract: Optional[Dict[str, Any]] = None,
+        strict_floors: bool = False,
+        display_prefix: str = "",
+    ):
+        self.modules: List[Module] = []
+        for rel in sorted(files):
+            self.modules.append(
+                Module(rel, files[rel], display_path=display_prefix + rel)
+            )
+        self.protocol_doc = protocol_doc
+        self.contract = contract
+        self.strict_floors = strict_floors
+        self.registry = JitRegistry.build(self.modules)
+        self._known_mods = {m.relpath for m in self.modules}
+        self._jit_views: Dict[str, "ModuleJitView"] = {}
+        #: report scope: when set (package-relative paths/prefixes), only
+        #: findings in matching files are reported — analysis itself is
+        #: always whole-program.
+        self.report_filter: Optional[List[str]] = None
+        #: non-fatal remarks (stale baseline entries land here too)
+        self.notes: List[str] = []
+
+    def jit_view(self, mod: Module) -> "ModuleJitView":
+        view = self._jit_views.get(mod.relpath)
+        if view is None:
+            view = ModuleJitView(
+                mod=mod,
+                own=self.registry.module_handles.get(mod.relpath, {}),
+                bound=self.registry.bound_handles(mod),
+                imported=self.registry.imported_handles(mod, self._known_mods),
+                aliases=self.registry.module_aliases(mod, self._known_mods),
+                registry=self.registry,
+            )
+            self._jit_views[mod.relpath] = view
+        return view
+
+    @staticmethod
+    def package_files(pkg_root: Path = PKG_ROOT) -> Dict[str, str]:
+        """The real package's sources keyed by relpath — the raw material
+        for from_package and for tests that seed a deliberate violation
+        into a scratch copy of one module."""
+        files: Dict[str, str] = {}
+        for p in sorted(pkg_root.rglob("*.py")):
+            rel = p.relative_to(pkg_root).as_posix()
+            if "__pycache__" in rel:
+                continue
+            files[rel] = p.read_text()
+        return files
+
+    @classmethod
+    def from_package(
+        cls,
+        pkg_root: Path = PKG_ROOT,
+        contract_path: Path = CONTRACT_PATH,
+        paths: Optional[Sequence[str]] = None,
+    ) -> "Project":
+        """The real tree. ``paths`` restricts which files findings are
+        REPORTED for — the whole package is still parsed, because the
+        rules are whole-program (the jit-factory registry in models//ops/
+        is what keeps a serve/-only run from false-positive-flagging
+        factory calls)."""
+        files = cls.package_files(pkg_root)
+        doc_path = pkg_root.parent / "docs" / "protocol.md"
+        protocol_doc = doc_path.read_text() if doc_path.exists() else None
+        contract = None
+        if contract_path.exists():
+            contract = json.loads(contract_path.read_text())
+        project = cls(
+            files,
+            protocol_doc=protocol_doc,
+            contract=contract,
+            strict_floors=True,
+            display_prefix=pkg_root.name + "/",
+        )
+        if paths:
+            project.report_filter = list(paths)
+        return project
+
+    # -- scoping -----------------------------------------------------------
+
+    def device_modules(self) -> List[Module]:
+        return [m for m in self.modules if m.relpath in DEVICE_MODULES]
+
+    def bitwise_scope(self, mod: Module, node: ast.AST) -> bool:
+        """Whether ``node`` is under the bitwise-determinism contract:
+        anywhere in ops//models//parallel/, or on a daemon/scheduler
+        fold/merge path (function name carries a fold fragment)."""
+        top = mod.relpath.split("/", 1)[0]
+        if top in BITWISE_DIRS:
+            return True
+        if mod.relpath in DEVICE_MODULES:
+            for anc in [node, *mod.ancestors(node)]:
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    name = anc.name.lower()
+                    if any(f in name for f in FOLD_NAME_FRAGMENTS):
+                        return True
+        return False
+
+    # -- running -----------------------------------------------------------
+
+    def run_raw(self, rules: Optional[Sequence[str]] = None) -> List[Finding]:
+        """All findings before pragma/baseline suppression."""
+        selected = sorted(set(rules)) if rules else sorted(RULES)
+        unknown = [r for r in selected if r not in RULES]
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+        # Notes are per-run state (rules append as they check): reset so
+        # a Project reused across runs reports only this run's notes.
+        self.notes = []
+        out: List[Finding] = []
+        for rid in selected:
+            out.extend(RULES[rid].check(self))
+        if self.report_filter is not None:
+            out = [f for f in out if self.in_report_scope(f.file)]
+        out.sort(key=lambda f: (f.file, f.line, f.rule))
+        return out
+
+    def in_report_scope(self, display_path: str) -> bool:
+        if self.report_filter is None:
+            return True
+        rel = display_path
+        for m in self.modules:
+            if m.display_path == display_path:
+                rel = m.relpath
+                break
+        return any(
+            rel == q or rel.startswith(q.rstrip("/") + "/")
+            for q in self.report_filter
+        )
+
+    def run(
+        self,
+        rules: Optional[Sequence[str]] = None,
+        baseline: Optional[Baseline] = None,
+    ) -> List[Finding]:
+        """Findings after inline pragmas and the baseline; stale-baseline
+        warnings land in ``self.notes``."""
+        raw = self.run_raw(rules)
+        if baseline is not None:
+            # A Baseline is reusable across runs: matched counts are
+            # per-run state, reset here so a second run suppresses again.
+            baseline._matched = {}
+        by_display = {m.display_path: m for m in self.modules}
+        kept: List[Finding] = []
+        for f in raw:
+            mod = by_display.get(f.file)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            if baseline is not None and baseline.suppresses(f):
+                continue
+            kept.append(f)
+        if baseline is not None:
+            self.notes.extend(baseline.stale())
+        return kept
+
+    def finding(
+        self, mod: Module, node: ast.AST, rule_id: str, message: str
+    ) -> Finding:
+        return Finding(
+            rule=rule_id,
+            file=mod.display_path,
+            line=getattr(node, "lineno", 1),
+            symbol=mod.enclosing_symbol(node),
+            message=message,
+        )
+
+
+# ---------------------------------------------------------------------------
+# rule family 1: lock discipline
+# ---------------------------------------------------------------------------
+
+#: Call targets that always touch the device (dispatch or transfer).
+_DEVICE_CALL_NAMES = frozenset(
+    ("block_until_ready", "device_get", "device_put")
+)
+#: Compile-path call targets: host work that must not hold _DEVICE_LOCK.
+_COMPILE_CALL_NAMES = frozenset(
+    ("lower", "compile", "aot_prime", "cost_analysis")
+)
+
+
+@dataclass
+class ModuleJitView:
+    """Per-module resolution context for jit-handle call sites."""
+
+    mod: Module
+    own: Dict[str, Tuple[int, ...]]
+    bound: Dict[str, List[Tuple[Optional[ast.AST], Tuple[int, ...]]]]
+    imported: Dict[str, Tuple[int, ...]]
+    aliases: Dict[str, str]
+    registry: JitRegistry
+
+    def resolve_call(self, call: ast.Call) -> Optional[Tuple[Tuple[int, ...], str]]:
+        """(donated positions, why) when this call dispatches a ledgered
+        jit handle, else None."""
+        dn = dotted_name(call.func)
+        if dn is not None and dn in self.bound:
+            enclosing: List[ast.AST] = []
+            fn = _enclosing_function(self.mod, call)
+            while fn is not None:
+                enclosing.append(fn)
+                fn = _enclosing_function(self.mod, fn)
+            for scope, don in self.bound[dn]:
+                if scope is None or scope in enclosing:
+                    return don, f"{dn} is bound from a jit factory"
+        name = terminal_name(call.func)
+        if name is None:
+            return None
+        if isinstance(call.func, ast.Name):
+            if name in self.own:
+                return self.own[name], f"{name} is a ledgered-jit entry"
+            if name in self.imported:
+                return self.imported[name], f"{name} is an imported ledgered-jit entry"
+        elif isinstance(call.func, ast.Attribute):
+            base = terminal_name(call.func.value)
+            src = self.aliases.get(base or "")
+            if src is not None:
+                handles = self.registry.module_handles.get(src, {})
+                if name in handles:
+                    return handles[name], (
+                        f"{base}.{name} is a ledgered-jit entry of {src}"
+                    )
+        return None
+
+
+def _in_locked_helper(mod: Module, node: ast.AST) -> bool:
+    """Whether the node sits in a ``*_locked``-suffixed function — the
+    package convention for "the caller already holds the lock" (e.g.
+    ``_Job._finalize_locked`` runs under finalize()'s _DEVICE_LOCK)."""
+    fn = _enclosing_function(mod, node)
+    while fn is not None:
+        if fn.name.endswith("_locked"):
+            return True
+        fn = _enclosing_function(mod, fn)
+    return False
+
+
+def _is_dispatch_call(
+    project: Project, mod: Module, call: ast.Call, view: ModuleJitView
+) -> Optional[str]:
+    """Why this call is a device dispatch, or None. The semantic model:
+    ledgered-jit handles (direct, imported, or factory-bound), ``*_fn``
+    jit handles, and the jax device/transfer entry points."""
+    name = terminal_name(call.func)
+    if name is None:
+        return None
+    if name in _DEVICE_CALL_NAMES:
+        return f"jax.{name} touches the device"
+    resolved = view.resolve_call(call)
+    if resolved is not None:
+        return resolved[1] + " (dispatches a device program)"
+    if (
+        name.endswith("_fn")
+        and name not in project.registry.factories
+        and not name.startswith(("init_", "plan_", "make_", "build_"))
+    ):
+        return f"{name} looks like a jit handle (*_fn convention)"
+    return None
+
+
+@rule(
+    "device-lock",
+    "device-dispatching calls in serve/daemon.py and serve/scheduler.py "
+    "must run lexically under `with _DEVICE_LOCK` (and `*_locked` helpers "
+    "must be called with a lock held)",
+)
+def _check_device_lock(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.device_modules():
+        view = project.jit_view(mod)
+        # *_locked helpers whose bodies DISPATCH: their call sites need
+        # _DEVICE_LOCK specifically, not just some lock — a model lock
+        # alone must not smuggle a device dispatch past the gate.
+        dispatching_helpers: Set[str] = set()
+        for fn_node in iter_functions(mod):
+            if not fn_node.name.endswith("_locked"):
+                continue
+            for sub in ast.walk(fn_node):
+                if isinstance(sub, ast.Call) and _is_dispatch_call(
+                    project, mod, sub, view
+                ):
+                    dispatching_helpers.add(fn_node.name)
+                    break
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            # The *_locked convention, checked from the caller's side: a
+            # helper that documents "caller holds the lock" in its name
+            # must see the lock lexically held at its call site — the
+            # DEVICE lock when the helper dispatches, any lock otherwise
+            # — unless the caller is itself a *_locked helper (legal
+            # delegation: ITS caller holds the lock).
+            if name is not None and name.endswith("_locked"):
+                if _in_locked_helper(mod, node):
+                    continue
+                held = held_locks(mod, node)
+                if name in dispatching_helpers and "_DEVICE_LOCK" not in held:
+                    out.append(
+                        project.finding(
+                            mod,
+                            node,
+                            "device-lock",
+                            f"call to {name}() without _DEVICE_LOCK held — "
+                            "the helper dispatches to the device, and its "
+                            "_locked suffix makes THIS call site "
+                            "responsible for the lock",
+                        )
+                    )
+                elif not held:
+                    out.append(
+                        project.finding(
+                            mod,
+                            node,
+                            "device-lock",
+                            f"call to {name}() with no lock held — the "
+                            "_locked suffix documents a caller-holds-the-"
+                            "lock contract",
+                        )
+                    )
+                continue
+            why = _is_dispatch_call(project, mod, node, view)
+            if why is None:
+                continue
+            if "_DEVICE_LOCK" in held_locks(mod, node):
+                continue
+            if _in_locked_helper(mod, node):
+                continue  # caller holds the lock (checked at its call site)
+            out.append(
+                project.finding(
+                    mod,
+                    node,
+                    "device-lock",
+                    f"device dispatch outside _DEVICE_LOCK: {why}; concurrent "
+                    "sharded dispatches can deadlock the backend "
+                    "(daemon threading contract)",
+                )
+            )
+    return out
+
+
+@rule(
+    "compile-outside-lock",
+    "compile-path calls (lower/compile/aot_prime/cost_analysis) must NOT "
+    "hold _DEVICE_LOCK — compiles are host work and would stall serving",
+)
+def _check_compile_outside_lock(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.device_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name not in _COMPILE_CALL_NAMES:
+                continue
+            if "_DEVICE_LOCK" not in held_locks(mod, node):
+                continue
+            out.append(
+                project.finding(
+                    mod,
+                    node,
+                    "compile-outside-lock",
+                    f"compile-path call .{name}() under _DEVICE_LOCK: compiles "
+                    "are pure host work — holding the device lock through one "
+                    "stalls every live dispatch for seconds (PR 13 hardening)",
+                )
+            )
+    return out
+
+
+@rule(
+    "lock-order",
+    "_DEVICE_LOCK is innermost by contract; acquiring another lock under "
+    "it — or inverting a lock ordering observed elsewhere — risks deadlock",
+)
+def _check_lock_order(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    # (outer, inner) → first observing (module, node); lock identities are
+    # scoped per module so unrelated `self.lock`s never alias.
+    pairs: Dict[Tuple[str, str], Tuple[Module, ast.AST]] = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            inner_names = [
+                lock_name(item.context_expr)
+                for item in node.items
+                if lock_name(item.context_expr) is not None
+            ]
+            if not inner_names:
+                continue
+            enclosing = held_locks(mod, node)
+            for i, inner in enumerate(inner_names):
+                # `with A, B:` acquires B while holding A — earlier items
+                # of the same statement are part of the held stack.
+                outer_stack = enclosing + inner_names[:i]
+                for outer in outer_stack:
+                    if outer == inner:
+                        continue
+                    if outer == "_DEVICE_LOCK":
+                        out.append(
+                            project.finding(
+                                mod,
+                                node,
+                                "lock-order",
+                                f"acquires {inner} while holding _DEVICE_LOCK; "
+                                "_DEVICE_LOCK is the INNERMOST lock by contract "
+                                "(after any job/model lock, never before one)",
+                            )
+                        )
+                        continue
+                    key = (f"{mod.relpath}:{outer}", f"{mod.relpath}:{inner}")
+                    pairs.setdefault(key, (mod, node))
+    for (outer, inner), (mod, node) in sorted(pairs.items()):
+        if (inner, outer) in pairs:
+            out.append(
+                project.finding(
+                    mod,
+                    node,
+                    "lock-order",
+                    f"lock-order inversion: {outer.split(':')[1]} → "
+                    f"{inner.split(':')[1]} here, but the opposite order is "
+                    "also taken in this file — an interleaving of the two "
+                    "call paths deadlocks",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule family 2: use-after-donate
+# ---------------------------------------------------------------------------
+
+
+def _donated_arg_names(call: ast.Call, positions: Tuple[int, ...]) -> List[str]:
+    names = []
+    for p in positions:
+        if p < len(call.args):
+            dn = dotted_name(call.args[p])
+            if dn is not None:
+                names.append(dn)
+    return names
+
+
+def _accesses(fn_node: ast.AST, dotted: str) -> List[Tuple[Tuple[int, int], str]]:
+    """All ordered (position, "load"|"store") accesses to ``dotted`` in
+    the function — plain names and ``self.x``-style attributes."""
+    acc: List[Tuple[Tuple[int, int], str]] = []
+    for node in ast.walk(fn_node):
+        dn = None
+        ctx = None
+        if isinstance(node, ast.Name):
+            dn, ctx = node.id, node.ctx
+        elif isinstance(node, ast.Attribute):
+            dn, ctx = dotted_name(node), node.ctx
+        if dn != dotted or ctx is None:
+            continue
+        kind = "store" if isinstance(ctx, (ast.Store, ast.Del)) else "load"
+        acc.append((node_pos(node), kind))
+    acc.sort()
+    return acc
+
+
+def _enclosing_stmt(mod: Module, node: ast.AST) -> ast.stmt:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.stmt):
+            return anc
+    return node  # pragma: no cover - a Call always sits in a statement
+
+
+def _accesses_after_call(
+    mod: Module, fn_node: ast.AST, call: ast.Call, dotted: str
+) -> List[Tuple[Tuple[int, int], str]]:
+    """Accesses to ``dotted`` that can execute AFTER the donating call,
+    in execution order: the tail of the call's own statement, then the
+    following-sibling statements of each enclosing block up to the
+    function. Mutually exclusive branches (the ``else`` arm of the
+    ``if`` the call sits in) are NOT after the call — a read there can
+    never see the donated buffer dead."""
+    end = node_end(call)
+    stmt = _enclosing_stmt(mod, call)
+    acc = [a for a in _accesses(stmt, dotted) if a[0] > end]
+
+    def scan(stmts) -> None:
+        for later in stmts:
+            if isinstance(later, ast.stmt):
+                acc.extend(_accesses(later, dotted))
+
+    node: ast.AST = stmt
+    while node is not fn_node:
+        parent = getattr(node, "_srml_parent", None)
+        if parent is None:
+            break
+        for fieldname, value in ast.iter_fields(parent):
+            if isinstance(value, list) and node in value:
+                scan(value[value.index(node) + 1:])
+                # Try semantics: handlers/else/finally execute after the
+                # try body; finally executes after handlers and else too.
+                if isinstance(parent, ast.Try):
+                    if fieldname == "body":
+                        for h in parent.handlers:
+                            scan(h.body)
+                        scan(parent.orelse)
+                        scan(parent.finalbody)
+                    elif fieldname in ("orelse",):
+                        scan(parent.finalbody)
+                elif isinstance(parent, (ast.For, ast.While, ast.AsyncFor)):
+                    if fieldname == "body":
+                        scan(parent.orelse)
+        if isinstance(parent, ast.ExceptHandler):
+            grand = getattr(parent, "_srml_parent", None)
+            if isinstance(grand, ast.Try):
+                scan(grand.finalbody)
+        if parent is fn_node:
+            break
+        node = parent
+    acc.sort()
+    return acc
+
+
+def _assign_target_names(target: ast.AST) -> Iterator[Optional[str]]:
+    """Dotted names bound by one assignment target, unpacking tuples/
+    lists/starred elements (``state, n = ...``)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assign_target_names(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _assign_target_names(target.value)
+    else:
+        yield dotted_name(target)
+
+
+def _healed_by_own_statement(mod: Module, call: ast.Call, donated: str) -> bool:
+    """``state = update(state, ...)`` — or the tuple-unpack shape
+    ``state, n = update(state, ...)`` — heals the donation in the very
+    statement that made it: the canonical streaming-fold shapes."""
+    stmt = _enclosing_stmt(mod, call)
+    if isinstance(stmt, ast.Assign):
+        return any(
+            name == donated
+            for t in stmt.targets
+            for name in _assign_target_names(t)
+        )
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return dotted_name(stmt.target) == donated
+    return False
+
+
+@rule(
+    "use-after-donate",
+    "a name passed at a donate_argnums position of a ledgered jit is "
+    "device-donated; reading it again before reassignment is a "
+    "use-after-free of the donated buffer",
+)
+def _check_use_after_donate(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules:
+        view = project.jit_view(mod)
+        for fn_node in iter_functions(mod):
+            for node in ast.walk(fn_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # One visit per call: nested defs are walked separately.
+                if _enclosing_function(mod, node) is not fn_node:
+                    continue
+                resolved = view.resolve_call(node)
+                if resolved is None or not resolved[0]:
+                    continue
+                positions = resolved[0]
+                name = terminal_name(node.func)
+                for donated in _donated_arg_names(node, positions):
+                    if _healed_by_own_statement(mod, node, donated):
+                        continue
+                    later = _accesses_after_call(mod, fn_node, node, donated)
+                    if later and later[0][1] == "load":
+                        out.append(
+                            project.finding(
+                                mod,
+                                node,
+                                "use-after-donate",
+                                f"{donated} is donated to {name}() "
+                                f"(donate_argnums) but read again at line "
+                                f"{later[0][0][0]} before reassignment — the "
+                                "buffer no longer exists after the dispatch",
+                            )
+                        )
+                        continue
+                    # Loop-carried reuse: a donating call inside a loop
+                    # whose body never rebinds the donated name re-reads
+                    # the dead buffer on the next iteration.
+                    loop = None
+                    for anc in mod.ancestors(node):
+                        if isinstance(anc, (ast.For, ast.While)):
+                            loop = anc
+                            break
+                        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            break
+                    if loop is not None:
+                        stores = [
+                            pos
+                            for pos, kind in _accesses(loop, donated)
+                            if kind == "store"
+                        ]
+                        if not stores:
+                            out.append(
+                                project.finding(
+                                    mod,
+                                    node,
+                                    "use-after-donate",
+                                    f"{donated} is donated to {name}() inside "
+                                    "a loop that never rebinds it — the next "
+                                    "iteration reads the donated buffer",
+                                )
+                            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule family 3: determinism
+# ---------------------------------------------------------------------------
+
+_DICT_ITER_METHODS = frozenset(("items", "keys", "values"))
+
+
+def _is_local_literal_dict(mod: Module, loop_node: ast.AST, name: str) -> bool:
+    """Whether ``name`` is assigned a dict literal in the same function
+    before the loop — its iteration order is then fixed by construction
+    (identical on every process), not by runtime insertion history."""
+    fn = _enclosing_function(mod, loop_node)
+    if fn is None:
+        return False
+    loop_line = getattr(loop_node, "lineno", 0)
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and getattr(node, "lineno", 0) <= loop_line
+            and isinstance(node.value, ast.Dict)
+            and any(
+                isinstance(t, ast.Name) and t.id == name for t in node.targets
+            )
+        ):
+            return True
+    return False
+
+
+def _is_keyed_rebuild(node: ast.AST, gen: "ast.comprehension") -> bool:
+    """``{k: f(v) for k, v in d.items()}`` — a key-addressed dict→dict
+    rebuild, not a fold: the result is consumed by key, and any later
+    ORDERED iteration of it gets its own finding at that site."""
+    if not isinstance(node, ast.DictComp):
+        return False
+    tgt = gen.target
+    if isinstance(tgt, ast.Tuple) and tgt.elts and isinstance(tgt.elts[0], ast.Name):
+        return (
+            isinstance(node.key, ast.Name) and node.key.id == tgt.elts[0].id
+        )
+    return False
+
+
+@rule(
+    "unsorted-iter",
+    "iterating an un-sorted() dict/set in the bitwise-contract modules "
+    "(ops/, models/, parallel/, daemon fold/merge paths) makes fold order "
+    "process-dependent — the PR 7 unsorted-fold class",
+)
+def _check_unsorted_iter(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules:
+        iters: List[Tuple[ast.AST, ast.AST, Optional[ast.comprehension]]] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append((node, node.iter, None))
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    iters.append((node, gen.iter, gen))
+        for node, it, gen in iters:
+            if not project.bitwise_scope(mod, node):
+                continue
+            what = None
+            if isinstance(it, ast.Call):
+                fn = it.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _DICT_ITER_METHODS
+                    and not it.args
+                ):
+                    what = f".{fn.attr}()"
+                    base = fn.value
+                    if isinstance(base, ast.Name) and _is_local_literal_dict(
+                        mod, node, base.id
+                    ):
+                        continue  # literal-ordered by construction
+                elif isinstance(fn, ast.Name) and fn.id == "set":
+                    what = "set(...)"
+            elif isinstance(it, ast.Set):
+                what = "a set literal"
+            if what is None:
+                continue
+            if gen is not None and _is_keyed_rebuild(node, gen):
+                continue
+            out.append(
+                project.finding(
+                    mod,
+                    node,
+                    "unsorted-iter",
+                    f"iterates {what} without sorted() on a bitwise-contract "
+                    "path — insertion/hash order varies across processes, so "
+                    "the fold is not reproducible; wrap the iterable in "
+                    "sorted()",
+                )
+            )
+    return out
+
+
+_SEEDED_RNG_CTORS = frozenset(
+    ("default_rng", "Generator", "RandomState", "SeedSequence", "PRNGKey", "key")
+)
+
+
+@rule(
+    "wallclock-entropy",
+    "time.time / random.* / unseeded np.random.* in the bitwise-contract "
+    "modules injects wall-clock or global-RNG entropy into paths that must "
+    "be bitwise-reproducible",
+)
+def _check_wallclock_entropy(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            if not project.bitwise_scope(mod, node):
+                continue
+            parts = dn.split(".")
+            bad = None
+            if dn == "time.time":
+                bad = "time.time() is wall-clock entropy"
+            elif parts[0] == "random" and len(parts) > 1:
+                bad = f"{dn}() draws from the global stdlib RNG"
+            elif (
+                len(parts) >= 3
+                and parts[-2] == "random"
+                and parts[0] in ("np", "numpy")
+                and parts[-1] not in _SEEDED_RNG_CTORS
+            ):
+                bad = f"{dn}() draws from the global numpy RNG"
+            if bad is None:
+                continue
+            out.append(
+                project.finding(
+                    mod,
+                    node,
+                    "wallclock-entropy",
+                    f"{bad} on a bitwise-contract path; thread a seeded "
+                    "np.random.default_rng(seed) (or jax.random key) through "
+                    "instead",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule family 4: wire contract
+# ---------------------------------------------------------------------------
+
+
+def collect_dispatched_ops(mod: Module) -> Dict[str, int]:
+    """op strings the daemon dispatches on: ``op == "x"`` comparisons and
+    ``op in ("x", "y")`` membership tests against a name ending in "op",
+    with constant folding so concatenation/f-strings can't dodge."""
+    ops: Dict[str, int] = {}
+
+    def is_op_name(e: ast.AST) -> bool:
+        tn = terminal_name(e)
+        return tn is not None and (tn == "op" or tn.endswith("_op"))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        operands = [node.left, *node.comparators]
+        if not any(is_op_name(o) for o in operands):
+            continue
+        for o, cmp_op in zip(operands[1:], node.ops):
+            if isinstance(cmp_op, (ast.Eq, ast.NotEq)):
+                s = const_str(o)
+                if s is None and is_op_name(o):
+                    s = const_str(node.left)
+                if s is not None:
+                    ops.setdefault(s, node.lineno)
+            elif isinstance(cmp_op, (ast.In, ast.NotIn)) and isinstance(
+                o, (ast.Tuple, ast.List, ast.Set)
+            ):
+                for elt in o.elts:
+                    s = const_str(elt)
+                    if s is not None:
+                        ops.setdefault(s, node.lineno)
+    return ops
+
+
+def collect_known_ops(mod: Module) -> Optional[Set[str]]:
+    """The ``_KNOWN_OPS = frozenset((...))`` clamp literal, AST-parsed."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(terminal_name(t) == "_KNOWN_OPS" for t in node.targets):
+            continue
+        known: Set[str] = set()
+        for sub in ast.walk(node.value):
+            s = const_str(sub)
+            if s is not None:
+                known.add(s)
+        return known
+    return None
+
+
+@rule(
+    "wire-op-clamp",
+    "every op string the daemon dispatches must appear in _KNOWN_OPS (the "
+    "metrics-label clamp) and docs/protocol.md (the frozen wire contract)",
+)
+def _check_wire_op_clamp(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    daemons = [m for m in project.modules if m.relpath == "serve/daemon.py"]
+    for mod in daemons:
+        dispatched = collect_dispatched_ops(mod)
+        known = collect_known_ops(mod)
+        if project.strict_floors and len(dispatched) < 15:
+            out.append(
+                Finding(
+                    "wire-op-clamp",
+                    mod.display_path,
+                    1,
+                    "<module>",
+                    f"only {len(dispatched)} dispatched ops found — the "
+                    "dispatch shape or the op collector regressed",
+                )
+            )
+        if known is None:
+            out.append(
+                Finding(
+                    "wire-op-clamp",
+                    mod.display_path,
+                    1,
+                    "<module>",
+                    "_KNOWN_OPS frozenset literal not found in serve/daemon.py",
+                )
+            )
+            continue
+        for op, line in sorted(dispatched.items()):
+            if op not in known:
+                out.append(
+                    Finding(
+                        "wire-op-clamp",
+                        mod.display_path,
+                        line,
+                        "<module>",
+                        f'op "{op}" is dispatched but missing from the '
+                        "_KNOWN_OPS metrics-label clamp (its telemetry would "
+                        'record under op="unknown")',
+                    )
+                )
+            if project.protocol_doc is not None and not re.search(
+                rf"\b{re.escape(op)}\b", project.protocol_doc
+            ):
+                out.append(
+                    Finding(
+                        "wire-op-clamp",
+                        mod.display_path,
+                        line,
+                        "<module>",
+                        f'op "{op}" is dispatched but absent from '
+                        "docs/protocol.md (the frozen wire contract)",
+                    )
+                )
+    return out
+
+
+def collect_ack_fields(mod: Module) -> Set[str]:
+    """Constant ack-dict field names the daemon answers with: keys of the
+    dict passed to ``send_json`` (arg 1) / ``_send_arrays_counted``
+    (arg 3) — inline literals AND acks built in a local variable first
+    (its dict-literal assignment and ``payload["k"] = ...`` grows in the
+    same function are resolved) — plus ``**helper()`` expansions resolved
+    one level into same-module helper returns. Subscript stores on
+    UNRELATED dicts in the same function are deliberately not counted:
+    over-collection would mask a removed ack field behind any
+    identically-named key (the gate must err toward reporting)."""
+    # def name → constant keys of returned dict literals (for ** resolution)
+    returns: Dict[str, Set[str]] = {}
+    for fn_node in iter_functions(mod):
+        keys: Set[str] = set()
+        for ret in ast.walk(fn_node):
+            if isinstance(ret, ast.Return) and isinstance(ret.value, ast.Dict):
+                for k in ret.value.keys:
+                    s = const_str(k) if k is not None else None
+                    if s is not None:
+                        keys.add(s)
+        if keys:
+            returns.setdefault(fn_node.name, set()).update(keys)
+
+    fields: Set[str] = set()
+
+    def scrape_dict(d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if k is None:  # ** expansion
+                if isinstance(v, ast.Call):
+                    helper = terminal_name(v.func)
+                    fields.update(returns.get(helper, set()))
+                continue
+            s = const_str(k)
+            if s is not None:
+                fields.add(s)
+
+    def scrape_ack_arg(arg: ast.AST, sender: Optional[ast.AST]) -> None:
+        if isinstance(arg, ast.Dict):
+            scrape_dict(arg)
+            return
+        if not isinstance(arg, ast.Name) or sender is None:
+            return
+        # Ack built in a local first: scrape its dict-literal assignment
+        # and every constant subscript-store on THAT name.
+        for node in ast.walk(sender):
+            if isinstance(node, ast.Assign):
+                if (
+                    any(
+                        isinstance(t, ast.Name) and t.id == arg.id
+                        for t in node.targets
+                    )
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    scrape_dict(node.value)
+                elif (
+                    len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == arg.id
+                ):
+                    s = const_str(node.targets[0].slice)
+                    if s is not None:
+                        fields.add(s)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        if name == "send_json" and len(node.args) >= 2:
+            scrape_ack_arg(node.args[1], _enclosing_function(mod, node))
+        elif name == "_send_arrays_counted" and len(node.args) >= 4:
+            scrape_ack_arg(node.args[3], _enclosing_function(mod, node))
+    return fields
+
+
+@rule(
+    "ack-contract",
+    "ack-dict fields are an additive wire contract: a field in the "
+    "checked-in snapshot (tools/analyze_contract.json) may never disappear "
+    "from the daemon's answers",
+)
+def _check_ack_contract(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    if project.contract is None:
+        return out
+    want = set(project.contract.get("ack_fields", []))
+    daemons = [m for m in project.modules if m.relpath == "serve/daemon.py"]
+    if not daemons:
+        return out
+    have: Set[str] = set()
+    for mod in daemons:
+        have |= collect_ack_fields(mod)
+    for fieldname in sorted(want - have):
+        out.append(
+            Finding(
+                "ack-contract",
+                daemons[0].display_path,
+                1,
+                "<module>",
+                f'ack field "{fieldname}" is in the wire-contract snapshot '
+                "but no longer answered by the daemon — ack fields may only "
+                "be ADDED (clients key on them); restore it or version the "
+                "protocol",
+            )
+        )
+    new = sorted(have - want)
+    if new:
+        project.notes.append(
+            "new ack field(s) not yet in tools/analyze_contract.json "
+            f"(additive, allowed): {', '.join(new)} — run "
+            "`python -m spark_rapids_ml_tpu.tools.analyze --write-contract`"
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ported regex gates (the engine's first rules)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "bare-print",
+    "library code logs through the package logger, never print() — stdout "
+    "belongs to the host application (and Spark's worker protocol); "
+    "tools/ and `if __name__ == '__main__'` tails are exempt",
+)
+def _check_bare_print(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules:
+        if mod.relpath.split("/", 1)[0] == "tools":
+            continue
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                if in_main_guard(mod, node):
+                    continue
+                out.append(
+                    project.finding(
+                        mod,
+                        node,
+                        "bare-print",
+                        "bare print() in library code — use the package "
+                        "logger (utils/logging.py) or record a metric",
+                    )
+                )
+    return out
+
+
+_COLLECTIVES = frozenset(
+    ("psum", "pmean", "all_gather", "ppermute", "psum_scatter", "all_to_all")
+)
+
+
+@rule(
+    "bare-collective",
+    "device collectives go through parallel/mapreduce.py — a bare "
+    "lax.psum/all_gather outside parallel/ bypasses the collective-trace "
+    "booking that audits ICI/DCN movement (docs/mesh.md)",
+)
+def _check_bare_collective(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules:
+        if mod.relpath.split("/", 1)[0] == "parallel":
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _COLLECTIVES
+                and terminal_name(fn.value) == "lax"
+            ):
+                out.append(
+                    project.finding(
+                        mod,
+                        node,
+                        "bare-collective",
+                        f"bare collective lax.{fn.attr}() outside parallel/ "
+                        "— route it through parallel.mapreduce so the "
+                        "collective-trace accounting sees it",
+                    )
+                )
+    return out
+
+
+@rule(
+    "socket-timeout",
+    "socket.create_connection without an explicit timeout inherits the "
+    "global default (None = block forever); one unreachable daemon would "
+    "hang its caller instead of failing into the retry/healing path",
+)
+def _check_socket_timeout(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "socket.create_connection" and not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "create_connection"
+                and terminal_name(node.func.value) == "socket"
+            ):
+                continue
+            has_timeout = len(node.args) >= 2 or any(
+                kw.arg == "timeout" or kw.arg is None for kw in node.keywords
+            )
+            if not has_timeout:
+                out.append(
+                    project.finding(
+                        mod,
+                        node,
+                        "socket-timeout",
+                        "socket.create_connection without an explicit "
+                        "timeout= — the default (None) blocks forever on an "
+                        "unreachable peer",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def rewrite_baseline(
+    project: Project,
+    old: Optional[Baseline],
+    new_findings: Sequence[Finding],
+    selected_rules: Optional[Sequence[str]] = None,
+) -> Baseline:
+    """The --write-baseline merge: this run's new findings become
+    accepted, still-live accepted entries keep their MATCHED counts
+    (stale ones fall off — the ratchet), and entries a restricted run
+    never evaluated (``--rule`` not selecting them, or a path filter
+    excluding their file) are preserved verbatim — a partial run must
+    not silently un-accept what it did not look at."""
+    merged = Baseline.from_findings(new_findings)
+    if old is None:
+        return merged
+    selected = set(selected_rules) if selected_rules else None
+    known_files = {m.display_path for m in project.modules}
+    for key, cap in old.entries.items():
+        rule_id, file_, _sym = key
+        if (
+            (selected is not None and rule_id not in selected)
+            or file_ not in known_files
+            or not project.in_report_scope(file_)
+        ):
+            merged.entries[key] = merged.entries.get(key, 0) + cap
+        else:
+            used = old._matched.get(key, 0)
+            if used:
+                merged.entries[key] = merged.entries.get(key, 0) + used
+    return merged
+
+
+def write_contract(project: Project, path: Path = CONTRACT_PATH) -> Dict[str, Any]:
+    fields: Set[str] = set()
+    for mod in project.modules:
+        if mod.relpath == "serve/daemon.py":
+            fields |= collect_ack_fields(mod)
+    contract = {"version": 1, "ack_fields": sorted(fields)}
+    path.write_text(json.dumps(contract, indent=2) + "\n")
+    return contract
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m spark_rapids_ml_tpu.tools.analyze",
+        description="srml-check: AST invariant analyzer for the "
+        "lock/donation/determinism/wire contracts",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="package-relative paths to restrict REPORTING to (e.g. "
+        "'serve' or 'ops/gram.py'); the whole package is always parsed "
+        "for cross-module context. Default: report everything",
+    )
+    parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE",
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=BASELINE_PATH,
+        help="baseline JSON path (default: tools/analyze_baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept the current unsuppressed findings into the baseline",
+    )
+    parser.add_argument(
+        "--write-contract",
+        action="store_true",
+        help="refresh the ack-field wire-contract snapshot",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid:22s} {RULES[rid].summary}")
+        return 0
+
+    try:
+        project = Project.from_package(paths=args.paths or None)
+    except SyntaxError as e:
+        print(f"srml-check: cannot parse {e.filename}:{e.lineno}: {e.msg}", file=sys.stderr)
+        return 2
+
+    if args.write_contract:
+        contract = write_contract(project)
+        print(
+            f"wrote {CONTRACT_PATH} ({len(contract['ack_fields'])} ack fields)"
+        )
+        project.contract = contract
+
+    baseline = None if args.no_baseline else Baseline.load(args.baseline)
+    try:
+        findings = project.run(rules=args.rules, baseline=baseline)
+    except KeyError as e:
+        print(f"srml-check: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # run() already consumed the old baseline, so `findings` are
+        # exactly the NEW ones; rewrite_baseline keeps still-live accepted
+        # entries (and preserves what a --rule/path-restricted run never
+        # evaluated), dropping only the stale.
+        merged = rewrite_baseline(project, baseline, findings, args.rules)
+        args.baseline.write_text(merged.as_json())
+        print(f"wrote {args.baseline} ({sum(merged.entries.values())} accepted findings)")
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in findings],
+                    "notes": project.notes,
+                    "rules": sorted(args.rules or RULES),
+                    "ok": not findings,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format())
+        for note in project.notes:
+            print(f"note: {note}", file=sys.stderr)
+        if not findings:
+            n = len(args.rules) if args.rules else len(RULES)
+            print(
+                f"srml-check: OK — {len(project.modules)} files, {n} rules, "
+                "zero unsuppressed findings"
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

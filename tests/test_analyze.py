@@ -1,0 +1,793 @@
+"""srml-check engine tests (spark_rapids_ml_tpu/tools/analyze.py).
+
+Three layers, mirroring the analyzer's contract (docs/static_analysis.md):
+
+1. Per-rule FIXTURES — for every rule, a positive snippet that must flag
+   and a negative twin that must not. The fixtures are tiny synthetic
+   projects (dict of relpath → source), so each rule's semantic model
+   (lock stacks, jit-handle resolution, constant folding) is pinned
+   independently of the real tree.
+2. SUPPRESSION — inline ``# srml: disable=`` pragmas, the baseline
+   round-trip (finding → baselined → code removed → stale-entry warning),
+   and the seeded-violation gate: a deliberate device dispatch outside
+   ``_DEVICE_LOCK`` spliced into a scratch copy of daemon.py must be
+   caught.
+3. The WHOLE-PACKAGE run — the tier-1 gate: zero unsuppressed findings
+   over the real tree, plus the ``--json`` CLI contract.
+
+No jax import anywhere in this file: the analyzer is stdlib-only and
+must stay runnable before the environment can even build a device.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from spark_rapids_ml_tpu.tools import analyze
+from spark_rapids_ml_tpu.tools.analyze import Baseline, Project
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Minimal ops module defining a donating streaming factory — gives the
+#: fixtures a realistic jit registry (the daemon fixtures bind from it).
+GRAM_FIXTURE = '''
+import functools
+from spark_rapids_ml_tpu.utils.xprof import ledgered_jit
+
+def streaming_update(mesh):
+    @functools.partial(ledgered_jit, "gram.streaming_update", donate_argnums=(0,))
+    def update(state, x, mask):
+        return state
+    return update
+'''
+
+
+def run_rules(files, *rules, **kw):
+    project = Project(files=dict(files), **kw)
+    return project, project.run_raw(rules=list(rules))
+
+
+_PKG_PROJECT = []
+
+
+def pkg_project() -> Project:
+    """One parsed real-tree Project shared by the whole-package tests —
+    runs are stateless (matched counts and notes reset per run), so the
+    read+parse+registry cost is paid once per session."""
+    if not _PKG_PROJECT:
+        _PKG_PROJECT.append(Project.from_package())
+    return _PKG_PROJECT[0]
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# family 1: lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _daemon(src: str) -> dict:
+    return {"ops/gram.py": GRAM_FIXTURE, "serve/daemon.py": src}
+
+
+def test_device_lock_flags_dispatch_outside_lock():
+    _, found = run_rules(_daemon('''
+import threading
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+_DEVICE_LOCK = threading.Lock()
+
+class Job:
+    def __init__(self, mesh):
+        self.update = streaming_update(mesh)
+    def fold(self, state, xs, ms):
+        state = self.update(state, xs, ms)
+        return state
+'''), "device-lock")
+    assert rule_ids(found) == ["device-lock"]
+    assert "self.update" in found[0].message
+
+
+def test_device_lock_passes_dispatch_under_lock():
+    _, found = run_rules(_daemon('''
+import threading
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+_DEVICE_LOCK = threading.Lock()
+
+class Job:
+    def __init__(self, mesh):
+        self.update = streaming_update(mesh)
+    def fold(self, state, xs, ms):
+        with _DEVICE_LOCK:
+            state = self.update(state, xs, ms)
+        return state
+'''), "device-lock")
+    assert found == []
+
+
+def test_device_lock_flags_block_until_ready_and_fn_handles():
+    _, found = run_rules(_daemon('''
+import jax
+
+def wait(out):
+    return jax.block_until_ready(out)
+
+def serve(q, _exact_knn_fn):
+    return _exact_knn_fn(q)
+'''), "device-lock")
+    assert rule_ids(found) == ["device-lock", "device-lock"]
+
+
+def test_device_lock_locked_helper_convention():
+    # Inside a *_locked helper the caller holds the lock — exempt; but a
+    # CALL site of a *_locked helper carries the obligation: a helper
+    # that DISPATCHES needs _DEVICE_LOCK there specifically (a model
+    # lock alone must not smuggle a dispatch past the gate), and any
+    # *_locked helper needs at least some lock.
+    src = '''
+import threading
+import jax
+_DEVICE_LOCK = threading.Lock()
+
+class Job:
+    lock = threading.Lock()
+    def _finalize_locked(self):
+        return jax.device_get(self.state)
+    def _prune_locked(self):
+        self.stale = None
+    def finalize(self):
+        with self.lock:
+            with _DEVICE_LOCK:
+                return self._finalize_locked()
+    def model_lock_only(self):
+        with self.lock:
+            return self._finalize_locked()
+    def broken(self):
+        return self._finalize_locked()
+    def prune(self):
+        with self.lock:
+            self._prune_locked()
+'''
+    _, found = run_rules(_daemon(src), "device-lock")
+    assert [(f.symbol, "without _DEVICE_LOCK" in f.message) for f in found] == [
+        ("Job.model_lock_only", True),
+        ("Job.broken", True),
+    ]
+
+
+def test_device_lock_allows_locked_to_locked_delegation():
+    # A *_locked helper delegating to another *_locked helper is the
+    # convention working as designed: the OUTER caller holds the lock.
+    _, found = run_rules(_daemon('''
+class Job:
+    def _cleanup_locked(self):
+        pass
+    def _finalize_locked(self):
+        return self._cleanup_locked()
+'''), "device-lock")
+    assert found == []
+
+
+def test_compile_outside_lock_twins():
+    bad = _daemon('''
+import threading
+_DEVICE_LOCK = threading.Lock()
+
+def warm(jit_obj, args):
+    with _DEVICE_LOCK:
+        jit_obj.aot_prime(*args)
+''')
+    good = _daemon('''
+import threading
+_DEVICE_LOCK = threading.Lock()
+
+def warm(jit_obj, args):
+    jit_obj.aot_prime(*args)
+''')
+    _, found = run_rules(bad, "compile-outside-lock")
+    assert rule_ids(found) == ["compile-outside-lock"]
+    _, found = run_rules(good, "compile-outside-lock")
+    assert found == []
+
+
+def test_lock_order_flags_acquisition_under_device_lock():
+    _, found = run_rules(_daemon('''
+import threading
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    _models_lock = threading.Lock()
+    def bad(self):
+        with _DEVICE_LOCK:
+            with self._models_lock:
+                pass
+    def good(self):
+        with self._models_lock:
+            with _DEVICE_LOCK:
+                pass
+'''), "lock-order")
+    assert len(found) == 1
+    assert found[0].symbol == "D.bad"
+
+
+def test_lock_order_flags_observed_inversion():
+    _, found = run_rules({"serve/fleet.py": '''
+import threading
+
+class F:
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+'''}, "lock-order")
+    assert rule_ids(found) == ["lock-order", "lock-order"]
+    assert "inversion" in found[0].message
+
+
+def test_lock_order_sees_multi_item_with():
+    # `with A, B:` acquires B while holding A — the single-statement
+    # spelling must flag exactly like the nested one.
+    _, found = run_rules(_daemon('''
+import threading
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    _models_lock = threading.Lock()
+    def bad(self):
+        with _DEVICE_LOCK, self._models_lock:
+            pass
+'''), "lock-order")
+    assert len(found) == 1
+    assert "_models_lock" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# family 2: use-after-donate
+# ---------------------------------------------------------------------------
+
+
+def test_use_after_donate_flags_read_after_donation():
+    _, found = run_rules({
+        "ops/gram.py": GRAM_FIXTURE,
+        "models/pca.py": '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, batches, state):
+    update = streaming_update(mesh)
+    out = update(state, batches[0], None)
+    return state, out  # state was donated: this read is a use-after-free
+''',
+    }, "use-after-donate")
+    assert rule_ids(found) == ["use-after-donate"]
+    assert "state" in found[0].message
+
+
+def test_use_after_donate_passes_rebinding_fold():
+    _, found = run_rules({
+        "ops/gram.py": GRAM_FIXTURE,
+        "models/pca.py": '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, batches, state):
+    update = streaming_update(mesh)
+    for b in batches:
+        state = update(state, b, None)
+    return state
+''',
+    }, "use-after-donate")
+    assert found == []
+
+
+def test_use_after_donate_flags_loop_without_rebind():
+    _, found = run_rules({
+        "ops/gram.py": GRAM_FIXTURE,
+        "models/pca.py": '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, batches, state):
+    update = streaming_update(mesh)
+    for b in batches:
+        update(state, b, None)  # next iteration re-reads the dead buffer
+''',
+    }, "use-after-donate")
+    assert rule_ids(found) == ["use-after-donate"]
+    assert "loop" in found[0].message
+
+
+def test_use_after_donate_ignores_mutually_exclusive_branch():
+    # A read of the donated name in the ELSE arm of the branch holding
+    # the donating call can never see the dead buffer — not a finding;
+    # a read AFTER the whole if (reachable from the donating arm) is.
+    files = {
+        "ops/gram.py": GRAM_FIXTURE,
+        "models/pca.py": '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, b, state, fast):
+    update = streaming_update(mesh)
+    if fast:
+        out = update(state, b, None)
+        return out
+    else:
+        return state
+''',
+    }
+    _, found = run_rules(files, "use-after-donate")
+    assert found == []
+    files["models/pca.py"] = '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, b, state, fast):
+    update = streaming_update(mesh)
+    if fast:
+        out = update(state, b, None)
+    return state  # reachable after the donating arm: use-after-free
+'''
+    _, found = run_rules(files, "use-after-donate")
+    assert rule_ids(found) == ["use-after-donate"]
+
+
+def test_use_after_donate_tuple_unpack_rebind_heals():
+    # Multi-output donated folds rebind via tuple unpack — healed.
+    _, found = run_rules({
+        "ops/gram.py": GRAM_FIXTURE,
+        "models/pca.py": '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, batches, state):
+    update = streaming_update(mesh)
+    n = 0
+    for b in batches:
+        state, n = update(state, b, None)
+    return state, n
+''',
+    }, "use-after-donate")
+    assert found == []
+
+
+def test_use_after_donate_sees_finally_block():
+    # try/finally: the finally body executes AFTER the donating call —
+    # a read of the donated name there is a real use-after-free.
+    _, found = run_rules({
+        "ops/gram.py": GRAM_FIXTURE,
+        "models/pca.py": '''
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+
+def fit(mesh, b, state, log):
+    update = streaming_update(mesh)
+    try:
+        out = update(state, b, None)
+    finally:
+        log(state.shape)
+    return out
+''',
+    }, "use-after-donate")
+    assert rule_ids(found) == ["use-after-donate"]
+
+
+def test_device_lock_closure_does_not_inherit_enclosing_with():
+    # A closure DEFINED under `with _DEVICE_LOCK` runs later, when the
+    # lock is long released: the dispatch inside it must still flag.
+    _, found = run_rules(_daemon('''
+import threading
+from spark_rapids_ml_tpu.ops.gram import streaming_update
+_DEVICE_LOCK = threading.Lock()
+
+class Job:
+    def __init__(self, mesh):
+        self.update = streaming_update(mesh)
+    def defer(self, schedule, s, x, m):
+        with _DEVICE_LOCK:
+            def cb():
+                return self.update(s, x, m)
+            schedule(cb)
+'''), "device-lock")
+    assert rule_ids(found) == ["device-lock"]
+    assert found[0].symbol == "Job.defer.cb"
+
+
+# ---------------------------------------------------------------------------
+# family 3: determinism
+# ---------------------------------------------------------------------------
+
+
+def test_unsorted_iter_twins():
+    bad = {"ops/fold.py": '''
+def merge(parts):
+    total = 0
+    for k, v in parts.items():
+        total += v
+    return total
+'''}
+    good = {"ops/fold.py": '''
+def merge(parts):
+    total = 0
+    for k, v in sorted(parts.items()):
+        total += v
+    return total
+'''}
+    _, found = run_rules(bad, "unsorted-iter")
+    assert rule_ids(found) == ["unsorted-iter"]
+    _, found = run_rules(good, "unsorted-iter")
+    assert found == []
+
+
+def test_unsorted_iter_scope_and_precision():
+    # Outside the bitwise modules (and off the daemon fold paths) the
+    # rule is silent; literal-ordered local dicts and key-addressed
+    # dict→dict rebuilds are deterministic by construction.
+    _, found = run_rules({
+        "serve/client.py": '''
+def render(d):
+    return [v for _, v in d.items()]
+''',
+        "ops/tables.py": '''
+def build(arrays):
+    want = {"a": 1, "b": 2}
+    out = []
+    for name, shape in want.items():
+        out.append((name, shape))
+    rekeyed = {k: float(v) for k, v in arrays.items()}
+    return out, rekeyed
+''',
+    }, "unsorted-iter")
+    assert found == []
+
+
+def test_unsorted_iter_flags_set_iteration_on_fold_path():
+    _, found = run_rules({"serve/daemon.py": '''
+def merge_peers(peers):
+    acc = []
+    for p in set(peers):
+        acc.append(p)
+    return acc
+'''}, "unsorted-iter")
+    assert rule_ids(found) == ["unsorted-iter"]
+
+
+def test_wallclock_entropy_twins():
+    bad = {"models/kmeans.py": '''
+import time
+import numpy as np
+
+def fit(x):
+    t = time.time()
+    noise = np.random.rand(4)
+    return t, noise
+'''}
+    good = {"models/kmeans.py": '''
+import numpy as np
+
+def fit(x, seed):
+    rng = np.random.default_rng(seed)
+    return rng.random(4)
+'''}
+    _, found = run_rules(bad, "wallclock-entropy")
+    assert sorted(rule_ids(found)) == ["wallclock-entropy", "wallclock-entropy"]
+    _, found = run_rules(good, "wallclock-entropy")
+    assert found == []
+
+
+def test_wallclock_entropy_ignores_non_bitwise_modules():
+    _, found = run_rules({"serve/client.py": '''
+import time
+
+def backoff():
+    return time.time()
+'''}, "wallclock-entropy")
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# family 4: wire contract
+# ---------------------------------------------------------------------------
+
+DAEMON_WIRE = '''
+_KNOWN_OPS = frozenset(("ping", "feed"))
+
+def dispatch(op, conn):
+    if op == "ping":
+        protocol.send_json(conn, {"ok": True})
+    elif op == "fe" + "ed":
+        protocol.send_json(conn, {"ok": True, "rows": 1})
+    elif op == f"fin{'alize'}":
+        protocol.send_json(conn, {"ok": True})
+'''
+
+
+def test_wire_op_clamp_sees_through_concatenation_and_fstrings():
+    project, found = run_rules(
+        {"serve/daemon.py": DAEMON_WIRE},
+        "wire-op-clamp",
+        protocol_doc="ping feed",
+    )
+    msgs = [f.message for f in found]
+    # "finalize" (built via f-string) is neither clamped nor documented;
+    # "feed" (built via concatenation) is both.
+    assert any('"finalize" is dispatched but missing' in m for m in msgs)
+    assert any("absent from docs/protocol.md" in m for m in msgs)
+    assert not any('"feed"' in m for m in msgs)
+
+
+def test_wire_op_clamp_clean_when_clamped_and_documented():
+    src = DAEMON_WIRE.replace('("ping", "feed")', '("ping", "feed", "finalize")')
+    _, found = run_rules(
+        {"serve/daemon.py": src},
+        "wire-op-clamp",
+        protocol_doc="ping feed finalize",
+    )
+    assert found == []
+
+
+def test_ack_contract_flags_removed_field_only():
+    files = {"serve/daemon.py": '''
+def _identity(self):
+    return {"id": 1, "boot_id": 2}
+
+def answer(self, conn):
+    protocol.send_json(conn, {"ok": True, "rows": 3, **self._identity()})
+'''}
+    # A snapshot field the daemon no longer answers → finding.
+    _, found = run_rules(
+        files, "ack-contract",
+        contract={"version": 1, "ack_fields": ["ok", "rows", "id", "boot_id", "gone"]},
+    )
+    assert rule_ids(found) == ["ack-contract"]
+    assert '"gone"' in found[0].message
+    # Additive drift (code answers MORE than the snapshot) → note, not a
+    # finding: the contract is "only ever add".
+    project, found = run_rules(
+        files, "ack-contract",
+        contract={"version": 1, "ack_fields": ["ok", "rows"]},
+    )
+    assert found == []
+    assert any("additive" in n for n in project.notes)
+
+
+def test_ack_field_collection_precision():
+    """Variable-bound acks (the health/model_status shape) ARE collected
+    — literal assignment plus dict-grown keys on the sent name — while
+    subscript stores on UNRELATED dicts are NOT: over-collection would
+    mask a removed ack field behind any identically-named key."""
+    from spark_rapids_ml_tpu.tools.analyze import Module, collect_ack_fields
+
+    mod = Module("serve/daemon.py", '''
+def answer(self, conn, m):
+    status = {"ok": True, "exists": m is not None}
+    if m is not None:
+        status["aot"] = 1
+    unrelated = {}
+    unrelated["rows"] = 3
+    protocol.send_json(conn, status)
+''')
+    assert collect_ack_fields(mod) == {"ok", "exists", "aot"}
+
+
+def test_package_contract_snapshot_is_in_sync():
+    """The checked-in snapshot must stay a subset of what the daemon
+    answers (removal = break) AND must not silently rot: every snapshot
+    field is still answered today."""
+    contract = json.loads(analyze.CONTRACT_PATH.read_text())
+    project = pkg_project()
+    daemon = [m for m in project.modules if m.relpath == "serve/daemon.py"][0]
+    have = analyze.collect_ack_fields(daemon)
+    assert set(contract["ack_fields"]) <= have
+    assert len(contract["ack_fields"]) >= 20  # the real ack surface
+
+
+# ---------------------------------------------------------------------------
+# ported regex gates
+# ---------------------------------------------------------------------------
+
+
+def test_bare_print_twins():
+    _, found = run_rules({
+        "core/x.py": 'def f():\n    print("hi")\n',
+        "tools/cli.py": 'def f():\n    print("hi")\n',
+        "spark/entry.py": 'if __name__ == "__main__":\n    print("hi")\n',
+    }, "bare-print")
+    assert [f.file for f in found] == ["core/x.py"]
+
+
+def test_bare_collective_twins():
+    _, found = run_rules({
+        "ops/gram.py": 'def f(x):\n    return lax.psum(x, "data")\n',
+        "parallel/mapreduce.py": 'def f(x):\n    return lax.psum(x, "data")\n',
+        "ops/doc.py": '"""mentions lax.psum in prose only"""\n',
+    }, "bare-collective")
+    assert [f.file for f in found] == ["ops/gram.py"]
+
+
+def test_socket_timeout_twins():
+    _, found = run_rules({"serve/client.py": '''
+import socket
+
+def bad(addr):
+    return socket.create_connection(addr)
+
+def good(addr):
+    return socket.create_connection(addr, timeout=5.0)
+
+def also_good(addr, t):
+    return socket.create_connection(addr, t)
+'''}, "socket-timeout")
+    assert len(found) == 1
+    assert found[0].symbol == "bad"
+
+
+# ---------------------------------------------------------------------------
+# suppression: pragmas, baseline round-trip, seeded violation
+# ---------------------------------------------------------------------------
+
+
+def test_inline_pragma_suppresses_exactly_its_rule():
+    files = {"ops/fold.py": '''
+def merge(parts):
+    total = 0
+    for k, v in parts.items():  # srml: disable=unsorted-iter
+        total += v
+    for k, v in parts.items():
+        total += v
+    return total
+'''}
+    project = Project(files=files)
+    found = project.run(rules=["unsorted-iter"])
+    assert len(found) == 1
+    assert found[0].line == 6  # only the un-pragma'd loop
+
+
+def test_baseline_round_trip_and_stale_warning():
+    bad = {"ops/fold.py": '''
+def merge(parts):
+    return [v for k, v in parts.items()]
+'''}
+    clean = {"ops/fold.py": '''
+def merge(parts):
+    return [v for k, v in sorted(parts.items())]
+'''}
+    # 1. finding exists
+    project = Project(files=bad)
+    raw = project.run(rules=["unsorted-iter"])
+    assert len(raw) == 1
+    # 2. accepted into the baseline → suppressed
+    accepted = Baseline.from_findings(raw)
+    project = Project(files=bad)
+    assert project.run(rules=["unsorted-iter"], baseline=accepted) == []
+    assert project.notes == []
+    # 3. offending code removed → the baseline entry goes stale (warned,
+    #    so the ratchet only ever shrinks)
+    project = Project(files=clean)
+    stale_base = Baseline.from_findings(raw)
+    assert project.run(rules=["unsorted-iter"], baseline=stale_base) == []
+    assert any("stale baseline entry" in n for n in project.notes)
+    # 4. a NEW finding in an already-baselined symbol still fails: the
+    #    count bounds acceptance.
+    two = {"ops/fold.py": '''
+def merge(parts):
+    a = [v for k, v in parts.items()]
+    b = [k for k, v in parts.items()]
+    return a + b
+'''}
+    project = Project(files=two)
+    found = project.run(rules=["unsorted-iter"], baseline=Baseline.from_findings(raw))
+    assert len(found) == 1
+
+
+def test_baseline_is_reusable_across_runs():
+    # Matched counts are per-run state: one loaded Baseline must keep
+    # suppressing when reused (the natural way to script the API).
+    files = {"ops/fold.py": '''
+def merge(parts):
+    return [v for k, v in parts.items()]
+'''}
+    accepted = Baseline.from_findings(Project(files=files).run(rules=["unsorted-iter"]))
+    for _ in range(2):
+        project = Project(files=files)
+        assert project.run(rules=["unsorted-iter"], baseline=accepted) == []
+        assert project.notes == []
+
+
+def test_rewrite_baseline_preserves_out_of_scope_entries():
+    """A --rule-restricted --write-baseline must not un-accept entries
+    of rules it never evaluated (or files a path filter excluded)."""
+    files = {"ops/fold.py": '''
+def merge(parts):
+    return [v for k, v in parts.items()]
+'''}
+    project = Project(files=files)
+    accepted = Baseline(entries=[
+        # Out of scope below: a different rule, and a file not analyzed.
+        {"rule": "device-lock", "file": "serve/daemon.py",
+         "symbol": "Job.fold", "count": 2},
+        # In scope and still live: kept at its matched count.
+        {"rule": "unsorted-iter", "file": "ops/fold.py",
+         "symbol": "merge", "count": 1},
+        # In scope but stale: dropped by the rewrite (the ratchet).
+        {"rule": "unsorted-iter", "file": "ops/fold.py",
+         "symbol": "gone_fn", "count": 1},
+    ])
+    findings = project.run(rules=["unsorted-iter"], baseline=accepted)
+    assert findings == []
+    merged = analyze.rewrite_baseline(
+        project, accepted, findings, selected_rules=["unsorted-iter"]
+    )
+    assert merged.entries == {
+        ("device-lock", "serve/daemon.py", "Job.fold"): 2,
+        ("unsorted-iter", "ops/fold.py", "merge"): 1,
+    }
+
+
+def test_seeded_violation_in_scratch_daemon_is_caught():
+    """The acceptance-criteria drill: splice a device dispatch outside
+    _DEVICE_LOCK into a scratch copy of the REAL daemon.py and the gate
+    must catch it."""
+    files = Project.package_files()
+    files["serve/daemon.py"] += '''
+
+def _scratch_unlocked_dispatch(self, state, xs, ms):
+    return self.update(state, xs, ms)
+'''
+    project = Project(files=files)
+    found = project.run(rules=["device-lock"], baseline=Baseline.load())
+    assert len(found) == 1
+    assert found[0].symbol == "_scratch_unlocked_dispatch"
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate + CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.analyze
+def test_whole_package_zero_unsuppressed_findings():
+    """THE gate: every rule over the real tree, pragmas + baseline
+    honored — a new violation anywhere in the package fails tier-1 here
+    exactly like the historical lint gates."""
+    project = pkg_project()
+    findings = project.run(baseline=Baseline.load())
+    assert findings == [], "\n" + analyze.format_findings(findings)
+
+
+@pytest.mark.analyze
+def test_baseline_has_no_stale_entries():
+    """The ratchet: accepted findings whose code has been fixed must be
+    removed from tools/analyze_baseline.json, so acceptance only shrinks."""
+    project = pkg_project()
+    project.run(baseline=Baseline.load())
+    stale = [n for n in project.notes if "stale baseline entry" in n]
+    assert stale == [], "\n".join(stale)
+
+
+@pytest.mark.analyze
+def test_cli_json_output():
+    """The machine interface CI consumes: exit 0 + well-formed JSON."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_ml_tpu.tools.analyze", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert len(payload["rules"]) >= 11
+
+
+def test_rule_catalog_is_documented():
+    """Every registered rule appears in docs/static_analysis.md (the
+    operator-facing catalog) — a rule cannot land undocumented."""
+    doc = (REPO / "docs" / "static_analysis.md").read_text()
+    missing = [rid for rid in analyze.RULES if f"`{rid}`" not in doc]
+    assert missing == [], f"rules missing from docs/static_analysis.md: {missing}"
