@@ -14,6 +14,7 @@ runtime, so the same mesh code scales from 1 chip to a pod; XLA routes
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -27,6 +28,14 @@ MODEL_AXIS = "model"
 
 _default_mesh: Optional[Mesh] = None
 _default_mesh_key: Optional[tuple] = None
+#: Guards the default-mesh cache: daemon connection threads reach
+#: default_mesh() through the model fit/serve paths under DIFFERENT
+#: locks (job lock here, model lock there), so the check-then-build
+#: below would otherwise interleave and build the mesh twice — or hand
+#: one caller a mesh mid-replacement (srml-check thread-shared-state
+#: notes "some lock held" is not "the SAME lock held"; this makes it
+#: the same lock).
+_mesh_lock = threading.Lock()
 
 
 def make_mesh(
@@ -55,10 +64,15 @@ def default_mesh() -> Mesh:
     Rebuilt when the axis config changes or the live device set changes."""
     global _default_mesh, _default_mesh_key
     key = (config.get("mesh_data_axis"), config.get("mesh_model_axis") or 1)
-    if _default_mesh is None or key != _default_mesh_key or _mesh_is_stale(_default_mesh):
-        _default_mesh = make_mesh(data=key[0], model=key[1])
-        _default_mesh_key = key
-    return _default_mesh
+    with _mesh_lock:
+        if (
+            _default_mesh is None
+            or key != _default_mesh_key
+            or _mesh_is_stale(_default_mesh)
+        ):
+            _default_mesh = make_mesh(data=key[0], model=key[1])
+            _default_mesh_key = key
+        return _default_mesh
 
 
 def _mesh_is_stale(mesh: Mesh) -> bool:
@@ -73,8 +87,9 @@ def _mesh_is_stale(mesh: Mesh) -> bool:
 
 def reset_default_mesh() -> None:
     global _default_mesh, _default_mesh_key
-    _default_mesh = None
-    _default_mesh_key = None
+    with _mesh_lock:
+        _default_mesh = None
+        _default_mesh_key = None
 
 
 def mesh_shape(mesh: Mesh) -> tuple:

@@ -191,7 +191,13 @@ _SHEDDABLE_OPS = (
 #: acyclic. This contract is machine-checked: srml-check's
 #: `device-lock`/`lock-order`/`compile-outside-lock` rules
 #: (tools/analyze.py, docs/static_analysis.md) fail tier-1 on a dispatch
-#: outside the lock, a lock acquired under it, or a compile inside it.
+#: outside the lock, a lock acquired under it, or a compile inside it —
+#: and the interprocedural passes extend the check through call edges:
+#: `blocking-under-device-lock` fails on any TRANSITIVELY-blocking call
+#: (socket I/O, sleeps, future waits) reachable while this lock is held
+#: (blocking on the device itself is the exemption — that is the lock's
+#: purpose), and `lock-graph-cycle` keeps the whole-program lock-order
+#: graph over every daemon/scheduler/router/fleet lock acyclic.
 _DEVICE_LOCK = threading.Lock()
 
 #: Every op _dispatch understands — the clamp for metric labels: a
@@ -1850,12 +1856,17 @@ class _ServedModel:
                     jits.append(jit_obj)
         # Hit/miss BASELINES per wrapper: a shared wrapper (the KNN case
         # above) carries other registrations' counts — this instance's
-        # ledger reports only what happened since ITS warm.
-        self.aot = {
-            "buckets": buckets,
-            "compiled": compiled,
-            "jits": [(j, j.aot_hits, j.aot_misses) for j in jits],
-        }
+        # ledger reports only what happened since ITS warm. Published
+        # under the model lock: aot_warm runs on the registering
+        # connection's thread while other connection threads read
+        # aot_status() (model_status/health), and an unlocked publish is
+        # exactly the srml-check thread-shared-state class.
+        with self.lock:
+            self.aot = {
+                "buckets": buckets,
+                "compiled": compiled,
+                "jits": [(j, j.aot_hits, j.aot_misses) for j in jits],
+            }
         return {"buckets": buckets, "compiled": compiled}
 
     def aot_status(self) -> Optional[Dict[str, Any]]:
@@ -1869,13 +1880,22 @@ class _ServedModel:
         registrations with identical index/query shapes pool their
         counts on the shared wrapper — the baselines separate
         sequential churn, not simultaneous same-shape traffic."""
-        if self.aot is None:
+        # The reader half of aot_warm's locked publish: ONE reference
+        # snapshot, deliberately WITHOUT self.lock — transform/
+        # kneighbors hold that lock across whole device dispatches, and
+        # a monitoring scrape must never park behind in-flight
+        # inference. The single read is safe: aot_warm builds the dict
+        # fully before publishing the reference, so this sees one
+        # complete generation of the ledger (never a mix), just
+        # possibly the previous one for an instant.
+        aot = self.aot
+        if aot is None:
             return None
         return {
-            "buckets": self.aot["buckets"],
-            "compiled": self.aot["compiled"],
-            "hits": sum(j.aot_hits - h0 for j, h0, _ in self.aot["jits"]),
-            "misses": sum(j.aot_misses - m0 for j, _, m0 in self.aot["jits"]),
+            "buckets": aot["buckets"],
+            "compiled": aot["compiled"],
+            "hits": sum(j.aot_hits - h0 for j, h0, _ in aot["jits"]),
+            "misses": sum(j.aot_misses - m0 for j, _, m0 in aot["jits"]),
         }
 
     def transform(self, x: np.ndarray) -> Dict[str, np.ndarray]:

@@ -38,8 +38,13 @@ Core pieces (docs/protocol.md "Serving scheduler"):
   ``serve_max_batch_rows``, dispatches ONCE under the model lock +
   ``_DEVICE_LOCK`` (via ``_ServedModel``), and scatters per-request row
   slices back to the waiting connection threads. (The lock discipline
-  here is machine-checked by srml-check's lock rules —
-  docs/static_analysis.md.)
+  here is machine-checked by srml-check — docs/static_analysis.md: the
+  lexical lock rules, plus the interprocedural passes that follow this
+  dispatcher thread's call graph: ``thread-shared-state`` proves every
+  ``_Request``/EWMA/ledger mutation happens with a lock on the access
+  path, ``lock-graph-cycle`` keeps ``_cv`` acyclic against the daemon's
+  model/job locks, and ``blocking-under-device-lock`` keeps host-side
+  blocking out of the device sections ``_dispatch`` enters.)
 * **Warmup** — :meth:`RequestScheduler.warmup` pre-compiles the bucket
   ladder for a served model (the additive ``warmup`` wire op), so
   first-request latency is predictable instead of hiding a compile.

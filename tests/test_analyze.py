@@ -214,7 +214,10 @@ class D:
     assert found[0].symbol == "D.bad"
 
 
-def test_lock_order_flags_observed_inversion():
+def test_lock_order_is_lexical_only():
+    # The general A→B/B→A inversion moved to lock-graph-cycle (where it
+    # is a graph cycle); lock-order keeps only the _DEVICE_LOCK-innermost
+    # lexical contract.
     _, found = run_rules({"serve/fleet.py": '''
 import threading
 
@@ -230,8 +233,7 @@ class F:
             with self._a_lock:
                 pass
 '''}, "lock-order")
-    assert rule_ids(found) == ["lock-order", "lock-order"]
-    assert "inversion" in found[0].message
+    assert found == []
 
 
 def test_lock_order_sees_multi_item_with():
@@ -626,6 +628,710 @@ def also_good(addr, t):
 
 
 # ---------------------------------------------------------------------------
+# the interprocedural engine: call-graph resolution units
+# ---------------------------------------------------------------------------
+
+CALLGRAPH_FILES = {
+    "ops/util.py": '''
+def leaf():
+    import time
+    time.sleep(0.1)
+
+def mid():
+    leaf()
+''',
+    "models/user.py": '''
+from spark_rapids_ml_tpu.ops import util as util_ops
+from spark_rapids_ml_tpu.ops.util import mid
+
+class Runner:
+    def run_all(self):
+        self.helper()
+
+    def helper(self):
+        mid()
+
+    def aliased(self):
+        util_ops.leaf()
+
+    def local(self):
+        def inner():
+            mid()
+        inner()
+''',
+}
+
+
+def test_callgraph_resolves_methods_imports_aliases_and_nested_defs():
+    project = Project(files=dict(CALLGRAPH_FILES))
+    g = project.graph
+    def callees(key):
+        return sorted(s.callee for s in g.calls_out.get(key, []))
+    # self-method resolution
+    assert callees(("models/user.py", "Runner.run_all")) == [
+        ("models/user.py", "Runner.helper")
+    ]
+    # from-import function resolution
+    assert callees(("models/user.py", "Runner.helper")) == [
+        ("ops/util.py", "mid")
+    ]
+    # module-alias resolution
+    assert callees(("models/user.py", "Runner.aliased")) == [
+        ("ops/util.py", "leaf")
+    ]
+    # nested-def resolution: `local` calls its own `inner`
+    assert callees(("models/user.py", "Runner.local")) == [
+        ("models/user.py", "Runner.local.inner")
+    ]
+
+
+def test_callgraph_may_block_fixpoint_chains_to_the_primitive():
+    project = Project(files=dict(CALLGRAPH_FILES))
+    g = project.graph
+    # leaf blocks directly; mid and every caller inherit it through the
+    # fixpoint, each with a witness chain that bottoms out at time.sleep.
+    assert ("ops/util.py", "leaf") in g.may_block
+    assert ("ops/util.py", "mid") in g.may_block
+    chain = g.may_block[("models/user.py", "Runner.run_all")]
+    assert "time.sleep" in chain[-1][3]
+    assert len(chain) >= 3  # run_all → helper → mid → leaf's primitive
+
+
+def test_callgraph_attr_dispatch_respects_visibility_and_affinity():
+    files = {
+        "serve/a.py": '''
+class Timer:
+    def halt(self):
+        pass
+
+class Daemon:
+    def halt(self):
+        import time
+        time.sleep(5)
+
+def use(timer):
+    timer.halt()
+''',
+        "spark/far.py": '''
+class Unrelated:
+    def halt(self):
+        import time
+        time.sleep(5)
+''',
+    }
+    project = Project(files=files)
+    g = project.graph
+    callees = {s.callee for s in g.calls_out.get(("serve/a.py", "use"), [])}
+    # receiver `timer` has name affinity with class Timer → the Daemon
+    # candidate is dropped; Unrelated lives in a module neither side
+    # imports → invisible.
+    assert callees == {("serve/a.py", "Timer.halt")}
+
+
+def test_callgraph_resolves_inherited_methods_through_aliased_base_imports():
+    # `from ... import Base as RenamedBase; class Child(RenamedBase)`:
+    # the base must resolve under its ORIGINAL name in the source
+    # module, or inherited-method facts silently vanish.
+    files = {
+        "ops/base.py": '''
+class Base:
+    def blocky(self):
+        import time
+        time.sleep(1)
+''',
+        "serve/child.py": '''
+from spark_rapids_ml_tpu.ops.base import Base as RenamedBase
+
+class Child(RenamedBase):
+    def go(self):
+        self.blocky()
+''',
+    }
+    project = Project(files=files)
+    g = project.graph
+    assert [s.callee for s in g.calls_out[("serve/child.py", "Child.go")]] == [
+        ("ops/base.py", "Base.blocky")
+    ]
+    assert ("serve/child.py", "Child.go") in g.may_block
+
+
+def test_long_held_scan_ignores_closures_defined_under_the_lock():
+    # A blocking call inside a nested def defined under `with lock:`
+    # runs AFTER the lock is released — it must not mark the lock
+    # long-held (the same closure rule held_locks documents).
+    files = _daemon('''
+import threading
+import time
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    _cb_lock = threading.Lock()
+    def defer(self, schedule):
+        with self._cb_lock:
+            def later():
+                time.sleep(1)
+            schedule(later)
+    def bump(self):
+        with self._cb_lock:
+            self.n = 1
+    def fold(self):
+        with _DEVICE_LOCK:
+            self.bump()
+''')
+    _, found = run_rules(files, "blocking-under-device-lock")
+    assert found == []
+
+
+def test_callgraph_entered_holding_propagates_through_calls():
+    files = {"serve/d.py": '''
+import threading
+
+class D:
+    _a_lock = threading.Lock()
+    def outer(self):
+        with self._a_lock:
+            self.inner()
+    def inner(self):
+        pass
+'''}
+    project = Project(files=files)
+    g = project.graph
+    assert g.entered_holding.get(("serve/d.py", "D.inner")) == {
+        "serve/d.py:_a_lock"
+    }
+
+
+# ---------------------------------------------------------------------------
+# family: interprocedural lock rules
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_under_device_lock_flags_direct_and_transitive():
+    bad = _daemon('''
+import threading
+import time
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    def direct(self):
+        with _DEVICE_LOCK:
+            time.sleep(0.5)
+    def transitive(self):
+        with _DEVICE_LOCK:
+            self._notify()
+    def _notify(self):
+        self._sock.sendall(b"x")
+''')
+    _, found = run_rules(bad, "blocking-under-device-lock")
+    assert rule_ids(found) == [
+        "blocking-under-device-lock", "blocking-under-device-lock"
+    ]
+    direct, transitive = found
+    assert "time.sleep" in direct.message
+    # the transitive finding carries the call-chain witness to the
+    # socket primitive, and the family lands in the JSON payload
+    assert transitive.chain and "sendall" in transitive.chain[-1][2]
+    assert transitive.family == "lock"
+    payload = transitive.as_dict()
+    assert payload["family"] == "lock"
+    assert payload["chain"][-1]["note"]
+
+
+def test_blocking_under_device_lock_contended_lock_twins():
+    # A `with lock:` acquisition blocks ONLY when that lock is
+    # LONG-HELD — some holder's critical section itself transitively
+    # blocks. Contending on a micro-lock (holders never block inside)
+    # is a bounded stall and must NOT flood the rule: config.get's
+    # registry lock is the canonical benign case.
+    contended = _daemon('''
+import threading
+import time
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    _stats_lock = threading.Lock()
+    def flush(self):
+        with self._stats_lock:
+            self._sock.sendall(b"stats")  # long holder: blocks inside
+    def bump(self):
+        with self._stats_lock:
+            self.n = 1
+    def fold(self):
+        with _DEVICE_LOCK:
+            self.bump()  # can wait for flush()'s socket send
+''')
+    _, found = run_rules(contended, "blocking-under-device-lock")
+    assert rule_ids(found) == ["blocking-under-device-lock"]
+    assert found[0].symbol == "D.fold"
+    notes = " ".join(n for _, _, n in found[0].chain)
+    assert "wait on a holder" in notes and "sendall" in notes
+    micro = _daemon('''
+import threading
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    _stats_lock = threading.Lock()
+    def bump(self):
+        with self._stats_lock:
+            self.n = 1  # every holder is O(ns): bounded micro-stall
+    def fold(self):
+        with _DEVICE_LOCK:
+            self.bump()
+''')
+    _, found = run_rules(micro, "blocking-under-device-lock")
+    assert found == []
+
+
+def test_thread_shared_state_sees_timer_and_positional_targets():
+    # threading.Timer's callable is POSITIONAL (`function`, not
+    # `target=`) — a Timer-spawned unlocked write must still flag.
+    files = {"serve/worker.py": '''
+import threading
+
+class W:
+    def arm(self):
+        threading.Timer(5.0, self._tick).start()
+    def _tick(self):
+        self.n = 1
+'''}
+    _, found = run_rules(files, "thread-shared-state")
+    assert rule_ids(found) == ["thread-shared-state"]
+    assert "self.n" in found[0].message
+
+
+def test_blocking_under_device_lock_exempts_device_waits():
+    # Blocking on the DEVICE is the lock's purpose: block_until_ready /
+    # device_get under _DEVICE_LOCK is the encoded exemption, not a
+    # finding (srml-check would otherwise flag every legal dispatch).
+    good = _daemon('''
+import threading
+import jax
+_DEVICE_LOCK = threading.Lock()
+
+class D:
+    def dispatch(self, out):
+        with _DEVICE_LOCK:
+            return jax.block_until_ready(out)
+    def unlocked_sleep(self):
+        import time
+        time.sleep(0.5)
+''')
+    _, found = run_rules(good, "blocking-under-device-lock")
+    assert found == []
+
+
+def test_lock_graph_cycle_twins_lexical():
+    bad = {"serve/fleet.py": '''
+import threading
+
+class F:
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+'''}
+    good = {"serve/fleet.py": '''
+import threading
+
+class F:
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+    def two(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+'''}
+    _, found = run_rules(bad, "lock-graph-cycle")
+    assert rule_ids(found) == ["lock-graph-cycle"]
+    assert "_a_lock" in found[0].message and "_b_lock" in found[0].message
+    assert len(found[0].chain) == 2  # both edges of the 2-cycle
+    _, found = run_rules(good, "lock-graph-cycle")
+    assert found == []
+
+
+def test_lock_graph_cycle_through_call_edges():
+    # The interprocedural shape PR 14's per-function analyzer was blind
+    # to: neither function nests two `with` statements — the ordering
+    # only exists across call edges.
+    files = {"serve/fleet.py": '''
+import threading
+
+class F:
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+    def path_one(self):
+        with self._a_lock:
+            self._grab_b()
+    def _grab_b(self):
+        with self._b_lock:
+            pass
+    def path_two(self):
+        with self._b_lock:
+            self._grab_a()
+    def _grab_a(self):
+        with self._a_lock:
+            pass
+'''}
+    _, found = run_rules(files, "lock-graph-cycle")
+    assert rule_ids(found) == ["lock-graph-cycle"]
+    assert "caller on the path" in " ".join(n for _, _, n in found[0].chain)
+
+
+def test_seeded_lock_cycle_drill_in_scratch_module():
+    """The acceptance-criteria drill: splice an A→B/B→A pair (linked only
+    through call edges) into a scratch module of the REAL package and the
+    cycle gate must catch it."""
+    files = Project.package_files()
+    files["serve/_scratch_cycle.py"] = '''
+import threading
+
+class Scratch:
+    _alpha_lock = threading.Lock()
+    _beta_lock = threading.Lock()
+    def forward(self):
+        with self._alpha_lock:
+            self._take_beta()
+    def _take_beta(self):
+        with self._beta_lock:
+            pass
+    def backward(self):
+        with self._beta_lock:
+            self._take_alpha()
+    def _take_alpha(self):
+        with self._alpha_lock:
+            pass
+'''
+    project = Project(files=files)
+    found = project.run(rules=["lock-graph-cycle"], baseline=Baseline.load())
+    assert len(found) == 1
+    assert "_alpha_lock" in found[0].message
+    assert found[0].file == "serve/_scratch_cycle.py"
+
+
+# ---------------------------------------------------------------------------
+# family: thread-shared-state
+# ---------------------------------------------------------------------------
+
+
+def test_thread_shared_state_twins():
+    bad = {"serve/worker.py": '''
+import threading
+
+class W:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+    def _loop(self):
+        self.count = 0
+'''}
+    good = {"serve/worker.py": '''
+import threading
+
+class W:
+    _lock = threading.Lock()
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+    def _loop(self):
+        with self._lock:
+            self.count = 0
+'''}
+    _, found = run_rules(bad, "thread-shared-state")
+    assert rule_ids(found) == ["thread-shared-state"]
+    assert "self.count" in found[0].message
+    _, found = run_rules(good, "thread-shared-state")
+    assert found == []
+
+
+def test_thread_shared_state_respects_lock_on_the_call_path():
+    # The write itself is lexically unlocked, but EVERY path from the
+    # thread entry passes a lock-holding call site — not a finding: the
+    # lock is held on the access path.
+    files = {"serve/worker.py": '''
+import threading
+
+class W:
+    _lock = threading.Lock()
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+    def _loop(self):
+        with self._lock:
+            self._flush()
+    def _flush(self):
+        self.pending = []
+'''}
+    _, found = run_rules(files, "thread-shared-state")
+    assert found == []
+
+
+def test_thread_shared_state_flags_module_globals_and_skips_init():
+    files = {"serve/worker.py": '''
+import threading
+
+_COUNTER = 0
+
+class W:
+    def __init__(self):
+        self.ok = True  # pre-publication: exempt
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+    def _loop(self):
+        global _COUNTER
+        _COUNTER += 1
+'''}
+    _, found = run_rules(files, "thread-shared-state")
+    assert rule_ids(found) == ["thread-shared-state"]
+    assert "_COUNTER" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# family: per-op wire schemas
+# ---------------------------------------------------------------------------
+
+WIRE_SCHEMA_DAEMON = '''
+class Daemon:
+    def _dispatch(self, conn, req):
+        op = req.get("op")
+        if op == "ping":
+            protocol.send_json(conn, {"ok": True, "v": 1})
+        elif op == "feed":
+            self._op_feed(conn, req)
+
+    def _op_feed(self, conn, req):
+        rows = int(req["rows"])
+        batch = req.get("batch_id")
+        protocol.send_json(conn, {"ok": True, "rows": rows})
+'''
+
+WIRE_SCHEMA_DOC = "### ping\n\n### feed\n"
+
+
+def _wire_contract(**ops):
+    return {"version": 2, "common": {"req": [], "ack": []}, "ops": ops}
+
+
+def test_wire_schema_extraction_is_per_op():
+    from spark_rapids_ml_tpu.tools.analyze import collect_op_schemas
+
+    project = Project(files={"serve/daemon.py": WIRE_SCHEMA_DAEMON})
+    mod = project.modules[0]
+    ops, common = collect_op_schemas(project, mod)
+    assert sorted(ops) == ["feed", "ping"]
+    assert ops["ping"]["ack"] == {"ok", "v"}
+    # the handler is followed through the self._op_feed(conn, req) call
+    assert ops["feed"]["req"] == {"rows", "batch_id"}
+    assert ops["feed"]["ack"] == {"ok", "rows"}
+    assert "op" in common["req"]
+
+
+def test_wire_schema_round_trip_additive_passes():
+    # Snapshot == code → clean; code answering MORE than the snapshot →
+    # a note, never a finding (the contract only ever grows).
+    snap = _wire_contract(
+        ping={"req": [], "ack": ["ok"]},
+        feed={"req": ["rows"], "ack": ["ok"]},
+    )
+    project, found = run_rules(
+        {"serve/daemon.py": WIRE_SCHEMA_DAEMON},
+        "wire-schema",
+        contract=snap,
+        protocol_doc=WIRE_SCHEMA_DOC,
+    )
+    assert found == []
+    assert any("grew (additive, allowed)" in n for n in project.notes)
+
+
+def test_wire_schema_flags_removed_ack_and_req_fields():
+    snap = _wire_contract(
+        ping={"req": [], "ack": ["ok", "v", "boot_id"]},
+        feed={"req": ["rows", "batch_id", "pass_id"], "ack": ["ok", "rows"]},
+    )
+    _, found = run_rules(
+        {"serve/daemon.py": WIRE_SCHEMA_DAEMON},
+        "wire-schema",
+        contract=snap,
+        protocol_doc=WIRE_SCHEMA_DOC,
+    )
+    msgs = " | ".join(f.message for f in found)
+    assert 'op "ping" no longer answers ack field "boot_id"' in msgs
+    assert 'op "feed" no longer reads request field "pass_id"' in msgs
+    assert len(found) == 2
+
+
+def test_wire_schema_flags_removed_op_and_doc_drift():
+    snap = _wire_contract(
+        ping={"req": [], "ack": ["ok"]},
+        feed={"req": [], "ack": ["ok"]},
+        legacy={"req": [], "ack": ["ok"]},
+    )
+    # docs lost feed's catalog heading (the word surviving in prose is
+    # not enough), and the snapshot still promises a "legacy" op.
+    _, found = run_rules(
+        {"serve/daemon.py": WIRE_SCHEMA_DAEMON},
+        "wire-schema",
+        contract=snap,
+        protocol_doc="### ping\n\nfeed is mentioned only in prose\n",
+    )
+    msgs = " | ".join(f.message for f in found)
+    assert 'op "legacy" is in the wire-schema snapshot but no longer' in msgs
+    assert 'no "### feed" catalog entry' in msgs
+    assert len(found) == 2
+
+
+def test_package_wire_schema_snapshot_is_in_sync():
+    """The checked-in v2 snapshot matches the tree: per-op extraction
+    yields every snapshot op with at least the snapshot's fields, and
+    the gate reports zero findings."""
+    contract = json.loads(analyze.CONTRACT_PATH.read_text())
+    assert contract["version"] == 2
+    assert len(contract["ops"]) >= 15
+    project = pkg_project()
+    found = [
+        f for f in project.run_raw(rules=["wire-schema"])
+    ]
+    assert found == [], "\n" + analyze.format_findings(found)
+    # and the op catalog matches docs/protocol.md section-for-section
+    for op in contract["ops"]:
+        assert f"### {op}" in project.protocol_doc or any(
+            line.startswith(f"### {op}")
+            for line in project.protocol_doc.splitlines()
+        ), op
+
+
+# ---------------------------------------------------------------------------
+# ported gates: jit-ledger + hot-path-span
+# ---------------------------------------------------------------------------
+
+
+def test_jit_ledger_twins():
+    bad = {"ops/kern.py": '''
+import jax
+f = jax.jit(lambda x: x)
+g = ledgered_jit("kern", lambda x: x)
+''',
+           "models/other.py": '''
+h = ledgered_jit("kern.step", lambda x: x)
+''',
+           "ops/dup.py": '''
+k = ledgered_jit("kern.step", lambda x: x)
+'''}
+    _, found = run_rules(bad, "jit-ledger")
+    msgs = " | ".join(f.message for f in found)
+    assert "bare jax.jit()" in msgs
+    assert 'ledger name "kern" is not <area>.<fn>' in msgs
+    assert "also registered in" in msgs
+    assert len(found) == 3
+    good = {"ops/kern.py": '''
+g = ledgered_jit("kern.fold", lambda x: x)
+g2 = ledgered_jit("kern.fold", lambda x: x)  # same-file reuse pools
+'''}
+    _, found = run_rules(good, "jit-ledger")
+    assert found == []
+
+
+def test_hot_path_span_twins():
+    bad = {"models/thing.py": '''
+def fit_thing(x):
+    return x
+
+class ThingModel:
+    def transform_matrix(self, x):
+        return x
+'''}
+    good = {"models/thing.py": '''
+from spark_rapids_ml_tpu.utils.profiling import trace_span
+
+def fit_thing(x):
+    with trace_span("fit"):
+        return x
+
+class ThingModel:
+    def transform_matrix(self, x):
+        with trace_span("transform"):
+            return x
+
+def plan_thing(x):  # not a hot path: neither fit_* nor a hot method
+    return x
+'''}
+    _, found = run_rules(bad, "hot-path-span")
+    assert sorted(f.message.split("(")[0] for f in found) == [
+        "model hot path fit_thing", "model hot path transform_matrix",
+    ]
+    _, found = run_rules(good, "hot-path-span")
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# --changed-only scoping
+# ---------------------------------------------------------------------------
+
+
+def test_reverse_dependents_follow_the_import_graph():
+    project = Project(files=dict(CALLGRAPH_FILES))
+    # models/user.py imports ops/util.py → changing util must pull user
+    # into the report scope; changing user pulls nothing else.
+    assert analyze.reverse_dependents(project, ["ops/util.py"]) == [
+        "models/user.py", "ops/util.py",
+    ]
+    assert analyze.reverse_dependents(project, ["models/user.py"]) == [
+        "models/user.py",
+    ]
+    # unknown paths are ignored rather than crashing the pre-commit hook
+    assert analyze.reverse_dependents(project, ["nope/gone.py"]) == []
+
+
+@pytest.mark.analyze
+def test_cli_changed_only_smoke():
+    proc = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_ml_tpu.tools.analyze",
+         "--changed-only", "HEAD", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "--changed-only HEAD" in proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# analyzer performance gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.analyze
+def test_whole_package_analysis_stays_under_budget():
+    """The interprocedural fixpoints must not quietly make tier-1
+    unaffordable: a fresh whole-package parse + call graph + every rule
+    stays under the pinned budget, and no fixpoint hit its iteration cap
+    (the cap is loud by contract)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    project = Project.from_package()
+    project.graph  # force the call graph + dataflow fixpoints
+    findings = project.run(baseline=Baseline.load())
+    elapsed = _time.perf_counter() - t0
+    assert elapsed < 10.0, (
+        f"whole-package analysis took {elapsed:.1f}s (budget 10s) — the "
+        "interprocedural passes regressed; profile CallGraph._link/_solve"
+    )
+    assert findings == []
+    cap_hits = [n for n in project.notes if "fixpoint cap" in n]
+    assert cap_hits == [], "\n".join(cap_hits)
+
+
+# ---------------------------------------------------------------------------
 # suppression: pragmas, baseline round-trip, seeded violation
 # ---------------------------------------------------------------------------
 
@@ -782,7 +1488,7 @@ def test_cli_json_output():
     payload = json.loads(proc.stdout)
     assert payload["ok"] is True
     assert payload["findings"] == []
-    assert len(payload["rules"]) >= 11
+    assert len(payload["rules"]) >= 17
 
 
 def test_rule_catalog_is_documented():

@@ -114,52 +114,14 @@ def test_fault_sites_used_by_tests_exist_in_the_package():
     )
 
 
-def _def_bodies(text: str, pattern: str):
-    """Yield (name, body) for each def matching ``pattern`` (a regex on
-    the full def line). The body runs to the next def/class/decorator at
-    the same or shallower indentation — indentation-aware so decorated
-    neighbors don't bleed in."""
-    lines = text.split("\n")
-    for i, line in enumerate(lines):
-        m = re.match(pattern, line)
-        if not m:
-            continue
-        indent = len(line) - len(line.lstrip())
-        body = []
-        for j in range(i + 1, len(lines)):
-            nxt = lines[j]
-            if nxt.strip():
-                nxt_indent = len(nxt) - len(nxt.lstrip())
-                if nxt_indent <= indent and re.match(
-                    r"\s*(def |class |@)", nxt
-                ):
-                    break
-            body.append(nxt)
-        yield m.group("name"), "\n".join(body)
-
-
 def test_model_fit_and_transform_hot_paths_are_spanned():
-    """Every model hot path must run under a ``trace_span``: spans are
-    the ONLY source of the per-phase breakdown (metrics histogram + run
-    journal, docs/observability.md) — an unspanned fit or transform is
-    invisible to every dashboard and every perf PR. Checked paths:
-    module-level ``fit_*`` functions, ``transform_matrix`` methods, and
-    ``kneighbors`` methods (the KNN transform surface) in models/."""
-    offenders = []
-    for path in sorted((PKG / "models").glob("*.py")):
-        if path.name == "__init__.py":
-            continue
-        text = path.read_text()
-        targets = list(_def_bodies(text, r"def (?P<name>fit_\w+)\("))
-        targets += list(
-            _def_bodies(text, r"    def (?P<name>transform_matrix|kneighbors)\(")
-        )
-        for name, body in targets:
-            if "trace_span(" not in body:
-                offenders.append(f"{path.name}:{name}")
-    assert offenders == [], (
-        "model hot paths without a trace_span: " + ", ".join(offenders)
-    )
+    """MIGRATED to srml-check (`hot-path-span`): every model hot path —
+    module-level ``fit_*`` functions, ``transform_matrix`` and
+    ``kneighbors`` methods in models/ — must run under a ``trace_span``:
+    spans are the ONLY source of the per-phase breakdown (metrics
+    histogram + run journal, docs/observability.md). AST upgrade: the
+    def-body extraction is scope-exact instead of indentation-guessed."""
+    _rule_clean("hot-path-span")
 
 
 def test_metric_names_follow_the_convention():
@@ -204,8 +166,11 @@ def test_wire_ops_are_clamped_and_documented():
     the daemon dispatches must appear in BOTH ``_KNOWN_OPS`` (the
     metrics-label allowlist) and ``docs/protocol.md`` (the frozen wire
     contract), and answered ack-dict fields may only ever be ADDED
-    versus the checked-in tools/analyze_contract.json snapshot."""
-    _rule_clean("wire-op-clamp", "ack-contract")
+    versus the checked-in tools/analyze_contract.json snapshot — now
+    PER OP: `wire-schema` extracts each handler's request/ack fields
+    and fails on a removed field, a removed op, or a deleted
+    ``### <op>`` catalog entry in docs/protocol.md."""
+    _rule_clean("wire-op-clamp", "ack-contract", "wire-schema")
 
 
 def test_serve_config_keys_have_env_alias_and_docs():
@@ -321,43 +286,16 @@ def test_no_bare_collectives_outside_parallel():
 
 
 def test_every_jit_in_ops_and_models_is_ledgered():
-    """Every jit entry point in ops/ and models/ must register with the
-    jit ledger (``ledgered_jit(name, ...)`` — utils/xprof.py), the
-    mirror of the hot-path-spanned gate above: a bare ``jax.jit`` is
-    invisible to the device-cost attribution (compile seconds, flops,
-    bytes) that every perf PR is judged with. Also pins ledger-name
-    hygiene: names are ``<area>.<fn>`` and unique ACROSS files — the
-    ledger is process-wide, and a cross-file collision silently merges
-    two unrelated entry points' accounting. Within one file, reuse is
-    deliberate and allowed: knn.py registers the host and device build
-    variants of the same logical op (ivf_assign/candidates/recenter)
-    under one name so their accounting pools."""
-    offenders = []
-    names = {}
-    # Both registration spellings: ledgered_jit("name", ...) and
-    # functools.partial(ledgered_jit, "name", ...).
-    name_re = re.compile(r"ledgered_jit\s*[(,]\s*\n?\s*[\"']([a-z0-9_.]+)[\"']")
-    for sub in ("ops", "models"):
-        for path in sorted((PKG / sub).glob("*.py")):
-            text = path.read_text()
-            for m in re.finditer(r"\bjax\.jit\s*\(", text):
-                line = text[: m.start()].count("\n") + 1
-                offenders.append(f"{path.relative_to(PKG.parent)}:{line}")
-            for m in name_re.finditer(text):
-                name = m.group(1)
-                where = f"{path.name}:{name}"
-                if not re.match(r"^[a-z0-9_]+\.[a-z0-9_]+$", name):
-                    offenders.append(f"{where} (ledger name not <area>.<fn>)")
-                prev = names.setdefault(name, path.name)
-                if prev != path.name:
-                    offenders.append(
-                        f"{where} (ledger name also registered in {prev})"
-                    )
-    assert len(names) >= 35, (
-        f"only {len(names)} ledgered entry points found in ops/ + models/ "
-        "— the registration pattern or this regex regressed"
-    )
-    assert offenders == [], (
-        "unledgered jax.jit (use utils.xprof.ledgered_jit) or bad ledger "
-        "names in ops//models/: " + ", ".join(offenders)
-    )
+    """MIGRATED to srml-check (`jit-ledger`): every jit entry point in
+    ops/ and models/ must register with the jit ledger
+    (``ledgered_jit(name, ...)`` — utils/xprof.py), the mirror of the
+    hot-path-spanned gate above: a bare ``jax.jit`` is invisible to the
+    device-cost attribution (compile seconds, flops, bytes) that every
+    perf PR is judged with. Ledger-name hygiene rides along: names are
+    ``<area>.<fn>`` and unique ACROSS files (the ledger is process-wide;
+    same-file reuse is the deliberate host/device-variant pooling). The
+    ≥35-entry self-check floor moved into the rule's strict_floors
+    branch. AST upgrade: registrations are found as call nodes, so a
+    renamed alias or an oddly-wrapped partial can no longer dodge the
+    regex."""
+    _rule_clean("jit-ledger")
