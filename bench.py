@@ -44,6 +44,18 @@ relative to a steady pass, and a bitwise check against an uninterrupted
 fit on the surviving topology; tools/perfcheck.py gates
 recovery-cost regressions against the CHAOS_r* trajectory.
 
+``python bench.py --chaos-grow`` (or SRML_BENCH_CHAOS_GROW=1) runs the
+mirror-image ELASTIC-GROW micro-benchmark: a 2-daemon hub-protocol
+kmeans fit that a third daemon JOINS at a pass boundary (one creating
+set_iterate carrying the boundary iterate — docs/protocol.md "Mid-fit
+daemon join"), runs grown for the middle passes, then shrinks back to
+two at the next boundary. The record carries time-to-admit, the
+rebalanced-row count, the grow overhead relative to a steady pass, and
+a bitwise check against an uninterrupted static-topology fit;
+tools/perfcheck.py check_chaos_grow gates it against the CHAOS_r*
+trajectory (the two chaos families share the glob; mode+metric filters
+separate them).
+
 ``python bench.py --forest`` (or SRML_BENCH_FOREST=1) runs the
 TREE-ENSEMBLE benchmark: a RandomForest classifier fit (quantile
 binning + fused per-depth histogram accumulate + vectorized split
@@ -806,6 +818,169 @@ def chaos_elastic_bench() -> None:
     print(json.dumps(record))
 
 
+def chaos_grow_bench() -> None:
+    """``--chaos-grow``: the scale-UP micro-record for the elastic fit
+    (docs/protocol.md "Mid-fit daemon join") — the mirror image of
+    ``--chaos-elastic``'s 3→2 degrade.
+
+    Two in-process daemons drive a hub-protocol kmeans fit; at the first
+    pass boundary a THIRD daemon appears and is admitted the way the
+    estimator's grow path admits it — one creating ``set_iterate``
+    carrying the boundary iterate plus the algo/n_cols/params creation
+    fields (the same PR 4 ledger replay uses) — and a third of the
+    partitions rebalance onto it for the middle passes. At the next
+    boundary the fleet shrinks back to two (the joiner's partials are
+    merged at the boundary, then it simply stops being routed to and is
+    stopped), so one record exercises grow AND shrink. Integer-valued
+    data makes every fold exact, so the record self-verifies: the grown
+    2→3→2 fit's centers must be bitwise-equal to an uninterrupted fit on
+    the static 2-daemon topology. Reported: ``time_to_admit_s`` (the
+    admission handshake alone), ``rebalanced_rows`` (rows moved onto the
+    joiner), ``grow_overhead`` (admit + first grown pass / steady pass).
+    One JSON line; perfcheck's ``check_chaos_grow`` gates correctness
+    absolutely and the cost numbers against the CHAOS_r* trajectory."""
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+    from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+
+    d = int(os.environ.get("SRML_BENCH_GROW_D", 64))
+    k = int(os.environ.get("SRML_BENCH_GROW_K", 8))
+    part_rows = int(os.environ.get("SRML_BENCH_GROW_PART_ROWS", 32768))
+    passes = max(int(os.environ.get("SRML_BENCH_GROW_PASSES", 3)), 3)
+    n_parts = 6
+    rng = np.random.default_rng(7)
+    centers0 = rng.integers(-12, 13, size=(k, d)) * 4
+    n = n_parts * part_rows
+    x = (
+        centers0[rng.integers(0, k, size=(n,))]
+        + rng.integers(-1, 2, size=(n, d))
+    ).astype(np.float64)
+    parts = [np.ascontiguousarray(p) for p in np.array_split(x, n_parts)]
+    seed_batch = x[: 32 * k]
+    params = {"k": k, "seed": 11}
+
+    def client(daemon):
+        return DataPlaneClient(
+            *daemon.address, timeout=60.0, max_op_attempts=2,
+            backoff_base_s=0.02, backoff_max_s=0.2,
+        )
+
+    def feed_pass(job, routing, it):
+        for pid, c in routing.items():
+            c.feed(job, parts[pid], algo="kmeans", partition=pid,
+                   pass_id=it, params=params)
+            c.commit(job, partition=pid, pass_id=it)
+
+    def reduce_step_sync(job, primary, peers):
+        for pc in peers:
+            arrays, meta = pc.export_state(job)
+            primary.merge_state(
+                job, arrays, rows=int(meta["pass_rows"]), algo="kmeans",
+                n_cols=d, params=params,
+            )
+        info = primary.step(job)
+        arrays, it_n = primary.get_iterate(job)
+        for pc in peers:
+            pc.set_iterate(job, arrays, it_n)
+        return info, (arrays, it_n)
+
+    record: dict = {
+        "metric": f"chaos_grow_admit_rows_per_s_d{d}_k{k}",
+        "unit": "rows/s",
+        "mode": "chaos_grow",
+        "n_daemons": 2,
+        "n_grown": 3,
+        "rows": n,
+        "passes": passes,
+    }
+    da = DataPlaneDaemon(ttl=3600.0).start()
+    dc_ = DataPlaneDaemon(ttl=3600.0).start()
+    ca, cc = client(da), client(dc_)
+    db = None
+    cb = None
+    try:
+        # Oracle: the static 2-daemon topology, uninterrupted — also
+        # the steady-pass clock the grow overhead is measured against.
+        job = "grow-oracle"
+        steady = []
+        for c in (ca, cc):
+            c.seed_kmeans(job, seed_batch, k=k, params=params)
+        routing2 = {pid: (cc if pid >= 3 else ca) for pid in range(n_parts)}
+        for it in range(passes):
+            t0 = time.perf_counter()
+            feed_pass(job, routing2, it)
+            reduce_step_sync(job, ca, [cc])
+            steady.append(time.perf_counter() - t0)
+        oracle, _ = ca.finalize(job, {}, drop=False)
+        ca.drop(job)
+        steady_pass_s = min(steady)
+
+        # Grown run: pass 0 on two daemons, then the joiner appears at
+        # the boundary and takes partitions 2-3 for the middle passes.
+        job = "grow-elastic"
+        for c in (ca, cc):
+            c.seed_kmeans(job, seed_batch, k=k, params=params)
+        feed_pass(job, routing2, 0)
+        _, ledger = reduce_step_sync(job, ca, [cc])
+
+        t0 = time.perf_counter()
+        db = DataPlaneDaemon(ttl=3600.0).start()
+        cb = client(db)
+        # The admission handshake: ONE creating set_iterate seeds the
+        # joiner with the boundary iterate (same creation fields the
+        # quarantine-replay ledger carries) — no seed_kmeans, no feed.
+        admit_t0 = time.perf_counter()
+        arrays, it_n = ledger
+        cb.set_iterate(job, arrays, it_n, algo="kmeans", n_cols=d,
+                       params=params)
+        time_to_admit = time.perf_counter() - admit_t0
+        routing3 = {
+            pid: (cc if pid >= 4 else cb if pid >= 2 else ca)
+            for pid in range(n_parts)
+        }
+        rebalanced_rows = sum(
+            len(parts[pid]) for pid, c in routing3.items() if c is cb
+        )
+        feed_pass(job, routing3, 1)
+        _, ledger = reduce_step_sync(job, ca, [cb, cc])
+        time_to_grow = time.perf_counter() - t0
+
+        # Grown middle passes, then shrink at the boundary: the
+        # joiner's partials were merged by the reduce above, so the
+        # last pass simply routes around it — no rewind, no replay.
+        for it in range(2, passes - 1):
+            feed_pass(job, routing3, it)
+            reduce_step_sync(job, ca, [cb, cc])
+        cb.close()
+        cb = None
+        db.stop()
+        db = None
+        feed_pass(job, routing2, passes - 1)
+        reduce_step_sync(job, ca, [cc])
+        grown, _ = ca.finalize(job, {}, drop=False)
+        ca.drop(job)
+        cc.drop(job)
+
+        record.update({
+            "value": round(rebalanced_rows / time_to_grow, 1),
+            "time_to_admit_s": round(time_to_admit, 4),
+            "time_to_grow_s": round(time_to_grow, 4),
+            "rebalanced_rows": rebalanced_rows,
+            "steady_pass_s": round(steady_pass_s, 4),
+            "grow_overhead": round(time_to_grow / steady_pass_s, 3),
+            "bitwise_equal_oracle": bool(
+                np.array_equal(grown["centers"], oracle["centers"])
+            ),
+        })
+    finally:
+        for c in (ca, cb, cc):
+            if c is not None:
+                c.close()
+        for daemon in (da, db, dc_):
+            if daemon is not None:
+                daemon.stop()
+    print(json.dumps(record))
+
+
 def forest_bench() -> None:
     """``--forest``: histogram tree-ensemble throughput (the first
     non-GEMM workload record — FOREST_r*).
@@ -1503,6 +1678,10 @@ if __name__ == "__main__":
         "SRML_BENCH_CHAOS_ELASTIC", ""
     ) in ("1", "true"):
         chaos_elastic_bench()
+    elif "--chaos-grow" in sys.argv or os.environ.get(
+        "SRML_BENCH_CHAOS_GROW", ""
+    ) in ("1", "true"):
+        chaos_grow_bench()
     elif "--serve" in sys.argv or os.environ.get("SRML_BENCH_SERVE", "") in (
         "1", "true"
     ):

@@ -289,6 +289,36 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_version_strict": _env_named(
         "SRML_SERVE_VERSION_STRICT", True, _as_bool
     ),
+    # Serve autoscaler (serve/autoscaler.py; docs/protocol.md "Serve
+    # autoscaler"): a control loop over telemetry the fleet already
+    # emits (scheduler queue depth + sheds, replica busy state, routed
+    # p99) that scales the replica set through the register→warm→flip→
+    # drain rollout — scale-down never drops an in-flight request. Env
+    # keys are deployment-facing (SRML_AUTOSCALE_*), like SRML_FLEET_*.
+    # Scale UP when queued requests per live replica crosses this.
+    "autoscale_high_watermark": _env_named(
+        "SRML_AUTOSCALE_HIGH_WATERMARK", 8.0, float
+    ),
+    # Scale DOWN when queued requests per live replica falls below this
+    # (the gap to the high watermark is the hysteresis band — a load
+    # that sits between the two never trips an action).
+    "autoscale_low_watermark": _env_named(
+        "SRML_AUTOSCALE_LOW_WATERMARK", 1.0, float
+    ),
+    # Minimum seconds between ACTIONS: a load flapping at a watermark
+    # trips at most one scale per cooldown window.
+    "autoscale_cooldown_s": _env_named("SRML_AUTOSCALE_COOLDOWN_S", 30.0, float),
+    # Control-loop poll interval.
+    "autoscale_tick_s": _env_named("SRML_AUTOSCALE_TICK_S", 2.0, float),
+    # Replica-count floor/ceiling the loop may never cross.
+    "autoscale_min_replicas": _env_named("SRML_AUTOSCALE_MIN_REPLICAS", 1, int),
+    "autoscale_max_replicas": _env_named("SRML_AUTOSCALE_MAX_REPLICAS", 8, int),
+    # Optional latency objective: routed p99 (estimated from the
+    # srml_router_request_seconds histogram) above this forces a
+    # high-watermark verdict even at a quiet queue. 0 = off.
+    "autoscale_p99_deadline_s": _env_named(
+        "SRML_AUTOSCALE_P99_DEADLINE_S", 0.0, float
+    ),
     # Served-model registry cap (0 = unbounded): past it, the least-
     # recently-used re-creatable registration is evicted (clients
     # re-register on miss); daemon-built KNN indexes are evicted only
@@ -323,6 +353,29 @@ _DEFAULTS: Dict[str, Any] = {
     # spark.srml.fit.daemon_death_timeout_s.
     "fit_daemon_death_timeout_s": _env(
         "FIT_DAEMON_DEATH_TIMEOUT_S", 15.0, float
+    ),
+    # Elastic-fit GROW policy (spark/estimator.py; docs/protocol.md
+    # "Mid-fit daemon join") — the inverse direction of the death policy
+    # above: whether a daemon that appears MID-FIT (Spark dynamic
+    # allocation granting an executor, a spot host coming up) may be
+    # admitted into a running fit. "off" (default) keeps today's
+    # contract byte-for-byte: an unlisted peer fails its tasks loudly
+    # (centers/iterate unseeded) and no discovery probe ever runs.
+    # "boundary" admits new daemons at the NEXT pass boundary only —
+    # never mid-pass — by seeding them with the ledger's boundary
+    # iterate, so grown fits stay bitwise-equal to a static-topology
+    # fit. Env keys are deployment-facing (SRML_FIT_*), like
+    # SRML_SERVE_*; also via spark.srml.fit.daemon_join_policy.
+    "fit_daemon_join_policy": _env_named(
+        "SRML_FIT_DAEMON_JOIN_POLICY", "off", str
+    ),
+    # Join budget: how many daemons one fit may admit mid-fit. A newly
+    # configured daemon past the budget fails the fit loudly (the loss-
+    # tolerance contract, mirrored) instead of silently staying outside
+    # the topology while executors route rows at it. Also via
+    # $SRML_FIT_DAEMON_JOIN_LIMIT / spark.srml.fit.daemon_join_limit.
+    "fit_daemon_join_limit": _env_named(
+        "SRML_FIT_DAEMON_JOIN_LIMIT", 2, int
     ),
     # Histogram tree ensembles (models/random_forest.py; docs/protocol.md
     # "The `rf` job algo"). Env keys are deployment-facing (SRML_FOREST_*),

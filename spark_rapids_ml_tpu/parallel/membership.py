@@ -45,11 +45,18 @@ class MeshMembership:
         a re-registration of a known id IS an incarnation change, and
         every in-flight fit that saw the old epoch must re-resolve."""
         with self._lock:
+            self._epoch += 1
             self._members[str(member_id)] = {
                 "boot_id": str(boot_id),
                 "handle": weakref.ref(handle),
+                # The epoch this incarnation joined AT — a member whose
+                # joined_epoch postdates a fit's first mesh_info read is
+                # a MID-FIT joiner (docs/protocol.md "Mid-fit daemon
+                # join"); the snapshot carries it so the driver and
+                # tools/top can tell newcomers from founders without a
+                # second registry.
+                "joined_epoch": self._epoch,
             }
-            self._epoch += 1
             return self._epoch
 
     def unregister(self, member_id: str, boot_id: Optional[str] = None) -> int:
@@ -75,12 +82,16 @@ class MeshMembership:
         to bump the epoch from a read path, making two concurrent
         snapshots disagree on it)."""
         with self._lock:
-            members: List[Dict[str, str]] = []
+            members: List[Dict[str, Any]] = []
             # sorted(): the members list reaches wire acks (mesh_info) —
             # registration order varies per process and must not leak.
             for mid, m in sorted(self._members.items()):
                 if m["handle"]() is not None:
-                    members.append({"id": mid, "boot_id": m["boot_id"]})
+                    members.append({
+                        "id": mid,
+                        "boot_id": m["boot_id"],
+                        "joined_epoch": int(m["joined_epoch"]),
+                    })
             return {"epoch": self._epoch, "members": members}
 
     def get(self, member_id: str, boot_id: Optional[str] = None):

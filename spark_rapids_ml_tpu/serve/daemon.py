@@ -2037,6 +2037,7 @@ class DataPlaneDaemon:
         ) or None
         self._active_conns = 0
         self._conn_socks: set = set()
+        self._conn_threads: set = set()
         self._conns_lock = threading.Lock()
         self._started = self._clock()
         # Self-reported identity: host:port spellings alias (localhost vs
@@ -2161,6 +2162,26 @@ class DataPlaneDaemon:
                 s.close()
             except OSError:
                 pass
+        # ... and WAIT for the connection threads to unwind (bounded).
+        # A thread that just acked its last request still owes trailing
+        # side effects — the op span's journal line, request metrics —
+        # and a stop() that returns before they land races every
+        # stopped-then-inspect sequence (tests reading the journal file
+        # the moment the daemon scope closes; an autoscaler draining a
+        # replica then releasing its host). The sockets are already shut
+        # above, so each thread is unwinding; the deadline only bounds a
+        # thread parked in a long device dispatch.
+        with self._conns_lock:
+            conn_threads = list(self._conn_threads)
+        deadline = self._clock() + 5.0
+        me = threading.current_thread()
+        for t in conn_threads:
+            if t is me:
+                continue
+            try:
+                t.join(timeout=max(0.0, deadline - self._clock()))
+            except RuntimeError:
+                pass  # registered by the acceptor but not yet started
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         if self._reaper_thread is not None:
@@ -2625,10 +2646,13 @@ class DataPlaneDaemon:
                 conn, addr = self._sock.accept()
             except OSError:
                 return  # socket closed
-            threading.Thread(
+            t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True,
                 name=f"srml-dataplane-{addr[1]}",
-            ).start()
+            )
+            with self._conns_lock:
+                self._conn_threads.add(t)
+            t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         with self._conns_lock:
@@ -2647,6 +2671,7 @@ class DataPlaneDaemon:
             with self._conns_lock:
                 self._active_conns -= 1
                 self._conn_socks.discard(conn)
+                self._conn_threads.discard(threading.current_thread())
 
     def _serve_conn_inner(self, conn: socket.socket) -> None:
         with conn:
@@ -3447,6 +3472,12 @@ class DataPlaneDaemon:
                     f"no such job {name!r} (a recovery set_iterate that "
                     "should recreate it must carry n_cols/algo/params)"
                 )
+            # Grow-path chaos site (docs/protocol.md "Mid-fit daemon
+            # join"): the creating set_iterate IS the admission
+            # handshake — a joiner that crashes or stalls HERE must
+            # leave the driver's membership untouched (the admit loop
+            # registers nothing until this op acks).
+            faults.checkpoint("daemon.join")
             job = _Job(
                 str(_opt(req, "algo", "pca")), int(n_cols), self._mesh,
                 req.get("params"), clock=self._clock,

@@ -300,6 +300,107 @@ class ModelFleet:
                 "drained": drained,
             }
 
+    # -- elastic membership (serve/autoscaler.py drives these) --------------
+
+    def scale_out(self, endpoint, warm: bool = True) -> Dict[str, Any]:
+        """Admit a new replica daemon into the fleet: register AND warm
+        every model's ACTIVE version on it first, then add it to the
+        ring — admission is the flip (router.RoutingTable.add_replica),
+        so the first request routed to the newcomer finds a warm
+        registration. The payloads come from the routing table's
+        version entries (the same source the in-band repair uses); a
+        newcomer that fails any registration is NOT admitted."""
+        if isinstance(endpoint, str):
+            host, _, port = endpoint.rpartition(":")
+            host, port = host or "127.0.0.1", int(port)
+        else:
+            host, port = endpoint[0], int(endpoint[1])
+        key = f"{host}:{port}"
+        with self._lock:
+            seeded: List[str] = []
+            c = DataPlaneClient(
+                host, port, token=self._token, **self._client_kwargs
+            )
+            try:
+                for model in self._table.models():
+                    v, _, reg_name = self._table.snapshot(model)
+                    info = self._table.version_info(model, v)
+                    c.ensure_model(
+                        reg_name, info["algo"], info["arrays"],
+                        params=info["params"], version=v,
+                    )
+                    width = _model_width(info["algo"], info["arrays"])
+                    if warm and width is not None:
+                        c.warmup(reg_name, n_cols=width, dtype="float32")
+                    _M_REGISTRATIONS.inc(outcome="ok")
+                    seeded.append(model)
+            except (OSError, protocol.ProtocolError, RuntimeError) as e:
+                _M_REGISTRATIONS.inc(outcome="error")
+                c.close()
+                raise FleetRolloutError(
+                    f"replica {key} failed pre-admission seeding of "
+                    f"{model!r} — not admitted: {e}"
+                ) from e
+            self._table.add_replica((host, port))
+            self._clients[key] = c
+            n = len(self._table.replicas())
+            for model in seeded:
+                _M_REPLICAS.set(n, model=model)
+            logger.info(
+                "scaled OUT: replica %s admitted with %d model(s) "
+                "seeded and warm (%d replicas in the ring)",
+                key, len(seeded), n,
+            )
+            return {"replica": key, "models": seeded, "replicas": n}
+
+    def scale_in(
+        self,
+        key: Optional[str] = None,
+        drain_timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Retire one replica without dropping a request: remove it
+        from the ring (no NEW request routes to it), then roll every
+        active model forward one version on the REMAINING replicas —
+        the rollout's drain barrier waits out every request pinned to
+        the old version, including those in flight on the victim, and
+        only then drops the old registrations. Returns ``{"replica",
+        "drained", "rollouts"}``; ``drained=False`` means some pinned
+        request outlived the timeout — the victim daemon must stay UP
+        until a later drain finishes (stopping it would be the dropped
+        request the barrier exists to prevent).
+
+        With no ``key`` the least-loaded live replica is chosen."""
+        if key is None:
+            live = [r for r in self._table.replicas() if r.alive]
+            if not live:
+                raise ValueError("no live replica to scale in")
+            key = min(live, key=lambda r: (r.load(), r.key)).key
+        self._table.remove_replica(key)
+        rollouts: Dict[str, Any] = {}
+        drained = True
+        for model in self._table.models():
+            v, _, _ = self._table.snapshot(model)
+            info = self._table.version_info(model, v)
+            res = self.rollout(
+                model, info["algo"], info["arrays"],
+                params=info["params"], drain_timeout_s=drain_timeout_s,
+            )
+            rollouts[model] = res
+            drained = drained and bool(res["drained"])
+        with self._lock:
+            c = self._clients.pop(key, None)
+            if c is not None:
+                c.close()
+            n = len(self._table.replicas())
+        logger.info(
+            "scaled IN: replica %s retired (%d replicas remain; "
+            "drained=%s)", key, n, drained,
+        )
+        return {
+            "replica": key, "drained": drained, "rollouts": rollouts,
+            "replicas": n,
+        }
+
     # -- observability ------------------------------------------------------
 
     def status(self, model: Optional[str] = None) -> Dict[str, Any]:

@@ -162,7 +162,7 @@ class _Replica:
     Mutated only under the owning table's lock."""
 
     __slots__ = ("key", "host", "port", "alive", "recheck_at", "health",
-                 "health_ts", "last_error")
+                 "health_ts", "last_error", "retired", "inflight")
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, int(port)
@@ -172,13 +172,29 @@ class _Replica:
         self.health: Dict[str, Any] = {}
         self.health_ts = 0.0
         self.last_error: Optional[str] = None
+        # Scale-in tombstone: a retired replica left the ring (no NEW
+        # request routes to it) but its entry survives, so an in-flight
+        # request that snapshotted the OLD ring can still resolve the
+        # key it routed to — removal must never turn a live request
+        # into a KeyError.
+        self.retired = False
+        # Routed requests currently executing against THIS replica
+        # (begin_replica/done_replica) — the router's live work-in-system
+        # view, distinct from the per-VERSION refcounts the drain
+        # barrier uses. The autoscaler's default telemetry reads it as
+        # the offered-load signal: health's ``queue_depth`` counts open
+        # CONNECTIONS (idle fleet clients keep theirs open), which
+        # would read as permanent load and pin the controller at "up".
+        self.inflight = 0
 
     def load(self) -> float:
-        """Comparable load score from the last health snapshot: open
-        connections + queued scheduler requests (both grow under
-        pressure); a busy replica sorts after every non-busy one."""
+        """Comparable load score: live in-flight routed requests plus
+        the last health snapshot's open connections + queued scheduler
+        requests (all grow under pressure); a busy replica sorts after
+        every non-busy one."""
         h = self.health
-        q = float(h.get("queue_depth", 0) or 0)
+        q = float(self.inflight)
+        q += float(h.get("queue_depth", 0) or 0)
         sched = h.get("scheduler") or {}
         q += float(sched.get("queued", 0) or 0)
         if h.get("busy"):
@@ -219,11 +235,10 @@ class RoutingTable:
         if len(set(keys)) != len(keys):
             raise ValueError(f"duplicate replica endpoints: {sorted(keys)}")
         self._replicas: Dict[str, _Replica] = {r.key: r for r in reps}
-        self.ring = ConsistentHashRing(
-            keys,
-            vnodes=int(config.get("fleet_vnodes") if vnodes is None
-                       else vnodes),
+        self._vnodes = int(
+            config.get("fleet_vnodes") if vnodes is None else vnodes
         )
+        self.ring = ConsistentHashRing(keys, vnodes=self._vnodes)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         #: model → {"active": int|None, "epoch": int,
@@ -233,11 +248,66 @@ class RoutingTable:
     # -- replicas ----------------------------------------------------------
 
     def replicas(self) -> List[_Replica]:
+        """The CURRENT fleet members (retired scale-in tombstones are
+        excluded — the control plane must not register new versions on
+        a replica that already left the ring)."""
         with self._lock:
-            return list(self._replicas.values())
+            return [r for r in self._replicas.values() if not r.retired]
 
     def replica(self, key: str) -> _Replica:
         return self._replicas[key]
+
+    def _rebuild_ring_locked(self) -> None:
+        """Swap in a fresh ring over the non-retired members. The ring
+        object itself stays immutable — readers grab ``self.ring`` once
+        (one atomic attribute load) and route against a consistent
+        snapshot; membership changes move only ~1/N of the key space."""
+        keys = [k for k, r in self._replicas.items() if not r.retired]
+        self.ring = ConsistentHashRing(keys, vnodes=self._vnodes)
+
+    def add_replica(self, endpoint) -> str:
+        """Elastic scale-UP (serve/autoscaler.py): admit a new replica
+        into the ring. The caller (ModelFleet.scale_out) registers and
+        warms every active model version on it FIRST — admission is the
+        flip, so the first request routed here finds a warm
+        registration, never a cold daemon. Re-admitting a retired key
+        clears its tombstone. Returns the replica key."""
+        if isinstance(endpoint, str):
+            host, _, port = endpoint.rpartition(":")
+            r = _Replica(host or "127.0.0.1", int(port))
+        else:
+            r = _Replica(endpoint[0], int(endpoint[1]))
+        with self._lock:
+            existing = self._replicas.get(r.key)
+            if existing is not None and not existing.retired:
+                raise ValueError(f"replica {r.key} is already in the fleet")
+            # A re-admitted endpoint gets a FRESH entry: the tombstone's
+            # stale health/dead-state must not haunt the newcomer.
+            self._replicas[r.key] = r
+            self._rebuild_ring_locked()
+        return r.key
+
+    def remove_replica(self, key: str) -> None:
+        """Elastic scale-DOWN: retire a replica from the ring so no NEW
+        request routes to it. In-flight requests that already routed
+        there finish normally (the entry survives as a tombstone; the
+        daemon itself is only stopped after the version-drain barrier —
+        ModelFleet.scale_in). The last live replica cannot be removed:
+        an empty ring would make every request unroutable."""
+        with self._lock:
+            r = self._replicas.get(key)
+            if r is None or r.retired:
+                raise KeyError(f"no live replica {key!r} in the fleet")
+            live = sum(
+                1 for rep in self._replicas.values() if not rep.retired
+            )
+            if live <= 1:
+                raise ValueError(
+                    f"cannot remove {key!r}: it is the last replica in "
+                    "the ring"
+                )
+            r.retired = True
+            self._rebuild_ring_locked()
 
     def mark_dead(self, key: str, error: str, recheck_s: float) -> None:
         with self._lock:
@@ -359,6 +429,30 @@ class RoutingTable:
             entry = self._models.get(model)
             return sorted(entry["versions"]) if entry else []
 
+    def models(self) -> List[str]:
+        """Model names with an ACTIVE version — the set a scale-out
+        must re-seed on a joining replica (ModelFleet.scale_out)."""
+        with self._lock:
+            return sorted(
+                m for m, e in self._models.items()
+                if e["active"] is not None
+            )
+
+    def begin_replica(self, key: str) -> None:
+        """Count a routed request in on ``key`` (see _Replica.inflight);
+        unknown keys no-op — a replica removed mid-request still gets
+        its ``done_replica`` via the same tolerant path."""
+        with self._lock:
+            r = self._replicas.get(key)
+            if r is not None:
+                r.inflight += 1
+
+    def done_replica(self, key: str) -> None:
+        with self._lock:
+            r = self._replicas.get(key)
+            if r is not None and r.inflight > 0:
+                r.inflight -= 1
+
     def begin(self, model: str, version: int) -> None:
         with self._lock:
             self._models[model]["versions"][int(version)]["inflight"] += 1
@@ -421,9 +515,12 @@ class FleetClient:
             config.get("fleet_failover_attempts")
             if failover_attempts is None else failover_attempts
         )
-        # 0 = one attempt per replica: every member gets exactly one
-        # chance before the request is declared unroutable.
-        self._attempts = n if n > 0 else len(table.ring.members)
+        # 0 = one attempt per replica: every CURRENT member gets exactly
+        # one chance before the request is declared unroutable — read
+        # per request, not frozen at construction, so a client created
+        # before an autoscaler grew the fleet failovers across the
+        # grown membership too.
+        self._attempts = n if n > 0 else None
         # Inner-client defaults tuned for FAILOVER, not solo healing: a
         # busy shed must surface immediately (max_busy_wait_s=0 — the
         # router's reroute IS the retry), and a dead replica must fail
@@ -599,44 +696,51 @@ class FleetClient:
         key = self._route_key(route_key)
         last_err: Optional[BaseException] = None
         tried = 0
+        attempts = self._attempts or len(self._table.ring.members)
         try:
             with journal.span(
                 f"router.{kind}", model=model, version=version, epoch=epoch,
             ):
                 for rk in self._candidates(key):
-                    if tried >= self._attempts:
+                    if tried >= attempts:
                         break
                     tried += 1
                     repaired = False
-                    while True:
-                        try:
-                            out = attempt_fn(
-                                self._client(rk), reg_name, version, epoch
-                            )
-                            self._table.mark_alive(rk)
-                            self.stats[rk] = self.stats.get(rk, 0) + 1
-                            _M_REQUESTS.inc(op=kind, outcome="ok")
-                            return out
-                        except DaemonBusy as e:
-                            last_err = e
-                            _M_FAILOVERS.inc(reason="busy")
-                            break
-                        except (OSError, protocol.ProtocolError) as e:
-                            last_err = e
-                            _M_FAILOVERS.inc(reason="dead")
-                            self._table.mark_dead(rk, str(e), self._poll_s)
-                            break
-                        except RuntimeError as e:
-                            last_err = e
-                            if (
-                                not repaired
-                                and "no such model" in str(e)
-                                and self._repair(rk, model, version)
-                            ):
-                                repaired = True
-                                continue  # retry THIS replica once
-                            _M_FAILOVERS.inc(reason="error")
-                            break
+                    self._table.begin_replica(rk)
+                    try:
+                        while True:
+                            try:
+                                out = attempt_fn(
+                                    self._client(rk), reg_name, version, epoch
+                                )
+                                self._table.mark_alive(rk)
+                                self.stats[rk] = self.stats.get(rk, 0) + 1
+                                _M_REQUESTS.inc(op=kind, outcome="ok")
+                                return out
+                            except DaemonBusy as e:
+                                last_err = e
+                                _M_FAILOVERS.inc(reason="busy")
+                                break
+                            except (OSError, protocol.ProtocolError) as e:
+                                last_err = e
+                                _M_FAILOVERS.inc(reason="dead")
+                                self._table.mark_dead(
+                                    rk, str(e), self._poll_s
+                                )
+                                break
+                            except RuntimeError as e:
+                                last_err = e
+                                if (
+                                    not repaired
+                                    and "no such model" in str(e)
+                                    and self._repair(rk, model, version)
+                                ):
+                                    repaired = True
+                                    continue  # retry THIS replica once
+                                _M_FAILOVERS.inc(reason="error")
+                                break
+                    finally:
+                        self._table.done_replica(rk)
             _M_REQUESTS.inc(op=kind, outcome="unroutable")
             raise FleetUnavailable(
                 f"no replica could serve {kind} for {model!r} v{version} "

@@ -206,6 +206,50 @@ def daemon_death_timeout_s(spark=None) -> float:
     )
 
 
+def daemon_join_policy(spark=None) -> str:
+    """Elastic-fit GROW policy (spark/estimator.py; docs/protocol.md
+    "Mid-fit daemon join"): whether a daemon that appears mid-fit may be
+    admitted into a running fit. ``off`` (the default) keeps the
+    unlisted-peer loud rejection byte-for-byte and runs no discovery
+    probe; ``boundary`` admits new daemons at the next pass boundary
+    only, seeded from the recovery ledger. An unrecognized value warns
+    and reads as ``off`` — a typo must not silently open the admission
+    door. Sources: ``$SRML_FIT_DAEMON_JOIN_POLICY`` /
+    ``spark.srml.fit.daemon_join_policy`` /
+    ``config "fit_daemon_join_policy"``."""
+
+    def _policy(v) -> str:
+        v = str(v).strip().lower()
+        if v not in ("off", "boundary"):
+            raise ValueError(v)
+        return v
+
+    try:
+        return _env_conf_config(
+            spark, "SRML_FIT_DAEMON_JOIN_POLICY",
+            "spark.srml.fit.daemon_join_policy",
+            "fit_daemon_join_policy", _policy, floor=None,
+        )
+    except (TypeError, ValueError):
+        # Every source (including the config default's last-resort
+        # cast) was invalid — admission stays closed.
+        return "off"
+
+
+def daemon_join_limit(spark=None) -> int:
+    """The join budget: how many daemons one fit may admit mid-fit
+    before a further newcomer fails the fit loudly (the
+    ``daemon_loss_tolerance`` contract, mirrored for growth). Sources:
+    ``$SRML_FIT_DAEMON_JOIN_LIMIT`` /
+    ``spark.srml.fit.daemon_join_limit`` /
+    ``config "fit_daemon_join_limit"``."""
+    return _env_conf_config(
+        spark, "SRML_FIT_DAEMON_JOIN_LIMIT",
+        "spark.srml.fit.daemon_join_limit",
+        "fit_daemon_join_limit", int, floor=0,
+    )
+
+
 def resolve_all(spark=None) -> list:
     """The full daemon set for fits that must know every peer BEFORE the
     first scan (kmeans: centers are seeded on all daemons up front).

@@ -38,6 +38,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu import config
 from spark_rapids_ml_tpu.spark import daemon_session
+from spark_rapids_ml_tpu.utils import faults
 from spark_rapids_ml_tpu.utils import journal
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
@@ -74,6 +75,17 @@ _M_FIT_REROUTES = metrics_mod.counter(
     "srml_fit_reroutes_total",
     "Feed passes rerun on the shrunken topology after a daemon loss — "
     "the dead daemon's partitions reroute to survivors, by algo",
+)
+_M_FIT_JOINS = metrics_mod.counter(
+    "srml_fit_joins_total",
+    "Daemons admitted into a RUNNING fit at a pass boundary "
+    "(fit_daemon_join_policy=boundary; docs/protocol.md 'Mid-fit "
+    "daemon join'), by algo",
+)
+_M_FIT_REBALANCED = metrics_mod.counter(
+    "srml_fit_rebalanced_rows_total",
+    "Rows the task layer rebalanced onto mid-fit joiners on their "
+    "first acked pass after admission, by algo",
 )
 
 
@@ -851,7 +863,19 @@ class _SparkAdapter:
         loss_tolerance = daemon_session.daemon_loss_tolerance(spark)
         death_timeout = daemon_session.daemon_death_timeout_s(spark)
         elastic = loss_tolerance > 0
-        ledger_on = bool(rec_attempts) or elastic
+        # Elastic grow (docs/protocol.md "Mid-fit daemon join"): the
+        # inverse direction — whether a daemon that APPEARS mid-fit
+        # (dynamic allocation, a spot host coming up) may be admitted
+        # at the next pass boundary. "off" (default) keeps the
+        # unlisted-peer loud rejection byte-for-byte and runs no
+        # discovery probe; the ledger arms for it like for the death
+        # policy, because admission IS a boundary replay: the joiner is
+        # seeded with the ledger iterate and the failed pass reruns on
+        # the grown topology.
+        join_policy = daemon_session.daemon_join_policy(spark)
+        join_limit = daemon_session.daemon_join_limit(spark)
+        grow = join_policy == "boundary"
+        ledger_on = bool(rec_attempts) or elastic or grow
         job = f"{core.uid}-{uuid.uuid4().hex[:8]}"
         input_col = core.getOrDefault(
             "inputCol" if core.hasParam("inputCol") else "featuresCol"
@@ -895,6 +919,12 @@ class _SparkAdapter:
         # acks rows from it fails loudly (it is alive with unrewound
         # state; the routing must stop feeding it).
         quarantined: dict = {}
+        # Mid-fit joiners (id → address), and the subset whose first
+        # post-admission acked pass has not landed yet — the rebalanced-
+        # rows metric counts exactly that first pass (the rows the task
+        # layer actually moved onto the newcomer).
+        joined: dict = {}
+        awaiting_rebalance: set = set()
 
         def peer_client(did, addr=None):
             c = peer_clients.get(did)
@@ -1123,6 +1153,13 @@ class _SparkAdapter:
                                 "daemon."
                             )
                         peers[did] = daemon_session._parse_addr(addr_of[did])
+                # The grow metric's ground truth: the first pass a
+                # joiner actually acks rows for IS the rebalance — the
+                # task layer moved those rows onto the newcomer.
+                for did in sorted(awaiting_rebalance):
+                    if per.get(did, 0) > 0:
+                        _M_FIT_REBALANCED.inc(per[did], algo=str(algo))
+                        awaiting_rebalance.discard(did)
                 # Incarnation fence AFTER peer registration (recover()
                 # must know every daemon this pass touched, so it can
                 # rewind/drop them all) but BEFORE any merge: partials
@@ -1230,6 +1267,116 @@ class _SparkAdapter:
                     return True
                 except Exception:
                     return False
+
+            def try_admit(err) -> bool:
+                """The grow policy's admission step (docs/protocol.md
+                "Mid-fit daemon join"), run only after a pass unit
+                already failed — a new daemon's unseeded-job rejection
+                of its first feeds IS the detection signal, and the
+                happy path stays zero-overhead (one env/conf re-read,
+                no wire ops unless a genuinely new address appears).
+                Re-reads the configured daemon set (Spark dynamic
+                allocation re-points ``spark.srml.daemon.addresses``),
+                identifies addresses that resolve to an instance id
+                this fit does not know, and admits each at the CURRENT
+                pass boundary: ``set_iterate`` seeds it with the ledger
+                iterate (the same algo/n_cols/params creation fields a
+                quarantine replay uses — the job is created from
+                nothing on the joiner), membership registration bumps
+                the mesh epoch daemon-side so the next collective
+                reduce re-fences, and the caller's ``recover`` rewinds
+                every daemon to the same boundary before the replay
+                rebalances partitions onto the newcomer. True = at
+                least one daemon admitted (replay on the grown
+                topology); False = nothing new appeared — the loss
+                policy or the transient replay budget rules."""
+                if not grow or ledger["arrays"] is None:
+                    # No boundary iterate to seed a joiner from (a
+                    # single-pass algo, whose ack path already admits
+                    # unknown peers natively, or a pre-seed failure).
+                    return False
+                known = {f"{host}:{port}"}
+                known.update(f"{h2}:{p2}" for h2, p2 in peers.values())
+                known.update(a for a in quarantined.values() if a)
+                known.update(a for a in joined.values() if a)
+                candidates = [
+                    (ph, pp) for ph, pp in daemon_session.resolve_all(spark)
+                    if f"{ph}:{pp}" not in known
+                ]
+                admitted = []
+                for ph, pp in candidates:
+                    addr = f"{ph}:{pp}"
+                    pc = DataPlaneClient(ph, pp, token=token, **ckw)
+                    registered = False
+                    try:
+                        try:
+                            did = pc.server_id()
+                        except Exception:
+                            continue  # configured but not up yet
+                        # Alias fences, in the run_pass order: an
+                        # unknown ADDRESS may still be a spelling of a
+                        # daemon this fit already knows.
+                        if not did or did == primary_id or did in peers:
+                            continue
+                        if did in quarantined:
+                            # A dead daemon's address re-answering with
+                            # the same id is the quarantine safety
+                            # valve's territory, not a joiner.
+                            continue
+                        if len(joined) + 1 > join_limit:
+                            raise RuntimeError(
+                                f"daemon {addr} ({did}) appeared mid-fit "
+                                f"but this fit's join budget is spent "
+                                f"(fit_daemon_join_limit={join_limit}, "
+                                f"{len(joined)} already admitted). Raise "
+                                "the limit, or stop routing executors "
+                                "to it until the next fit."
+                            ) from err
+                        # The admission handshake: seed the joiner with
+                        # the boundary iterate. A joiner that vanishes
+                        # UNDER the handshake must not half-join — the
+                        # set_iterate failure surfaces here, nothing
+                        # was registered, and the original error's
+                        # replay path resumes without it.
+                        faults.checkpoint("daemon.join")
+                        arrays = ledger["arrays"]
+                        n_cols = int(
+                            arrays["centers"].shape[1]
+                            if "centers" in arrays
+                            else arrays["bin_edges"].shape[0]
+                            if "bin_edges" in arrays
+                            else arrays["w"].shape[0]
+                        )
+                        pc.set_iterate(
+                            job, arrays, int(ledger["iteration"]),
+                            algo=wire_algo, n_cols=n_cols,
+                            params=feed_params,
+                        )
+                        peers[did] = (ph, pp)
+                        addr_by_id[did] = addr
+                        peer_clients[did] = pc
+                        registered = True
+                        joined[did] = addr
+                        awaiting_rebalance.add(did)
+                        admitted.append(did)
+                        _M_FIT_JOINS.inc(algo=str(algo))
+                        journal.mark(
+                            "fit daemon join", algo=algo, job=job,
+                            daemon=did, addr=addr,
+                            iteration=int(ledger["iteration"]),
+                        )
+                        logger.warning(
+                            "fit elastic grow (%s): daemon %s (%s) "
+                            "admitted at the pass-%d boundary — seeded "
+                            "with the ledger iterate; replaying the "
+                            "failed pass on the %d-daemon topology",
+                            algo, addr, did, int(ledger["iteration"]),
+                            len(peers) + 1,
+                        )
+                    finally:
+                        if not registered:
+                            pc.close()
+                return bool(admitted)
 
             def try_quarantine(err) -> bool:
                 """The death policy's classification step, run only after
@@ -1422,6 +1569,20 @@ class _SparkAdapter:
                             NotImplementedError):
                         raise  # deterministic — a replay cannot help
                     except Exception as e:
+                        # Grow first: a failure caused by an unadmitted
+                        # newcomer (its unseeded-job rejections failed
+                        # the scan) is healed by ADMITTING it, and the
+                        # admission consumes the join budget — not the
+                        # transient replay budget, and never the loss
+                        # tolerance (every incumbent is alive).
+                        if grow and try_admit(e):
+                            with trace_span("elastic grow"):
+                                journal.mark(
+                                    "fit elastic-grow", algo=algo,
+                                    job=job, error=str(e)[:300],
+                                )
+                                recover(e)
+                            continue
                         if elastic and try_quarantine(e):
                             with trace_span("elastic degrade"):
                                 _M_FIT_REROUTES.inc(algo=str(algo))

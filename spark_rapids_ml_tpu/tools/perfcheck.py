@@ -441,6 +441,95 @@ def check_chaos_elastic(
     return ok, lines
 
 
+def check_chaos_grow(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --chaos-grow`` record (the 2→3→2 daemon
+    kmeans grow/shrink — docs/protocol.md "Mid-fit daemon join").
+    Correctness gates are ABSOLUTE — a record whose grown fit was not
+    bitwise-equal to the static-topology oracle, or that rebalanced no
+    rows onto the joiner, FAILS regardless of history. The COST gates
+    are trajectory-relative: admission throughput (``value``,
+    rebalanced rows / time-to-grow) must stay within ``max_regression``
+    of the metric-matched median, and ``grow_overhead`` (admit + first
+    grown pass / steady pass) must not grow past
+    (1 + max_regression) × its median. Grow records share the CHAOS_r*
+    glob with the degrade family; the mode+metric filter keeps the
+    trajectories separate. No history → cost gates SKIP with a note
+    (first record seeds the trajectory) — never a silent pass."""
+    lines: List[str] = []
+    if fresh.get("mode") != "chaos_grow":
+        return False, [
+            "record has no mode=chaos_grow — not a "
+            "bench.py --chaos-grow record?"
+        ]
+    ok = True
+    if not bool(fresh.get("bitwise_equal_oracle")):
+        ok = False
+        lines.append(
+            "grow correctness [FAIL] the grown 2→3→2 fit was NOT "
+            "bitwise-equal to the static-topology oracle — the "
+            "admission itself is broken; no cost number matters"
+        )
+    else:
+        lines.append(
+            "grow correctness [OK] grown fit bitwise-equal to the "
+            f"static {fresh.get('n_daemons')}-daemon oracle"
+        )
+    rebalanced = int(fresh.get("rebalanced_rows") or 0)
+    if rebalanced <= 0:
+        ok = False
+        lines.append(
+            "grow correctness [FAIL] record rebalanced 0 rows — the "
+            "joiner never took work"
+        )
+    matching = [
+        h for h in history
+        if h.get("mode") == "chaos_grow"
+        and h.get("metric") == fresh.get("metric")
+    ]
+    value = float(fresh.get("value") or 0.0)
+    overhead = fresh.get("grow_overhead")
+    if not matching:
+        lines.append(
+            f"grow cost [SKIP] no CHAOS_r* history matches metric "
+            f"{fresh.get('metric')!r} — recorded "
+            f"{fresh.get('time_to_admit_s')}s to admit "
+            f"({rebalanced:,} rows rebalanced; overhead {overhead}×), "
+            "nothing gated"
+        )
+        return ok, lines
+    base_v = _median([
+        float(h["value"]) for h in matching if h.get("value") is not None
+    ] or [value])
+    floor = (1.0 - max_regression) * base_v
+    verdict = "OK" if value >= floor else "REGRESSION"
+    lines.append(
+        f"admission throughput [{verdict}] {value:,.1f} rows/s vs median "
+        f"{base_v:,.1f} over {len(matching)} record(s) "
+        f"(gate at -{max_regression:.0%})"
+    )
+    if value < floor:
+        ok = False
+    ovs = [
+        float(h["grow_overhead"]) for h in matching
+        if h.get("grow_overhead") is not None
+    ]
+    if overhead is not None and ovs:
+        ceil = (1.0 + max_regression) * _median(ovs)
+        verdict = "OK" if float(overhead) <= ceil else "REGRESSION"
+        lines.append(
+            f"grow overhead [{verdict}] {float(overhead):.3f}x a "
+            f"steady pass vs ceiling {ceil:.3f}x "
+            f"(median {_median(ovs):.3f}x)"
+        )
+        if float(overhead) > ceil:
+            ok = False
+    return ok, lines
+
+
 def check_forest(
     fresh: Dict[str, Any],
     history: List[Dict[str, Any]],
@@ -648,12 +737,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     fleet = str(fresh.get("metric", "")).startswith("serve_fleet_")
     chaos = str(fresh.get("metric", "")).startswith("chaos_elastic_")
+    grow = str(fresh.get("metric", "")).startswith("chaos_grow_")
     forest = str(fresh.get("metric", "")).startswith("forest_")
     kernels = str(fresh.get("metric", "")).startswith("kernel_")
     default_glob = (
         "KERNELS_r*.json" if kernels
         else "FOREST_r*.json" if forest
-        else "CHAOS_r*.json" if chaos
+        else "CHAOS_r*.json" if chaos or grow
         else "FLEET_r*.json" if fleet
         else "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
     )
@@ -668,6 +758,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     elif chaos:
         ok, lines = check_chaos_elastic(
+            fresh, history, max_regression=args.max_regression,
+        )
+    elif grow:
+        ok, lines = check_chaos_grow(
             fresh, history, max_regression=args.max_regression,
         )
     elif fleet:

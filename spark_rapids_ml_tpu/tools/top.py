@@ -27,11 +27,15 @@ deployment. The single-address view is unchanged.
 from __future__ import annotations
 
 import argparse
-import math
 import os
 import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+# The quantile estimator lives in utils/metrics.py (the serve
+# autoscaler's p99 objective reads the SAME interpolation this panel
+# renders); the name stays importable from here for existing callers.
+from spark_rapids_ml_tpu.utils.metrics import quantile_from_buckets  # noqa: F401
 
 REQ = "srml_daemon_requests_total"
 LAT = "srml_daemon_request_seconds"
@@ -49,32 +53,14 @@ SCHED_PADDED = "srml_scheduler_padded_rows_total"
 SCHED_MISSES = "srml_scheduler_compile_misses_total"
 SCHED_HITS = "srml_scheduler_compile_hits_total"
 SCHED_SHEDS = "srml_scheduler_sheds_total"
+AUTO_LAST = "srml_autoscale_last_decision"
+AUTO_LOAD = "srml_autoscale_load"
+AUTO_WATERMARK = "srml_autoscale_watermark"
+AUTO_COOLDOWN = "srml_autoscale_cooldown_seconds"
+AUTO_REPLICAS = "srml_autoscale_replicas"
+AUTO_ACTIONS = "srml_autoscale_actions_total"
 
 
-def quantile_from_buckets(buckets: Dict[str, int], q: float) -> Optional[float]:
-    """Estimate the q-quantile (0 < q < 1) from CUMULATIVE le→count
-    buckets (the snapshot/Prometheus shape), linearly interpolating
-    inside the target bucket. None when empty; the +Inf bucket clamps to
-    the largest finite bound (no upper edge to interpolate against)."""
-    pairs: List[Tuple[float, int]] = sorted(
-        (math.inf if le == "+Inf" else float(le), n)
-        for le, n in buckets.items()
-    )
-    if not pairs or pairs[-1][1] <= 0:
-        return None
-    total = pairs[-1][1]
-    target = q * total
-    prev_bound, prev_count = 0.0, 0
-    for bound, count in pairs:
-        if count >= target:
-            if math.isinf(bound):
-                return prev_bound
-            if count == prev_count:
-                return bound
-            frac = (target - prev_count) / (count - prev_count)
-            return prev_bound + frac * (bound - prev_bound)
-        prev_bound, prev_count = (0.0 if math.isinf(bound) else bound), count
-    return prev_bound
 
 
 def _fmt_bytes(n: float) -> str:
@@ -199,6 +185,10 @@ def render(
     if sched:
         lines.append("")
         lines.extend(sched)
+    autoscale = _autoscale_lines(snap)
+    if autoscale:
+        lines.append("")
+        lines.extend(autoscale)
     phases = _hist_by_label(snap.get(PHASES), "phase")
     if phases:
         lines.append("")
@@ -270,6 +260,52 @@ def _sched_lines(health: Dict[str, Any], snap: Dict[str, Any]) -> List[str]:
                 f"{int(misses.get(op, 0)):>5}/{int(hits.get(op, 0)):<4}"
                 f"{int(sheds.get(op, 0)):>7}"
             )
+    return lines
+
+
+def _autoscale_lines(snap: Dict[str, Any]) -> List[str]:
+    """The autoscaler panel (docs/protocol.md "Serve autoscaler"): last
+    decision, live load against the high/low watermarks, replica count,
+    cooldown remaining, and cumulative action tallies — all read from
+    the gauges/counters the AutoScaler publishes, so the panel works
+    over any daemon sharing its metrics registry. Empty when no
+    autoscaler has ever run in the scraped process."""
+    last = _hist_by_label(snap.get(AUTO_LAST), "verdict")
+    if not last:
+        return []
+    decision = next(
+        (v for v in sorted(last) if float(last[v].get("value", 0.0)) >= 1.0),
+        "-",
+    )
+    marks = _hist_by_label(snap.get(AUTO_WATERMARK), "bound")
+
+    def _gauge(name: str) -> float:
+        return sum(
+            float(s.get("value", 0.0))
+            for s in (snap.get(name) or {}).get("samples", [])
+        )
+
+    head = (
+        f"autoscaler  decision {decision}"
+        f"  load {_gauge(AUTO_LOAD):.2f}"
+        f" (low {float(marks.get('low', {}).get('value', 0.0)):.2f}"
+        f" / high {float(marks.get('high', {}).get('value', 0.0)):.2f})"
+        f"  replicas {int(_gauge(AUTO_REPLICAS))}"
+        f"  cooldown {_gauge(AUTO_COOLDOWN):.1f}s"
+    )
+    lines = [head]
+    actions: Dict[str, float] = {}
+    for s in (snap.get(AUTO_ACTIONS) or {}).get("samples", []):
+        key = "%s/%s" % (
+            s["labels"].get("action", "?"),
+            s["labels"].get("outcome", "?"),
+        )
+        actions[key] = actions.get(key, 0.0) + float(s.get("value", 0.0))
+    if actions:
+        lines.append(
+            "  actions "
+            + "  ".join(f"{k}:{int(n)}" for k, n in sorted(actions.items()))
+        )
     return lines
 
 

@@ -32,10 +32,24 @@ fires):
                           the permanent-loss site; elastic-fit chaos
                           tests pair it with NO restart
                           (docs/protocol.md "Permanent daemon loss")
+``daemon.join``           the mid-fit admission handshake, both ends
+                          (spark/estimator.py before the joiner's
+                          seeding set_iterate; serve/daemon.py on the
+                          job-creating set_iterate path): a vanish/drop
+                          here is a daemon dying DURING its admission —
+                          the grow chaos tests prove a half-admitted
+                          joiner never enters membership
+                          (docs/protocol.md "Mid-fit daemon join")
 ``daemon.scheduler``      serving-scheduler admission (serve/scheduler.py):
                           a drop/refuse here is translated into a shed —
                           the request is answered with the busy/
                           retry_after_s contract, never queued
+``autoscale.action``      serve/autoscaler.py, between a scale decision
+                          and its rollout action: a fault here is the
+                          controller dying (or being refused) after
+                          deciding but before acting — the loop must
+                          count the failure and retry on a later tick,
+                          never half-scale
 ``wire.send_frame``       every outbound frame, both directions (partial/drop)
 ``bridge.to_matrix``      Arrow list column → matrix conversion
 ``bridge.to_ipc``         matrix/table → Arrow IPC encode (client feed path)

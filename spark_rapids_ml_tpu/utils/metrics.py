@@ -46,6 +46,7 @@ __all__ = [
     "snapshot",
     "render_prometheus",
     "reset",
+    "quantile_from_buckets",
 ]
 
 #: Default latency buckets (seconds): sub-millisecond host ops through
@@ -65,6 +66,38 @@ def _enabled() -> bool:
     from spark_rapids_ml_tpu import config
 
     return bool(config.peek("metrics"))
+
+
+def quantile_from_buckets(buckets: Dict[str, int], q: float
+                          ) -> Optional[float]:
+    """Estimate the q-quantile (0 < q < 1) from CUMULATIVE le→count
+    buckets (the snapshot/Prometheus shape), linearly interpolating
+    inside the target bucket. None when empty; the +Inf bucket clamps
+    to the largest finite bound (no upper edge to interpolate against).
+    The ONE estimator both consumers of the snapshot shape use —
+    tools/top's latency columns and the serve autoscaler's p99
+    objective must read the SAME number from the same histogram."""
+    import math
+
+    pairs: List[Tuple[float, int]] = sorted(
+        (math.inf if le == "+Inf" else float(le), n)
+        for le, n in buckets.items()
+    )
+    if not pairs or pairs[-1][1] <= 0:
+        return None
+    total = pairs[-1][1]
+    target = q * total
+    prev_bound, prev_count = 0.0, 0
+    for bound, count in pairs:
+        if count >= target:
+            if math.isinf(bound):
+                return prev_bound
+            if count == prev_count:
+                return bound
+            frac = (target - prev_count) / (count - prev_count)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_count = (0.0 if math.isinf(bound) else bound), count
+    return prev_bound
 
 
 def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:

@@ -1,0 +1,550 @@
+"""Serve autoscaler tests (serve/autoscaler.py; ISSUE 16,
+docs/protocol.md "Serve autoscaler").
+
+The load-bearing claims, in test order:
+
+* **hysteresis + cooldown units** (synthetic telemetry, injected
+  clock) — a load flapping AT a watermark trips exactly one action per
+  cooldown window; the band between the watermarks is a hold; shed
+  deltas and p99-over-deadline force a high crossing regardless of the
+  queue; the min/max replica bounds turn verdicts into ``bounded``
+  non-actions; a failed action (the ``autoscale.action`` fault site)
+  never half-scales and does NOT consume the cooldown — it retries on a
+  later tick;
+* **scale-down drain barrier** (real fleet, live traffic) — a direct
+  ``scale_in`` under concurrent requests loses ZERO requests: the
+  victim leaves the ring first, the per-model rollout's drain barrier
+  waits out every pinned in-flight request, and only then may the
+  victim daemon be stopped;
+* **load-spike flagship** (real fleet + real traffic, the autoscaler's
+  own thread) — offered load triples and the fleet scales itself up
+  with ZERO operator action while p99 stays under the deadline; the
+  load falls away and the fleet drains itself back down, still without
+  a single failed request.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve import (
+    DataPlaneDaemon,
+    ModelFleet,
+)
+from spark_rapids_ml_tpu.serve.autoscaler import AutoScaler
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.autoscale
+
+D = 16
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leaks():
+    yield
+    faults.deactivate()
+    assert faults.active_plan() is None
+
+
+def _counter(name, **labels):
+    snap = metrics_mod.snapshot()
+    total = 0.0
+    for s in (snap.get(name) or {}).get("samples", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += float(s.get("value", 0.0))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# synthetic-telemetry units: a fake fleet, a hand-cranked clock
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, key):
+        self.key, self.alive, self.health = key, True, {}
+
+    def load(self):
+        return 0.0
+
+
+class _FakeTable:
+    def __init__(self, n):
+        self._r = [_FakeReplica(f"10.0.0.{i}:7000") for i in range(n)]
+
+    def replicas(self):
+        return list(self._r)
+
+
+class _FakeFleet:
+    """Counts scale actions; mutates its replica set like the real one."""
+
+    def __init__(self, n):
+        self.table = _FakeTable(n)
+        self.outs = []
+        self.ins = []
+        self.drained = True
+
+    def scale_out(self, endpoint):
+        r = _FakeReplica(str(endpoint))
+        self.table._r.append(r)
+        self.outs.append(str(endpoint))
+        return {"replica": r.key, "replicas": len(self.table._r)}
+
+    def scale_in(self, key=None):
+        victim = self.table._r.pop()
+        self.ins.append(victim.key)
+        return {
+            "replica": victim.key, "drained": self.drained,
+            "rollouts": {}, "replicas": len(self.table._r),
+        }
+
+
+def _scaler(fleet, sample, clock, **kw):
+    """An AutoScaler on synthetic telemetry: ``sample`` is a mutable
+    dict the test edits between ticks; replicas always tracks the fake
+    fleet so the load signal divides by live capacity."""
+    kw.setdefault("high_watermark", 5.0)
+    kw.setdefault("low_watermark", 1.0)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("tick_s", 0.01)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    counter = iter(range(10 ** 6))
+
+    def telemetry():
+        return dict(sample, replicas=len(fleet.table.replicas()))
+
+    return AutoScaler(
+        fleet, spawn=lambda: f"10.0.1.{next(counter)}:7000",
+        telemetry=telemetry, clock=clock, **kw,
+    )
+
+
+def test_hold_band_between_watermarks_never_acts():
+    """The hysteresis band: any load strictly between the watermarks is
+    a hold — no crossing, no action, however long it persists."""
+    fleet = _FakeFleet(2)
+    t = [0.0]
+    sample = {"queued": 6.0, "sheds_total": 0.0, "p99_s": None}  # load 3.0
+    sc = _scaler(fleet, sample, lambda: t[0])
+    for _ in range(20):
+        d = sc.tick()
+        assert d["verdict"] == "hold" and d["action"] == "none"
+        t[0] += 1.0
+    assert fleet.outs == [] and fleet.ins == []
+
+
+def test_flap_at_watermark_one_action_per_cooldown_window():
+    """THE hysteresis claim: a load flapping right at the high watermark
+    every tick produces exactly ONE scale action per cooldown window —
+    crossings and decisions keep counting, the fleet is not churned."""
+    fleet = _FakeFleet(1)
+    t = [0.0]
+    sample = {"queued": 0.0, "sheds_total": 0.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0],
+                 high_watermark=5.0, low_watermark=1.0, cooldown_s=10.0)
+    dec0 = _counter("srml_autoscale_decisions_total")
+    # 30 seconds of one-second ticks, flapping across the watermark:
+    # windows [0,10), [10,20), [20,30) may each act at most once.
+    actions = []
+    for i in range(30):
+        n = len(fleet.table.replicas())
+        # flap: above the high watermark on even ticks, below on odd —
+        # scaled by capacity so growth does not quench the signal
+        sample["queued"] = 6.0 * n if i % 2 == 0 else 0.5 * n
+        d = sc.tick()
+        if d["action"] in ("scale_up", "scale_down"):
+            actions.append((t[0], d["action"]))
+        t[0] += 1.0
+    assert len(actions) == 3, actions  # one per 10s window, not one per flap
+    for (t1, _), (t2, _) in zip(actions, actions[1:]):
+        assert t2 - t1 >= 10.0
+    # pressure stayed visible while the controller held
+    assert _counter("srml_autoscale_decisions_total") - dec0 == 30
+
+
+def test_sheds_force_scale_up_regardless_of_queue():
+    """A positive shed delta means requests are ALREADY refused — the
+    verdict is up even when the instantaneous queue reads empty."""
+    fleet = _FakeFleet(2)
+    t = [0.0]
+    sample = {"queued": 0.0, "sheds_total": 5.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0])
+    d = sc.tick()  # first tick only baselines the shed counter
+    assert d["verdict"] == "down"  # load 0 <= low with no delta yet
+    t[0] += 11.0
+    sample["sheds_total"] = 9.0
+    d = sc.tick()
+    assert d["verdict"] == "up" and d["reason"] == "sheds"
+    assert d["action"] == "scale_up"
+    assert len(fleet.outs) == 1
+
+
+def test_p99_over_deadline_forces_scale_up():
+    fleet = _FakeFleet(2)
+    t = [0.0]
+    sample = {"queued": 4.0, "sheds_total": 0.0, "p99_s": 0.9}  # load 2: hold
+    sc = _scaler(fleet, sample, lambda: t[0], p99_deadline_s=0.5)
+    d = sc.tick()
+    assert d["verdict"] == "up" and d["reason"] == "p99"
+    assert len(fleet.outs) == 1
+    # deadline unset (the default 0.0) ignores p99 entirely
+    fleet2 = _FakeFleet(2)
+    sc2 = _scaler(fleet2, dict(sample), lambda: t[0], p99_deadline_s=0.0)
+    assert sc2.tick()["verdict"] == "hold"
+
+
+def test_replica_bounds_turn_verdicts_into_bounded():
+    """max_replicas caps growth and min_replicas floors shrinkage: the
+    verdict stands (pressure stays visible) but no action fires and no
+    cooldown is consumed."""
+    fleet = _FakeFleet(2)
+    t = [0.0]
+    sample = {"queued": 100.0, "sheds_total": 0.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0], max_replicas=2, min_replicas=2)
+    b0 = _counter("srml_autoscale_actions_total", outcome="bounded")
+    d = sc.tick()
+    assert d["verdict"] == "up" and d["action"] == "bounded"
+    sample["queued"] = 0.0
+    d = sc.tick()
+    assert d["verdict"] == "down" and d["action"] == "bounded"
+    assert fleet.outs == [] and fleet.ins == []
+    assert _counter("srml_autoscale_actions_total", outcome="bounded") \
+        - b0 == 2
+    assert sc.cooldown_remaining() == 0.0
+
+
+def test_action_fault_never_half_scales_and_retries():
+    """The autoscale.action fault site sits between decide and act: a
+    refused action leaves the fleet EXACTLY as it was, counts an error,
+    does NOT consume the cooldown, and the next tick retries."""
+    fleet = _FakeFleet(1)
+    t = [0.0]
+    sample = {"queued": 50.0, "sheds_total": 0.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0])
+    err0 = _counter("srml_autoscale_actions_total", outcome="error")
+    plan = FaultPlan(seed=7).rule("autoscale.action", "refuse", times=1)
+    with faults.active(plan):
+        d = sc.tick()
+    assert plan.fired.get("autoscale.action") == 1
+    assert d["action"] == "error"
+    assert fleet.outs == [] and len(fleet.table.replicas()) == 1
+    assert _counter("srml_autoscale_actions_total", outcome="error") \
+        - err0 == 1
+    assert sc.cooldown_remaining() == 0.0  # failure must not gate the retry
+    d = sc.tick()  # same clock instant: the retry needs no waiting
+    assert d["action"] == "scale_up" and len(fleet.outs) == 1
+
+
+def test_drain_callback_only_after_full_drain():
+    """scale_in reporting drained=False means pinned requests are still
+    in flight on the victim — releasing its host THEN would drop them,
+    so the drain hook must not run."""
+    released = []
+    fleet = _FakeFleet(3)
+    t = [0.0]
+    sample = {"queued": 0.0, "sheds_total": 0.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0], drain=released.append)
+    fleet.drained = False
+    d = sc.tick()
+    assert d["action"] == "scale_down" and released == []
+    t[0] += 11.0
+    fleet.drained = True
+    d = sc.tick()
+    assert d["action"] == "scale_down" and len(released) == 1
+    assert released[0] == fleet.ins[-1]
+
+
+def test_inverted_watermarks_rejected():
+    with pytest.raises(ValueError, match="hysteresis"):
+        _scaler(_FakeFleet(1), {}, time.monotonic,
+                high_watermark=1.0, low_watermark=2.0)
+
+
+def test_status_feeds_the_operator_panel():
+    fleet = _FakeFleet(2)
+    t = [0.0]
+    sample = {"queued": 100.0, "sheds_total": 0.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0])
+    sc.tick()
+    st = sc.status()
+    assert st["high_watermark"] == 5.0 and st["low_watermark"] == 1.0
+    assert st["replicas"] == 3  # the tick scaled 2 → 3
+    assert st["last_decision"]["verdict"] == "up"
+    assert st["last_action"]["action"] == "scale_up"
+    assert st["cooldown_remaining_s"] == 10.0
+    # the gauges the tools/top panel renders from are live too
+    snap = metrics_mod.snapshot()
+    for g in ("srml_autoscale_replicas", "srml_autoscale_load",
+              "srml_autoscale_cooldown_seconds", "srml_autoscale_watermark",
+              "srml_autoscale_last_decision"):
+        assert snap.get(g), f"{g} missing from the registry"
+
+
+def test_top_renders_autoscaler_panel():
+    """tools.top grows an autoscaler panel: last decision, load vs the
+    high/low watermarks, replica count, cooldown remaining, and action
+    tallies — all from the snapshot alone, no live scaler handle."""
+    from spark_rapids_ml_tpu.tools.top import render
+
+    fleet = _FakeFleet(2)
+    t = [0.0]
+    sample = {"queued": 100.0, "sheds_total": 0.0, "p99_s": None}
+    sc = _scaler(fleet, sample, lambda: t[0])
+    ups0 = _counter("srml_autoscale_actions_total",
+                    action="scale_up", outcome="ok")
+    sc.tick()  # up verdict → scale_up ok
+    out = render({"id": "d0"}, metrics_mod.snapshot())
+    panel = [ln for ln in out.splitlines() if ln.startswith("autoscaler")]
+    assert panel, "autoscaler panel missing from tools.top render"
+    head = panel[0]
+    assert "decision up" in head
+    assert "(low 1.00 / high 5.00)" in head
+    assert "replicas 3" in head
+    assert "cooldown 10.0s" in head
+    tally = f"scale_up/ok:{int(ups0) + 1}"
+    assert any(tally in ln for ln in out.splitlines())
+    # a snapshot with no autoscale series renders no dead panel
+    quiet = render({"id": "d0"}, {})
+    assert not any(ln.startswith("autoscaler") for ln in quiet.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# real fleet: scale-out seeding, the scale-in drain barrier
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def pca_arrays(rng, mesh8):
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    basis = rng.normal(size=(D, D)) * np.logspace(0, -1.5, D)
+    data = rng.normal(size=(400, D)) @ basis
+    m = PCA(mesh=mesh8).setK(3).fit({"features": data})
+    q = rng.normal(size=(12, D))
+    return {
+        "arrays": m._model_data(),
+        "q": q,
+        "ref": np.asarray(m.transform_matrix(q)["output"]),
+    }
+
+
+def test_scale_out_newcomer_is_warm_before_first_request(mesh8, pca_arrays):
+    """Admission is the flip: every active model version is registered
+    and warmed on the newcomer BEFORE it joins the ring, so the first
+    routed request never hits a no-such-model repair window."""
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+    d0 = DataPlaneDaemon(mesh=mesh8).start()
+    d1 = DataPlaneDaemon(mesh=mesh8).start()
+    try:
+        with ModelFleet([d0.address]) as fleet:
+            fleet.register("m", "pca", pca_arrays["arrays"], version=1)
+            res = fleet.scale_out(d1.address)
+            assert res["replicas"] == 2 and res["models"] == ["m"]
+            # the newcomer already holds the versioned registration
+            with DataPlaneClient(*d1.address) as c:
+                assert c.model_exists("m@v1")
+            # and serves bitwise-correct answers through the router
+            with fleet.client() as fc:
+                for i in range(12):
+                    out = fc.transform("m", pca_arrays["q"],
+                                       route_key=f"k{i}")
+                    assert np.array_equal(
+                        np.asarray(out["output"]), pca_arrays["ref"]
+                    )
+                assert sorted(fc.stats) == sorted(
+                    fleet.table.ring.members
+                )  # both replicas took traffic
+    finally:
+        d0.stop()
+        d1.stop()
+
+
+def test_scale_in_under_live_traffic_drops_nothing(mesh8, pca_arrays):
+    """The drain barrier under fire: concurrent clients keep routing
+    while a replica is retired. Every request — including those pinned
+    in flight to the victim — must succeed with the bitwise answer;
+    the victim daemon stays up until scale_in reports drained."""
+    daemons = [DataPlaneDaemon(mesh=mesh8).start() for _ in range(2)]
+    errors = []
+    answers = [0]
+    stop = threading.Event()
+    try:
+        with ModelFleet([d.address for d in daemons]) as fleet:
+            fleet.register("m", "pca", pca_arrays["arrays"], version=1)
+
+            def pound(i):
+                try:
+                    with fleet.client() as fc:
+                        j = 0
+                        while not stop.is_set():
+                            out = fc.transform(
+                                "m", pca_arrays["q"],
+                                route_key=f"c{i}-{j}",
+                            )
+                            if not np.array_equal(
+                                np.asarray(out["output"]),
+                                pca_arrays["ref"],
+                            ):
+                                raise AssertionError("wrong answer")
+                            j += 1
+                        answers[0] += j
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=pound, args=(i,)) for i in range(4)
+            ]
+            for th in threads:
+                th.start()
+            time.sleep(0.3)  # requests genuinely in flight
+            res = fleet.scale_in()
+            assert res["drained"] is True, res
+            assert res["replicas"] == 1
+            victim = next(
+                d for d in daemons
+                if f"{d.address[0]}:{d.address[1]}" == res["replica"]
+            )
+            time.sleep(0.3)  # traffic continues on the shrunken fleet
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+            victim.stop()  # only AFTER the drain barrier held
+        assert errors == [], errors[:3]
+        assert answers[0] > 0
+    finally:
+        stop.set()
+        for d in daemons:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# the load-spike flagship
+# ---------------------------------------------------------------------------
+
+
+def test_flagship_load_spike_scales_itself_zero_drops(mesh8, pca_arrays):
+    """ISSUE 16's serving acceptance: offered load triples and the
+    AUTOSCALER — not an operator — grows the fleet; p99 stays under the
+    deadline; when the load falls away the fleet drains itself back
+    down; and across the whole episode, including the scale-down,
+    not one request fails."""
+    daemons = {}
+
+    def spawn():
+        d = DataPlaneDaemon(mesh=mesh8).start()
+        key = f"{d.address[0]}:{d.address[1]}"
+        daemons[key] = d
+        return d.address
+
+    released = []
+
+    def drain(key):
+        released.append(key)
+        d = daemons.pop(key, None)
+        if d is not None:
+            d.stop()
+
+    first = spawn()
+    level = [2]  # offered concurrency, the telemetry's load signal
+    errors = []
+    lat = []
+    lat_lock = threading.Lock()
+    stop = threading.Event()
+    with ModelFleet([first]) as fleet:
+        fleet.register("m", "pca", pca_arrays["arrays"], version=1)
+
+        def telemetry():
+            live = [r for r in fleet.table.replicas() if r.alive]
+            return {
+                "replicas": len(live),
+                "queued": float(level[0]),
+                "busy": 0,
+                "sheds_total": 0.0,
+                "p99_s": None,
+            }
+
+        scaler = AutoScaler(
+            fleet, spawn, drain,
+            high_watermark=1.5, low_watermark=0.75,
+            cooldown_s=0.2, tick_s=0.05,
+            min_replicas=1, max_replicas=3,
+            telemetry=telemetry,
+        )
+
+        def pound(i):
+            try:
+                with fleet.client() as fc:
+                    j = 0
+                    while not stop.is_set():
+                        if i >= level[0]:  # offered load follows `level`
+                            time.sleep(0.01)
+                            continue
+                        t0 = time.perf_counter()
+                        out = fc.transform(
+                            "m", pca_arrays["q"], route_key=f"c{i}-{j}"
+                        )
+                        dt = time.perf_counter() - t0
+                        if not np.array_equal(
+                            np.asarray(out["output"]), pca_arrays["ref"]
+                        ):
+                            raise AssertionError("wrong answer")
+                        with lat_lock:
+                            lat.append(dt)
+                        j += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=pound, args=(i,)) for i in range(6)
+        ]
+        for th in threads:
+            th.start()
+
+        def live_count():
+            return len([r for r in fleet.table.replicas() if r.alive])
+
+        def wait_for(n, timeout=20.0):
+            t0 = time.monotonic()
+            while live_count() != n:
+                if time.monotonic() - t0 > timeout:
+                    raise AssertionError(
+                        f"fleet never reached {n} replicas "
+                        f"(at {live_count()}): {scaler.status()}"
+                    )
+                time.sleep(0.05)
+
+        try:
+            with scaler:  # the control loop runs itself — no operator
+                wait_for(2)  # load 2 / 1 replica = 2.0 >= 1.5 → grow
+                level[0] = 6  # the spike: offered load triples
+                wait_for(3)  # 6/2 = 3.0 → grow to the ceiling
+                time.sleep(0.5)  # serve the spike at full width
+                level[0] = 1  # the spike passes
+                wait_for(1)  # 1/3, 1/2 <= 0.75 → drain back down
+                time.sleep(0.3)  # traffic survives the shrunken fleet
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+    try:
+        assert errors == [], errors[:3]
+        assert len(released) == 2 and len(daemons) == 1
+        assert len(lat) > 0
+        lat.sort()
+        p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+        assert p99 < 2.0, f"p99 {p99:.3f}s blew the deadline"
+        # the episode is journaled as metrics, not just asserted here
+        assert _counter("srml_autoscale_actions_total", action="scale_up",
+                        outcome="ok") >= 2
+        assert _counter("srml_autoscale_actions_total", action="scale_down",
+                        outcome="ok") >= 2
+    finally:
+        for d in daemons.values():
+            d.stop()

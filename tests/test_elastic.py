@@ -485,3 +485,246 @@ def test_feed_task_evicts_quarantined_routes_worker_side(rng, mesh8,
         with DataPlaneClient(h, p) as c:
             c.drop(job)
         _evict_daemon_id_cache(job)
+
+
+# --------------------- mid-fit daemon JOIN (scale-UP) ------------------------
+# The grow direction (ISSUE 16; docs/protocol.md "Mid-fit daemon join"):
+# a daemon appearing mid-fit is admitted at the next pass boundary —
+# never mid-pass — seeded with the recovery-ledger iterate, and the
+# replayed pass rebalances partitions onto it. Routing model: partitions
+# 2,3 FIRST try the newcomer every pass (per-attempt failover back to
+# the primary — before admission the newcomer's unseeded-job rejection
+# fails the attempt and the rows land on the primary); the fit's
+# configured daemon set grows mid-fit via the fault callback, the way
+# Spark dynamic allocation re-points spark.srml.daemon.addresses.
+
+
+def _grow_env(addr_new, addr_fallback, addr_c):
+    return {
+        2: [{"SRML_DAEMON_ADDRESS": addr_new},
+            {"SRML_DAEMON_ADDRESS": addr_fallback}],
+        3: [{"SRML_DAEMON_ADDRESS": addr_new},
+            {"SRML_DAEMON_ADDRESS": addr_fallback}],
+        4: {"SRML_DAEMON_ADDRESS": addr_c},
+        5: {"SRML_DAEMON_ADDRESS": addr_c},
+    }
+
+
+def _grow_session(addr_a, addr_c):
+    return SimSparkSession({
+        "spark.srml.daemon.address": addr_a,
+        "spark.srml.daemon.addresses": f"{addr_a},{addr_c}",
+    })
+
+
+def _fit_kmeans_on(x, session, env_plan):
+    df = simdf_from_numpy(x, n_partitions=6, session=session,
+                          env_plan=env_plan, concurrency=1)
+    return SparkKMeans().setK(3).setMaxIter(3).setSeed(5).fit(df)
+
+
+def _grow_plan(session, a, b, c, seed=4):
+    """A boundary-sync failure (both client attempts dropped) whose
+    crash callback is the dynamic-allocation event: the newcomer's
+    address joins the fit's configured daemon set mid-fit."""
+    return (
+        FaultPlan(seed=seed)
+        .rule("daemon.vanish", "crash", after=1, times=2)
+        .on_crash(lambda: session.conf.set(
+            "spark.srml.daemon.addresses",
+            f"{_addr(a)},{_addr(b)},{_addr(c)}",
+        ))
+    )
+
+
+@pytest.mark.parametrize("collectives", [True, False],
+                         ids=["collective", "hub"])
+def test_kmeans_mid_fit_join_bitwise(rng, mesh8, monkeypatch, collectives,
+                                     three_daemons):
+    """THE grow tentpole on both reduce paths: a 2-daemon iterative fit,
+    a third daemon appears mid-fit (fault callback re-points the
+    configured addresses at a boundary failure), join policy `boundary`
+    admits it — seeded from the ledger iterate by ONE creating
+    set_iterate — and the replayed pass rebalances partitions 2,3 onto
+    it. The grown fit must be BITWISE-equal to a static-topology oracle,
+    and the join/rebalance telemetry must count exactly one join and
+    exactly the moved rows."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.setenv("SRML_FIT_DAEMON_JOIN_POLICY", "boundary")
+    joins0 = _counter_total("srml_fit_joins_total")
+    rebal0 = _counter_total("srml_fit_rebalanced_rows_total")
+    with config.option("mesh_collectives", collectives):
+        oracle = _fit_kmeans(
+            x, _addr(a), _survivor_env(_addr(c)),
+            addresses=f"{_addr(a)},{_addr(c)}",
+        )
+        session = _grow_session(_addr(a), _addr(c))
+        plan = _grow_plan(session, a, b, c)
+        with faults.active(plan):
+            m = _fit_kmeans_on(
+                x, session, _grow_env(_addr(b), _addr(a), _addr(c))
+            )
+    assert plan.fired.get("daemon.vanish") == 2, (
+        "the boundary failure never fired — the run proved nothing"
+    )
+    np.testing.assert_array_equal(m.centers, oracle.centers)
+    assert m.summary.trainingCost == oracle.summary.trainingCost
+    assert m.summary.numIter == oracle.summary.numIter
+    # zero lost rows: every pass still accounts the full dataset
+    assert m.summary.n_rows == x.shape[0]
+    assert _counter_total("srml_fit_joins_total") - joins0 == 1
+    # partitions 2 and 3 moved onto the joiner on its first acked pass
+    assert (_counter_total("srml_fit_rebalanced_rows_total") - rebal0
+            == x.shape[0] // 3)
+
+
+def test_join_policy_default_off_stays_loud(rng, mesh8, monkeypatch,
+                                            three_daemons):
+    """The acceptance pin: with fit_daemon_join_policy at its default
+    `off`, the same mid-fit appearance changes nothing — the boundary
+    failure is today's loud error, no daemon is admitted, no join
+    telemetry fires."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.delenv("SRML_FIT_DAEMON_JOIN_POLICY", raising=False)
+    joins0 = _counter_total("srml_fit_joins_total")
+    session = _grow_session(_addr(a), _addr(c))
+    plan = _grow_plan(session, a, b, c)
+    with faults.active(plan):
+        with pytest.raises(OSError):
+            _fit_kmeans_on(
+                x, session, _grow_env(_addr(b), _addr(a), _addr(c))
+            )
+    assert plan.fired.get("daemon.vanish") == 2
+    assert _counter_total("srml_fit_joins_total") == joins0
+
+
+def test_join_budget_exhausted_fails_loudly(rng, mesh8, monkeypatch,
+                                            three_daemons):
+    """Admitting MORE daemons than fit_daemon_join_limit grants must
+    surface a clear budget error, not a silent unbalanced fit: limit=0
+    under policy `boundary`."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.setenv("SRML_FIT_DAEMON_JOIN_POLICY", "boundary")
+    monkeypatch.setenv("SRML_FIT_DAEMON_JOIN_LIMIT", "0")
+    joins0 = _counter_total("srml_fit_joins_total")
+    session = _grow_session(_addr(a), _addr(c))
+    plan = _grow_plan(session, a, b, c)
+    with faults.active(plan):
+        with pytest.raises(RuntimeError, match="join budget"):
+            _fit_kmeans_on(
+                x, session, _grow_env(_addr(b), _addr(a), _addr(c))
+            )
+    assert _counter_total("srml_fit_joins_total") == joins0
+
+
+def test_join_fault_during_admission_no_half_join(rng, mesh8, monkeypatch,
+                                                  three_daemons):
+    """A joiner that fails UNDER the admission handshake (the
+    daemon.join fault site sits before its seeding set_iterate) must not
+    half-join: the fit surfaces the failure, nothing is registered, no
+    join is counted, and the would-be joiner holds no job."""
+    a, b, c = three_daemons
+    x = _int_blobs(rng)
+    monkeypatch.setenv("SRML_FIT_DAEMON_JOIN_POLICY", "boundary")
+    joins0 = _counter_total("srml_fit_joins_total")
+    session = _grow_session(_addr(a), _addr(c))
+    plan = _grow_plan(session, a, b, c).rule(
+        "daemon.join", "refuse", times=1
+    )
+    with faults.active(plan):
+        with pytest.raises(OSError):
+            _fit_kmeans_on(
+                x, session, _grow_env(_addr(b), _addr(a), _addr(c))
+            )
+    assert plan.fired.get("daemon.join") == 1, (
+        "the admission fault never fired — the run proved nothing"
+    )
+    assert _counter_total("srml_fit_joins_total") == joins0
+
+
+def test_perfcheck_chaos_grow_gates():
+    """The grow-cost gate's unit matrix (mirror of the chaos-elastic
+    one): correctness (bitwise vs the static-topology oracle, nonzero
+    rebalanced rows) is ABSOLUTE; admission throughput / grow overhead
+    gate against the metric-matched trajectory and SKIP — never pass —
+    without history; degrade-family records in the shared CHAOS_r* glob
+    never pollute the grow trajectory."""
+    from spark_rapids_ml_tpu.tools import perfcheck
+
+    good = {
+        "metric": "chaos_grow_admit_rows_per_s_d64_k8",
+        "mode": "chaos_grow", "value": 1000.0, "rebalanced_rows": 100,
+        "grow_overhead": 1.1, "bitwise_equal_oracle": True,
+        "n_daemons": 2, "time_to_admit_s": 0.01,
+    }
+    ok, lines = perfcheck.check_chaos_grow(good, [])
+    assert ok and any("SKIP" in ln for ln in lines)
+    ok, lines = perfcheck.check_chaos_grow(
+        dict(good, bitwise_equal_oracle=False), []
+    )
+    assert not ok and any("FAIL" in ln for ln in lines)
+    ok, _ = perfcheck.check_chaos_grow(dict(good, rebalanced_rows=0), [good])
+    assert not ok
+    ok, _ = perfcheck.check_chaos_grow(dict(good, value=500.0), [good])
+    assert not ok  # admission throughput regressed past the floor
+    ok, _ = perfcheck.check_chaos_grow(dict(good, grow_overhead=5.0), [good])
+    assert not ok  # growing got relatively MORE expensive
+    ok, _ = perfcheck.check_chaos_grow(dict(good), [good])
+    assert ok  # healthy vs its own trajectory
+    # A degrade-family record sharing the glob is filtered out: the
+    # grow gates still SKIP rather than compare across families.
+    elastic = {
+        "metric": "chaos_elastic_replay_rows_per_s_d64_k8",
+        "mode": "chaos_elastic", "value": 10.0,
+    }
+    ok, lines = perfcheck.check_chaos_grow(good, [elastic])
+    assert ok and any("SKIP" in ln for ln in lines)
+    ok, _ = perfcheck.check_chaos_grow({"metric": "x"}, [])
+    assert not ok  # not a chaos-grow record at all
+
+
+@pytest.mark.perf
+def test_bench_chaos_grow_smoke_and_gate(tmp_path):
+    """End-to-end: ``bench.py --chaos-grow`` at toy shapes emits one
+    self-verifying JSON record (bitwise_equal_oracle must hold even at
+    toy sizes — integer folds are exact at any scale) and the perfcheck
+    CLI routes it to the grow gate: correctness OK, cost SKIP (no
+    history), exit 0."""
+    import json as json_mod
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SRML_BENCH_GROW_PART_ROWS": "512",
+        "SRML_BENCH_GROW_D": "8",
+        "SRML_BENCH_GROW_K": "4",
+        "PYTHONPATH": os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p
+        ),
+    })
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--chaos-grow"],
+        cwd=repo_root, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json_mod.loads(line)
+    assert rec["mode"] == "chaos_grow"
+    assert rec["bitwise_equal_oracle"] is True
+    assert rec["rebalanced_rows"] > 0
+    assert rec["time_to_admit_s"] > 0
+
+    from spark_rapids_ml_tpu.tools import perfcheck
+
+    path = tmp_path / "rec.json"
+    path.write_text(line)
+    assert perfcheck.main(
+        [str(path), "--history", str(tmp_path / "no-history-*.json")]
+    ) == 0

@@ -53,9 +53,12 @@ def test_fault_checkpoints_exist_at_contract_sites():
     expect = {
         "serve/client.py": ["client.connect", "client.op"],
         "serve/daemon.py": ["daemon.conn", "daemon.op",
-                            "daemon.pass_boundary", "daemon.vanish"],
+                            "daemon.pass_boundary", "daemon.vanish",
+                            "daemon.join"],
         "serve/scheduler.py": ["daemon.scheduler"],
         "serve/protocol.py": ["wire.send_frame"],
+        "serve/autoscaler.py": ["autoscale.action"],
+        "spark/estimator.py": ["daemon.join"],
         "bridge/arrow.py": ["bridge.to_matrix", "bridge.to_ipc"],
     }
     for rel, sites in expect.items():
@@ -182,10 +185,15 @@ def test_serve_config_keys_have_env_alias_and_docs():
     wire-op clamp+docs gate): a knob cannot be added silently, without
     an env spelling or documentation. The fleet keys (``fleet_*`` +
     ``serve_version_*``) joined the gate with the fleet PR; the forest
-    keys (``forest_*``/``rf_*``) with the tree-ensemble PR."""
+    keys (``forest_*``/``rf_*``) with the tree-ensemble PR; the
+    elastic-scale keys (``autoscale_*`` + ``fit_daemon_join_*``) with
+    the scale-up PR (``fit_daemon_join`` specifically — the older
+    ``fit_daemon_loss_tolerance``/``fit_daemon_death_timeout_s`` keys
+    predate the gate and use the legacy SRML_TPU_ env prefix)."""
     text = (PKG / "config.py").read_text()
     keys = sorted(set(re.findall(
-        r'^\s+"((?:serve|fleet|rf|forest)_[a-z0-9_]+)"\s*:', text, re.M
+        r'^\s+"((?:serve|fleet|rf|forest|autoscale|fit_daemon_join)'
+        r'_[a-z0-9_]+)"\s*:', text, re.M
     )))
     assert len(keys) >= 5, (
         f"only {len(keys)} serve_*/fleet_*/forest_* config keys found — "
@@ -202,6 +210,14 @@ def test_serve_config_keys_have_env_alias_and_docs():
     assert any(k.startswith(("forest_", "rf_")) for k in keys), (
         "no forest_*/rf_* config keys found — the tree-ensemble config "
         "block or this regex regressed"
+    )
+    assert any(k.startswith("autoscale_") for k in keys), (
+        "no autoscale_* config keys found — the serve-autoscaler config "
+        "block or this regex regressed"
+    )
+    assert any(k.startswith("fit_daemon_join_") for k in keys), (
+        "no fit_daemon_join_* config keys found — the mid-fit join "
+        "config block or this regex regressed"
     )
     docs = (PKG.parent / "docs" / "protocol.md").read_text()
     missing_env = [k for k in keys if f"SRML_{k.upper()}" not in text]

@@ -82,6 +82,40 @@ def test_client_outside_any_run_stamps_nothing(mesh8, tmp_path):
     assert all(e["parent_id"] is None for e in ops)
 
 
+def test_stop_joins_connection_threads_flushing_trailing_writes(
+    mesh8, tmp_path
+):
+    """``stop()`` must WAIT for connection threads: the op span's journal
+    line (and the request's metrics) are written AFTER the ack is sent,
+    so a stop() that returns while a connection thread is still unwinding
+    races every stopped-then-inspect sequence — this very suite read
+    journal files the moment the daemon scope closed and flaked when the
+    trailing write lost the race. After the scope exits, the span line is
+    on disk and no connection thread survives."""
+    import threading
+
+    before = {
+        t for t in threading.enumerate()
+        if t.name.startswith("srml-dataplane-")
+    }
+    p = tmp_path / "flush.jsonl"
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(p)):
+            with DataPlaneClient(*d.address) as c:
+                c.feed("flush", np.ones((8, 3)), algo="pca")
+    # No sleep, no close(): the write must already have landed.
+    leftovers = [
+        t for t in threading.enumerate()
+        if t.name.startswith("srml-dataplane-") and t not in before
+    ]
+    assert not leftovers, f"connection threads outlived stop(): {leftovers}"
+    journal.close()
+    names = [
+        e["name"] for e in journal.read(str(p)) if e.get("event") == "phase"
+    ]
+    assert "daemon.feed" in names
+
+
 def test_daemon_op_span_parents_into_the_callers_frame(mesh8, tmp_path):
     """The core stitch: a client op issued inside a driver-side span
     lands the daemon's op span (and every model-phase span under it)
