@@ -56,6 +56,19 @@ tools/perfcheck.py check_chaos_grow gates it against the CHAOS_r*
 trajectory (the two chaos families share the glob; mode+metric filters
 separate them).
 
+``python bench.py --chaos-partition`` (or SRML_BENCH_CHAOS_PARTITION=1)
+runs the GOSSIP PARTITION-HEAL micro-benchmark: four daemons with live
+gossip threads split into two islands that never hear of each other;
+the losing island registers a model first, the winning island registers
+AND rolls it forward (dominant epochs, the old version tombstoned), a
+client bootstrapped from one losing-island seed routes traffic through
+the whole split, and the heal is a single bridge gossip_push. The
+record carries time-to-converge (bridge → all four FleetViews agree:
+one active version, one epoch, the stale version tombstoned everywhere,
+no resurrection) plus the routed/failed tallies from inside the split;
+tools/perfcheck.py check_chaos_partition gates correctness absolutely
+and convergence against the shared CHAOS_r* trajectory.
+
 ``python bench.py --forest`` (or SRML_BENCH_FOREST=1) runs the
 TREE-ENSEMBLE benchmark: a RandomForest classifier fit (quantile
 binning + fused per-depth histogram accumulate + vectorized split
@@ -981,6 +994,160 @@ def chaos_grow_bench() -> None:
     print(json.dumps(record))
 
 
+def chaos_partition_bench() -> None:
+    """``--chaos-partition``: the gossip partition-heal micro-record
+    for the fleet control plane (docs/protocol.md "Fleet gossip &
+    bootstrap") — the serving-plane sibling of the elastic chaos pair.
+
+    Four daemons with LIVE gossip threads form two islands that never
+    hear of each other (each island's controller only ever pushes to
+    its own pair, and gossip peers are drawn from each daemon's own
+    view, so the split needs no firewall). Island B registers the model
+    first; island A registers it and rolls it to v2 AFTER — so A's
+    records dominate under the ``(epoch, boot_id)`` merge rule and v1
+    carries a tombstone. A routed client bootstrapped from ONE island-B
+    seed serves traffic throughout the split; every response must
+    succeed and be bitwise-stable (a partition degrades freshness,
+    never correctness). The heal is ONE bridge ``gossip_push`` from an
+    island-A view into an island-B daemon; anti-entropy carries it the
+    rest of the way. Reported: ``time_to_converge_s`` (bridge push →
+    all four views agree: active v2, one record epoch, v1 tombstoned
+    everywhere, four live replicas — the record self-verifies that the
+    losing island's v1 never resurrects), plus the routed/failed/
+    mismatched traffic tallies from inside the split. One JSON line;
+    perfcheck's ``check_chaos_partition`` gates correctness absolutely
+    and convergence time against the CHAOS_r* trajectory."""
+    import threading
+
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+    from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
+    from spark_rapids_ml_tpu.serve.fleet import ModelFleet
+    from spark_rapids_ml_tpu.serve.router import FleetClient
+
+    d = int(os.environ.get("SRML_BENCH_PARTITION_D", 64))
+    k = int(os.environ.get("SRML_BENCH_PARTITION_K", 8))
+    rows = int(os.environ.get("SRML_BENCH_PARTITION_ROWS", 64))
+    interval = float(os.environ.get("SRML_BENCH_PARTITION_INTERVAL_S", 0.05))
+    fanout = int(os.environ.get("SRML_BENCH_PARTITION_FANOUT", 2))
+    split_s = float(os.environ.get("SRML_BENCH_PARTITION_SPLIT_S", 0.5))
+    deadline = float(os.environ.get("SRML_BENCH_PARTITION_DEADLINE_S", 30.0))
+    model = "bench-partition"
+
+    rng = np.random.default_rng(0)
+    # Fabricated projections (the fleet_bench idiom — a (d, k) payload
+    # needs no fit); v2 is a different shape so a flip is observable.
+    arrays_v1 = {
+        "pc": rng.standard_normal((d, k)).astype(np.float64),
+        "mean": np.zeros((d,), np.float64),
+    }
+    arrays_v2 = {
+        "pc": rng.standard_normal((d, k - 2)).astype(np.float64),
+        "mean": np.zeros((d,), np.float64),
+    }
+    q = rng.standard_normal((rows, d)).astype(np.float64)
+
+    record: dict = {
+        "metric": "chaos_partition_converge_d4",
+        "unit": "s",
+        "mode": "chaos_partition",
+        "n_daemons": 4,
+        "gossip_interval_s": interval,
+        "gossip_fanout": fanout,
+    }
+    daemons = [
+        DataPlaneDaemon(
+            ttl=3600.0, gossip_interval_s=interval, gossip_fanout=fanout,
+        ).start()
+        for _ in range(4)
+    ]
+    island_a, island_b = daemons[:2], daemons[2:]
+    stop = threading.Event()
+    routed = [0]
+    failed = [0]
+    mismatched = [0]
+
+    def traffic() -> None:
+        # A fresh operator box: ONE island-B seed, no endpoint roster.
+        ref = None
+        seed = "%s:%d" % island_b[0].address
+        with FleetClient.from_seeds([seed]) as fc:
+            while not stop.is_set():
+                try:
+                    got = np.asarray(
+                        fc.transform(model, q, route_key="bench")["output"]
+                    )
+                except Exception:
+                    failed[0] += 1
+                    continue
+                if ref is None:
+                    ref = got
+                elif not np.array_equal(got, ref):
+                    mismatched[0] += 1
+                routed[0] += 1
+
+    try:
+        # Island B first: its v1 records carry the OLDER epochs.
+        with ModelFleet([d_.address for d_ in island_b]) as fb:
+            fb.register(model, "pca", arrays_v1, version=1)
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        # Island A second, and it rolls forward — both controllers live
+        # in this process so they share one Lamport clock and A's
+        # register + rollout strictly dominate B's stale v1 records.
+        with ModelFleet([d_.address for d_ in island_a]) as fa:
+            fa.register(model, "pca", arrays_v1, version=1)
+            fa.rollout(model, "pca", arrays_v2, version=2, warm=False)
+        time.sleep(split_s)  # let traffic route inside the split
+        stop.set()
+        t.join(timeout=60)
+
+        def converged() -> bool:
+            epochs = set()
+            for dm in daemons:
+                rec = dm.fleet_view.model(model)
+                if rec is None or rec.get("active_version") != 2:
+                    return False
+                if rec.get("intent") is not None:
+                    return False
+                if "1" not in (rec.get("tombstones") or {}):
+                    return False
+                if len(dm.fleet_view.replicas(liveness="up")) != 4:
+                    return False
+                epochs.add(int(rec["epoch"]))
+            return len(epochs) == 1
+
+        # The heal: ONE bridge push A→B; the gossip threads do the rest.
+        t0 = time.perf_counter()
+        with DataPlaneClient(*island_b[0].address, timeout=10.0) as bridge:
+            bridge.gossip_push(island_a[0].fleet_view.to_wire())
+        while not converged():
+            if time.perf_counter() - t0 > deadline:
+                break
+            time.sleep(interval / 4)
+        time_to_converge = time.perf_counter() - t0
+
+        record.update({
+            "value": round(time_to_converge, 4),
+            "time_to_converge_s": round(time_to_converge, 4),
+            "converged": converged(),
+            "routed_during_partition": routed[0],
+            "failed_during_partition": failed[0],
+            "mismatched_during_partition": mismatched[0],
+            "tombstones_clean": all(
+                "1" in (dm.fleet_view.model(model) or {}).get(
+                    "tombstones", {}
+                )
+                for dm in daemons
+            ),
+            "split_s": split_s,
+        })
+    finally:
+        stop.set()
+        for dm in daemons:
+            dm.stop()
+    print(json.dumps(record))
+
+
 def forest_bench() -> None:
     """``--forest``: histogram tree-ensemble throughput (the first
     non-GEMM workload record — FOREST_r*).
@@ -1682,6 +1849,10 @@ if __name__ == "__main__":
         "SRML_BENCH_CHAOS_GROW", ""
     ) in ("1", "true"):
         chaos_grow_bench()
+    elif "--chaos-partition" in sys.argv or os.environ.get(
+        "SRML_BENCH_CHAOS_PARTITION", ""
+    ) in ("1", "true"):
+        chaos_partition_bench()
     elif "--serve" in sys.argv or os.environ.get("SRML_BENCH_SERVE", "") in (
         "1", "true"
     ):

@@ -282,6 +282,36 @@ _DEFAULTS: Dict[str, Any] = {
     "fleet_drain_timeout_s": _env_named(
         "SRML_FLEET_DRAIN_TIMEOUT_S", 30.0, float
     ),
+    # Fleet gossip plane (serve/gossip.py; docs/protocol.md "Fleet
+    # gossip & bootstrap"): daemons exchange FleetViews — replica
+    # records + per-model version tables — so fleet state survives any
+    # client's death. Env keys are deployment-facing (SRML_GOSSIP_* /
+    # SRML_FLEET_*), like SRML_DAEMON_STATE_DIR.
+    # Seconds between gossip ticks (each tick pushes this daemon's view
+    # to gossip_fanout peers and merges theirs back). 0 (default) = no
+    # gossip thread — the view still exists and answers gossip_pull /
+    # merges gossip_push, so control planes that push synchronously
+    # (ModelFleet) work without any background traffic.
+    "gossip_interval_s": _env_named("SRML_GOSSIP_INTERVAL_S", 0.0, float),
+    # Peers contacted per tick. Convergence is bounded by
+    # gossip_interval_s × ring-diameter; fanout ≥ 2 keeps the diameter
+    # O(log N).
+    "gossip_fanout": _env_named("SRML_GOSSIP_FANOUT", 2, int),
+    # How long retired-replica/version tombstones keep gossiping before
+    # they are pruned; must exceed any plausible partition length or a
+    # healed island could resurrect a retired record. 0 = keep forever.
+    "gossip_tombstone_ttl_s": _env_named(
+        "SRML_GOSSIP_TOMBSTONE_TTL_S", 600.0, float
+    ),
+    # Comma-separated seed daemon addresses ("host:port,...") a client
+    # bootstraps its routing table from — ONE reachable seed suffices;
+    # the pulled FleetView names the rest of the fleet. None = no
+    # seeds configured (FleetClient.from_seeds requires an explicit
+    # argument then). Also settable per Spark session via
+    # spark.srml.fleet.seed_addresses (spark/daemon_session.py).
+    "fleet_seed_addresses": _env_named(
+        "SRML_FLEET_SEED_ADDRESSES", None, str
+    ),
     # Versioned-serving fence (serve/daemon.py): a serving request
     # whose additive `version` field disagrees with the registration's
     # pinned version is refused (True, default) or answered with a

@@ -105,6 +105,29 @@ class MeshMembership:
                 return None
             return m["handle"]()
 
+    # -- epoch plane (serve/gossip.py rides the SAME clock) ------------------
+
+    def tick(self) -> int:
+        """Mint a fresh epoch with NO membership change — the gossip
+        layer (serve/gossip.py) stamps every FleetView record it writes
+        from this clock, so a record written after a join/leave/reboot
+        always dominates records written before it: membership changes
+        and gossip writes are totally ordered on one counter."""
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def observe(self, epoch: int) -> int:
+        """Lamport receive rule: advance this plane's epoch to at least
+        a REMOTE epoch seen in a merged FleetView, so the next local
+        tick() dominates everything the remote view carried. Never
+        rewinds. Returns the (possibly advanced) epoch."""
+        epoch = int(epoch)
+        with self._lock:
+            if epoch > self._epoch:
+                self._epoch = epoch
+            return self._epoch
+
     @property
     def epoch(self) -> int:
         with self._lock:

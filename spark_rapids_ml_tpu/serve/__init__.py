@@ -15,16 +15,19 @@ reference's JVM ``RDD.reduce`` played (RapidsRowMatrix.scala:139).
 from spark_rapids_ml_tpu.serve.client import DaemonBusy, DataPlaneClient
 from spark_rapids_ml_tpu.serve.daemon import DataPlaneDaemon
 from spark_rapids_ml_tpu.serve.fleet import FleetRolloutError, ModelFleet
+from spark_rapids_ml_tpu.serve.gossip import FleetView
 from spark_rapids_ml_tpu.serve.router import (
     ConsistentHashRing,
     FleetClient,
     FleetUnavailable,
     RoutingTable,
+    bootstrap_table,
 )
 from spark_rapids_ml_tpu.serve.scheduler import RequestScheduler, SchedulerBusy
 
 __all__ = [
     "ConsistentHashRing", "DaemonBusy", "DataPlaneClient", "DataPlaneDaemon",
-    "FleetClient", "FleetRolloutError", "FleetUnavailable", "ModelFleet",
-    "RequestScheduler", "RoutingTable", "SchedulerBusy",
+    "FleetClient", "FleetRolloutError", "FleetUnavailable", "FleetView",
+    "ModelFleet", "RequestScheduler", "RoutingTable", "SchedulerBusy",
+    "bootstrap_table",
 ]

@@ -194,7 +194,19 @@ class AutoScaler:
         queued = 0.0
         busy = 0
         replicas = table.replicas()
-        live = [r for r in replicas if r.alive]
+        # Membership comes from the GOSSIPED view when the fleet has
+        # one: a replica some other controller already tombstoned (a
+        # scale-in this process has not merged into its ring yet) must
+        # not count toward capacity — the load signal would read low
+        # against phantom replicas and the controller would under-scale.
+        view = getattr(self._fleet, "view", None)
+        tombstoned = set()
+        if view is not None:
+            tombstoned = {
+                r["addr"] for r in view.replicas(liveness="tombstone")
+                if r.get("addr")
+            }
+        live = [r for r in replicas if r.alive and r.key not in tombstoned]
         for r in live:
             queued += float(getattr(r, "inflight", 0) or 0)
             h = r.health or {}
@@ -298,11 +310,53 @@ class AutoScaler:
 
     # -- act ---------------------------------------------------------------
 
+    def _adopt_orphaned_rollouts(self) -> None:
+        """Crash-safe rollouts, closed loop: a rollout intent gossiped
+        by a controller that then DIED sits in the view until someone
+        finishes it. The autoscaler is the fleet's resident control
+        loop, so it adopts any intent older than
+        ``fleet_drain_timeout_s`` — a live controller advances its
+        phases well inside one drain window — and completes or aborts
+        it through ``ModelFleet.resume_rollout`` (the phase decides
+        which). Fleets without the gossip plane (bare stubs in tests)
+        are skipped."""
+        from spark_rapids_ml_tpu import config
+
+        resume = getattr(self._fleet, "resume_rollout", None)
+        intents = getattr(self._fleet.table, "intents", None)
+        if resume is None or intents is None:
+            return
+        horizon = float(config.get("fleet_drain_timeout_s"))
+        now = time.time()
+        for model, intent in intents().items():
+            age = now - float(intent.get("at") or 0.0)
+            if age <= horizon:
+                continue
+            try:
+                res = resume(model)
+            except Exception as e:
+                _M_ACTIONS.inc(action="resume_rollout", outcome="error")
+                logger.warning(
+                    "adopting the orphaned rollout of %r failed (will "
+                    "retry on a later tick): %s", model, e,
+                )
+                continue
+            if res.get("action") != "none":
+                _M_ACTIONS.inc(action="resume_rollout", outcome="ok")
+                logger.warning(
+                    "adopted an orphaned rollout of %r: %s v%s→v%s "
+                    "(died in phase %r, %.1fs ago)",
+                    model, res.get("action"), intent.get("from_version"),
+                    intent.get("to_version"), intent.get("phase"), age,
+                )
+
     def tick(self) -> Dict[str, Any]:
-        """One full control iteration: sample → decide → maybe act.
-        Returns the decision dict with an ``action`` field describing
-        what (if anything) was done. Thread-safe; callable manually."""
+        """One full control iteration: adopt orphaned rollouts, then
+        sample → decide → maybe act. Returns the decision dict with an
+        ``action`` field describing what (if anything) was done.
+        Thread-safe; callable manually."""
         with self._tick_lock:
+            self._adopt_orphaned_rollouts()
             sample = self._telemetry()
             now = self._clock()
             decision = self.evaluate(sample, now=now)

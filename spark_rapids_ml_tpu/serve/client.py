@@ -177,7 +177,10 @@ class DataPlaneClient:
                 timeout = min(timeout, max(deadline - time.monotonic(), 0.01))
             s = socket.create_connection(self._addr, timeout=timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._sock = s
+            # One client per thread by contract (class docstring); the
+            # gossip thread builds a FRESH client per exchange, so this
+            # state is thread-local by construction.
+            self._sock = s  # srml: disable=thread-shared-state
         return self._sock
 
     def _reset(self) -> None:
@@ -188,7 +191,9 @@ class DataPlaneClient:
                 self._sock.close()
             except OSError:
                 pass
-            self._sock = None
+            # Thread-local by the one-client-per-thread contract (see
+            # _conn).
+            self._sock = None  # srml: disable=thread-shared-state
 
     def close(self) -> None:
         # Same as _reset (one behavior, not two): a socket that errors on
@@ -259,7 +264,9 @@ class DataPlaneClient:
             self.seen_boot_ids.add(str(boot))
         sid = resp.get("id")
         if sid is not None:
-            self.last_server_id = str(sid)
+            # Thread-local by the one-client-per-thread contract (see
+            # _conn).
+            self.last_server_id = str(sid)  # srml: disable=thread-shared-state
         outs = protocol.recv_arrays(sock, resp) if want_arrays else None
         return resp, outs
 
@@ -392,6 +399,22 @@ class DataPlaneClient:
         shedding heavy ops; ``retry_after_s`` carries its hint)."""
         resp, _ = self._roundtrip({"op": "health"})
         return {k: v for k, v in resp.items() if k != "ok"}
+
+    def gossip_push(self, view: Dict[str, Any]) -> Dict[str, Any]:
+        """Anti-entropy exchange (additive op): push a FleetView wire
+        dict (serve/gossip.py ``to_wire()``); the ack carries the
+        daemon's own ``view`` back — push-pull in one round trip — plus
+        ``merged`` (records the daemon adopted) and its identity."""
+        resp, _ = self._roundtrip({"op": "gossip_push", "view": view})
+        return {k: v for k, v in resp.items() if k != "ok"}
+
+    def gossip_pull(self) -> Dict[str, Any]:
+        """The daemon's gossiped FleetView wire dict (additive op):
+        what a client bootstraps its routing table from given ONE seed
+        address (docs/protocol.md "Fleet gossip & bootstrap")."""
+        resp, _ = self._roundtrip({"op": "gossip_pull"})
+        view = resp.get("view")
+        return view if isinstance(view, dict) else {}
 
     def metrics(self, format: str = "json"):
         """Daemon metrics (additive op): the daemon process's registry

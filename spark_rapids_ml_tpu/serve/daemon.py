@@ -77,6 +77,7 @@ import hashlib
 import hmac
 import json
 import os
+import random
 import socket
 import threading
 import time
@@ -92,6 +93,7 @@ from spark_rapids_ml_tpu.ops import gram as gram_ops
 from spark_rapids_ml_tpu.parallel import membership as membership_mod
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from spark_rapids_ml_tpu.parallel.sharding import row_sharding
+from spark_rapids_ml_tpu.serve import gossip as gossip_mod
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.serve import scheduler as scheduler_mod
 from spark_rapids_ml_tpu.utils import faults
@@ -155,6 +157,11 @@ _M_MESH_REDUCES = metrics_mod.counter(
     "On-mesh collective reduces applied (reduce_mesh op: co-resident "
     "peer partials folded on the device plane, no driver hub), by algo",
 )
+_M_GOSSIP_TICKS = metrics_mod.counter(
+    "srml_gossip_ticks_total",
+    "Gossip-thread ticks run, by outcome (ok = every contacted peer "
+    "exchanged; partial = some peer push dropped this tick)",
+)
 
 #: Device-build cap for daemon-side IVF (bytes of raw f32 rows): past
 #: this, the full (n, d) matrix would not fit one chip's HBM alongside
@@ -208,7 +215,7 @@ _KNOWN_OPS = frozenset((
     "commit", "step", "finalize", "drop", "export_state", "merge_state",
     "get_iterate", "set_iterate", "ensure_model", "transform",
     "kneighbors", "model_status", "drop_model", "warmup", "sample_rows",
-    "mesh_info", "reduce_mesh",
+    "mesh_info", "reduce_mesh", "gossip_push", "gossip_pull",
 ))
 
 
@@ -220,7 +227,10 @@ def _op_label(op) -> str:
 #: Ops that never open a journal span even when the journal is on: O(1)
 #: control-plane chatter (liveness probes, scrapes) that would bury the
 #: fit tree under polling noise.
-_UNJOURNALED_OPS = frozenset(("ping", "health", "metrics", "model_status"))
+_UNJOURNALED_OPS = frozenset((
+    "ping", "health", "metrics", "model_status", "gossip_push",
+    "gossip_pull",
+))
 
 
 @contextlib.contextmanager
@@ -1990,6 +2000,8 @@ class DataPlaneDaemon:
         state_dir: Optional[str] = None,
         serve_batching: Optional[bool] = None,
         max_models: Optional[int] = None,
+        gossip_interval_s: Optional[float] = None,
+        gossip_fanout: Optional[int] = None,
     ):
         from spark_rapids_ml_tpu import config
 
@@ -2070,6 +2082,25 @@ class DataPlaneDaemon:
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reaper_thread: Optional[threading.Thread] = None
+        # Fleet gossip plane (serve/gossip.py; docs/protocol.md "Fleet
+        # gossip & bootstrap"): this daemon's resident FleetView plus
+        # the anti-entropy thread that exchanges it with peers. Interval
+        # 0 (the default) runs NO thread — the view still answers
+        # gossip_pull and merges gossip_push, so synchronous control
+        # planes work with zero background traffic.
+        self._gossip_interval_s = float(
+            config.get("gossip_interval_s")
+            if gossip_interval_s is None else gossip_interval_s
+        )
+        self._gossip_fanout = max(int(
+            config.get("gossip_fanout")
+            if gossip_fanout is None else gossip_fanout
+        ), 1)
+        self.fleet_view = gossip_mod.FleetView()
+        # Peer selection rng: seeded from the boot id so two daemons
+        # sharing a process never walk identical peer sequences.
+        self._gossip_rng = random.Random(self.boot_id)
+        self._gossip_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -2098,6 +2129,19 @@ class DataPlaneDaemon:
         membership_mod.registry().register(
             self.instance_id, self.boot_id, self
         )
+        # Gossip: this daemon's own replica record enters its resident
+        # view AT START (post-bind — the advertised port is now real),
+        # at an epoch minted from the same membership plane the
+        # register() above just bumped, so a rebooted daemon's fresh
+        # record dominates every view that still carries its old boot.
+        adv_host = (
+            "127.0.0.1" if self._host in ("0.0.0.0", "::", "")
+            else self._host
+        )
+        self.fleet_view.observe_replica(
+            self.instance_id, f"{adv_host}:{self._port}", self.boot_id,
+            liveness="up",
+        )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="srml-dataplane-accept", daemon=True
         )
@@ -2107,6 +2151,12 @@ class DataPlaneDaemon:
                 target=self._reap_loop, name="srml-dataplane-reaper", daemon=True
             )
             self._reaper_thread.start()
+        if self._gossip_interval_s > 0:
+            self._gossip_thread = threading.Thread(
+                target=self._gossip_loop, name="srml-dataplane-gossip",
+                daemon=True,
+            )
+            self._gossip_thread.start()
         logger.info("data-plane daemon listening on %s:%d", self._host, self._port)
         return self
 
@@ -2178,14 +2228,24 @@ class DataPlaneDaemon:
         for t in conn_threads:
             if t is me:
                 continue
-            try:
-                t.join(timeout=max(0.0, deadline - self._clock()))
-            except RuntimeError:
-                pass  # registered by the acceptor but not yet started
+            while True:
+                try:
+                    t.join(timeout=max(0.0, deadline - self._clock()))
+                    break
+                except RuntimeError:
+                    # Registered by the acceptor but not yet started: it
+                    # starts momentarily and exits at once (the sockets
+                    # are already shut) — re-join under the same
+                    # deadline instead of leaking it past stop().
+                    if self._clock() >= deadline:
+                        break
+                    time.sleep(0.002)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         if self._reaper_thread is not None:
             self._reaper_thread.join(timeout=5)
+        if self._gossip_thread is not None:
+            self._gossip_thread.join(timeout=5)
 
     def _reap_loop(self) -> None:
         """Evict jobs idle > ttl: a driver that crashed between feed and
@@ -2329,6 +2389,83 @@ class DataPlaneDaemon:
                     )
             except OSError:
                 pass  # raced a restore/drop, or already gone
+
+    # -- fleet gossip (serve/gossip.py; docs/protocol.md) -------------------
+
+    def _gossip_peers(self) -> list:
+        """Up to ``gossip_fanout`` peer addresses drawn from THIS
+        daemon's view: live replica records that are not me. Reads a
+        snapshot — no lock is held across the exchanges."""
+        peers = [
+            r["addr"] for r in self.fleet_view.replicas(liveness="up")
+            if r["server_id"] != self.instance_id and r["addr"]
+        ]
+        if len(peers) <= self._gossip_fanout:
+            return peers
+        return self._gossip_rng.sample(peers, self._gossip_fanout)
+
+    def _gossip_tick(self) -> Dict[str, int]:
+        """One anti-entropy round: push this view to each chosen peer
+        and merge the peer's view from the ack (push-pull in one RTT).
+        A failed peer — dead, busy, or the ``gossip.push`` fault site —
+        just drops THAT exchange for this tick: the view only ever
+        merges complete acks, so a torn push cannot corrupt it."""
+        from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+
+        pushed = dropped = 0
+        for addr in self._gossip_peers():
+            host, _, port = addr.rpartition(":")
+            try:
+                faults.checkpoint("gossip.push")
+                with DataPlaneClient(
+                    host or "127.0.0.1", int(port), token=self._token,
+                    timeout=5.0, op_deadline_s=5.0, max_op_attempts=1,
+                ) as c:
+                    ack = c.gossip_push(self.fleet_view.to_wire())
+                remote = ack.get("view")
+                if isinstance(remote, dict):
+                    self.fleet_view.merge(remote)
+                pushed += 1
+            except Exception as e:
+                dropped += 1
+                logger.debug("gossip push to %s dropped: %s", addr, e)
+        _M_GOSSIP_TICKS.inc(outcome="partial" if dropped else "ok")
+        return {"pushed": pushed, "dropped": dropped}
+
+    def _gossip_loop(self) -> None:
+        """The per-daemon gossip thread: one tick per
+        ``gossip_interval_s`` until stop. Socket I/O only — it never
+        touches the device plane or takes a daemon lock, so it can
+        never stall (or deadlock against) serving traffic."""
+        while not self._stop.wait(self._gossip_interval_s):
+            try:
+                self._gossip_tick()
+            except Exception:
+                # One bad tick must not kill anti-entropy forever.
+                logger.exception("gossip tick failed")
+
+    def _op_gossip_push(self, conn, req: Dict[str, Any]) -> None:
+        """Additive anti-entropy op: merge the sender's view, answer
+        with mine — the ack IS the pull half of push-pull. Never shed
+        (it carries the fleet's control state) and never journaled
+        (periodic chatter)."""
+        remote = req.get("view")
+        merged = 0
+        if isinstance(remote, dict):
+            merged = self.fleet_view.merge(remote)
+        protocol.send_json(conn, {
+            "ok": True, "merged": merged,
+            "view": self.fleet_view.to_wire(), **self._identity(),
+        })
+
+    def _op_gossip_pull(self, conn) -> None:
+        """Additive bootstrap/resync op: this daemon's FleetView,
+        read-only — what a stateless client builds its routing table
+        from (docs/protocol.md "Fleet gossip & bootstrap")."""
+        protocol.send_json(conn, {
+            "ok": True, "view": self.fleet_view.to_wire(),
+            **self._identity(),
+        })
 
     def __enter__(self):
         return self.start()
@@ -2651,6 +2788,18 @@ class DataPlaneDaemon:
                 name=f"srml-dataplane-{addr[1]}",
             )
             with self._conns_lock:
+                # Re-checked under the registration lock: stop() sets
+                # _stop BEFORE its self-connect poke and snapshots the
+                # thread roster under this same lock, so a connection
+                # landing after the stop (the poke itself, or a client
+                # racing the shutdown) must NOT spawn a thread stop()
+                # would never join.
+                if self._stop.is_set():
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
                 self._conn_threads.add(t)
             t.start()
 
@@ -2826,6 +2975,10 @@ class DataPlaneDaemon:
             self._op_mesh_info(conn)
         elif op == "reduce_mesh":
             self._op_reduce_mesh(conn, req)
+        elif op == "gossip_push":
+            self._op_gossip_push(conn, req)
+        elif op == "gossip_pull":
+            self._op_gossip_pull(conn)
         elif op == "get_iterate":
             job = self._get_job(req)
             arrays, meta = job.get_iterate()

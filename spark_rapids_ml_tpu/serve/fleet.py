@@ -37,14 +37,22 @@ thread, all sharing this fleet's routing table and health view.
 from __future__ import annotations
 
 import threading
+import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.serve import gossip as gossip_mod
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.serve.client import DataPlaneClient
 from spark_rapids_ml_tpu.serve.daemon import _model_width
-from spark_rapids_ml_tpu.serve.router import FleetClient, RoutingTable
+from spark_rapids_ml_tpu.serve.router import (
+    FleetClient,
+    RoutingTable,
+    bootstrap_table,
+)
+from spark_rapids_ml_tpu.utils import faults
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
@@ -94,12 +102,17 @@ class ModelFleet:
 
     def __init__(
         self,
-        endpoints,
+        endpoints=None,
         token: Optional[str] = None,
         vnodes: Optional[int] = None,
         client_kwargs: Optional[Dict[str, Any]] = None,
+        table: Optional[RoutingTable] = None,
     ):
-        self._table = RoutingTable(endpoints, vnodes=vnodes)
+        if table is None:
+            table = RoutingTable(endpoints, vnodes=vnodes)
+        elif endpoints is not None:
+            raise ValueError("pass endpoints OR a pre-built table, not both")
+        self._table = table
         self._token = token
         # Admin-op client settings: fail a dead replica in seconds (it
         # gets marked dead and routed around), don't heal for minutes.
@@ -110,12 +123,46 @@ class ModelFleet:
         self._client_kwargs = kw
         self._clients: Dict[str, DataPlaneClient] = {}
         self._lock = threading.Lock()  # serializes admin ops per fleet
+        # Gossip half (serve/gossip.py): the controller keeps its own
+        # FleetView and pushes every control-plane write (registration,
+        # each rollout phase's intent, membership changes) to the
+        # replicas, which gossip it onward — so the fleet's state
+        # SURVIVES this object. A successor controller rebuilds from
+        # any one daemon (from_seeds) and resumes (resume_rollout).
+        self._view = gossip_mod.FleetView()
+        self._controller_id = f"ctl-{uuid.uuid4().hex[:12]}"
+        self._identities: Dict[str, Dict[str, Any]] = {}
+
+    @classmethod
+    def from_seeds(
+        cls,
+        seeds=None,
+        token: Optional[str] = None,
+        vnodes: Optional[int] = None,
+        client_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> "ModelFleet":
+        """A control plane bootstrapped from ONE seed daemon's gossiped
+        FleetView (router.bootstrap_table) — how a SUCCESSOR controller
+        (or any operator tool) takes over a running fleet with no
+        endpoint roster and no surviving predecessor. Version entries
+        adopted this way are payload-less; serving keeps working, and
+        :meth:`resume_rollout` can finish or abort an interrupted
+        rollout from the gossiped intent."""
+        t = bootstrap_table(seeds, token=token, vnodes=vnodes)
+        fleet = cls(token=token, client_kwargs=client_kwargs, table=t)
+        return fleet
 
     # -- lifecycle ---------------------------------------------------------
 
     @property
     def table(self) -> RoutingTable:
         return self._table
+
+    @property
+    def view(self) -> gossip_mod.FleetView:
+        """The controller's own gossiped FleetView (tools/top, the
+        autoscaler's membership telemetry)."""
+        return self._view
 
     def close(self) -> None:
         for c in self._clients.values():
@@ -143,6 +190,88 @@ class ModelFleet:
             )
             self._clients[key] = c
         return c
+
+    # -- gossip sync (serve/gossip.py; docs/protocol.md) --------------------
+
+    def _refresh_replica_records(self) -> None:
+        """Write the table's CURRENT members into the controller's view
+        as replica records (identity pulled once per replica and
+        cached). A replica whose identity cannot be read is skipped —
+        the daemons' own start()-time records cover it via gossip."""
+        for r in self._table.replicas():
+            ident = self._identities.get(r.key)
+            if ident is None:
+                try:
+                    ident = self._client(r.key).server_info()
+                except (OSError, protocol.ProtocolError, RuntimeError):
+                    continue
+                self._identities[r.key] = ident
+            sid = str(ident.get("id") or r.key)
+            self._view.observe_replica(
+                sid, r.key, str(ident.get("boot_id") or ""), liveness="up"
+            )
+
+    def _push_view(self) -> int:
+        """Push the controller's FleetView to every live replica and
+        merge each ack's view back (push-pull), best effort per
+        replica. With per-daemon gossip threads running this just
+        shortens convergence; with them disabled
+        (``gossip_interval_s=0`` — unit tests, single-host fleets) this
+        synchronous push IS the gossip. Returns replicas reached."""
+        self._refresh_replica_records()
+        wire = self._view.to_wire()
+        pushed = 0
+        for r in self._table.replicas():
+            try:
+                ack = self._client(r.key).gossip_push(wire)
+            except (OSError, protocol.ProtocolError, RuntimeError) as e:
+                logger.warning(
+                    "gossip push to replica %s failed (its own gossip "
+                    "thread will catch it up): %s", r.key, e,
+                )
+                continue
+            remote = ack.get("view")
+            if isinstance(remote, dict):
+                self._view.merge(remote)
+            pushed += 1
+        return pushed
+
+    def _publish_model(
+        self, model: str, tombstone_versions=(),
+    ) -> None:
+        """Gossip one model's CURRENT table state — active version,
+        fleet epoch, rollout intent (None = no rollout in flight) —
+        to the fleet."""
+        try:
+            v, e, _ = self._table.snapshot(model)
+        except KeyError:
+            v, e = None, 0
+        self._view.set_model(
+            model, v, e, self._controller_id,
+            intent=self._table.intent(model),
+            tombstone_versions=tuple(tombstone_versions),
+        )
+        self._push_view()
+
+    def _set_intent(
+        self, model: str, from_v: Optional[int], to_v: int, phase: str,
+    ) -> None:
+        """Write + gossip a rollout-intent record BEFORE the phase it
+        names runs, then cross the ``fleet.rollout`` fault site — the
+        crash-safety contract: a controller that dies inside any phase
+        has already told the fleet what it was doing, so a successor
+        can complete or abort (docs/protocol.md "Fleet gossip &
+        bootstrap")."""
+        self._table.set_intent(model, {
+            "model": model,
+            "from_version": None if from_v is None else int(from_v),
+            "to_version": int(to_v),
+            "phase": phase,
+            "by": self._controller_id,
+            "at": float(time.time()),
+        })
+        self._publish_model(model)
+        faults.checkpoint("fleet.rollout")
 
     # -- registration + rollout --------------------------------------------
 
@@ -217,6 +346,9 @@ class ModelFleet:
             epoch = self._table.activate(model, version)
             _M_REPLICAS.set(len(res["ok"]), model=model)
             _M_EPOCH.set(epoch, model=model)
+            # Gossip the new model record so a client can bootstrap
+            # (and a restarted replica re-learn) from any daemon.
+            self._publish_model(model)
             return {
                 "version": version, "epoch": epoch,
                 "replicas": len(res["ok"]), "failed": res["failed"],
@@ -246,22 +378,60 @@ class ModelFleet:
                     f"rollout version {new_v} is already the active "
                     f"version of {model!r}"
                 )
+            # Every phase below gossips its intent BEFORE it runs
+            # (_set_intent): a controller that dies mid-phase leaves a
+            # record any successor can act on — registering/warming
+            # abort cleanly (nothing flipped), flipped/draining
+            # complete (resume_rollout).
+            self._set_intent(model, old_v, new_v, "registering")
             self._table.install(model, new_v, algo, arrays, params)
             res = self._register_on_replicas(
-                model, new_v, algo, arrays, dict(params or {}), warm
+                model, new_v, algo, arrays, dict(params or {}), warm=False
             )
             if not res["ok"]:
                 # Nothing flipped: v_old keeps serving, the failed
                 # install is retired so a retry starts clean.
                 self._table.retire(model, new_v)
+                self._table.set_intent(model, None)
+                self._publish_model(model)
                 _M_ROLLOUTS.inc(outcome="error")
                 raise FleetRolloutError(
                     f"no replica accepted {model!r} v{new_v}; "
                     f"v{old_v} keeps serving"
                 )
+            if warm:
+                self._set_intent(model, old_v, new_v, "warming")
+                width = _model_width(algo, arrays)
+                if width is not None:
+                    reg_name = self._table.reg_name(model, new_v)
+                    for key in list(res["ok"]):
+                        try:
+                            self._client(key).warmup(
+                                reg_name, n_cols=width, dtype="float32"
+                            )
+                        except (OSError, protocol.ProtocolError,
+                                RuntimeError) as e:
+                            # Same policy as a failed registration:
+                            # mark it dead and route around it.
+                            self._table.mark_dead(
+                                key, f"warmup of {reg_name} failed: {e}",
+                                recheck_s=1.0,
+                            )
+                            res["ok"].remove(key)
+                            res["failed"].append(key)
+                    if not res["ok"]:
+                        self._table.retire(model, new_v)
+                        self._table.set_intent(model, None)
+                        self._publish_model(model)
+                        _M_ROLLOUTS.inc(outcome="error")
+                        raise FleetRolloutError(
+                            f"every replica failed warming {model!r} "
+                            f"v{new_v}; v{old_v} keeps serving"
+                        )
             # THE flip: one atomic table write. Every request from here
             # snapshots v_new; every in-flight request keeps its v_old
             # pin and its v_old daemon registration.
+            self._set_intent(model, old_v, new_v, "flipped")
             epoch = self._table.activate(model, new_v)
             _M_REPLICAS.set(len(res["ok"]), model=model)
             _M_EPOCH.set(epoch, model=model)
@@ -274,6 +444,7 @@ class ModelFleet:
             # arrays are dropped. A timeout leaves v_old registered —
             # stale registrations cost memory, yanked arrays cost
             # correctness.
+            self._set_intent(model, old_v, new_v, "draining")
             timeout = float(
                 config.get("fleet_drain_timeout_s")
                 if drain_timeout_s is None else drain_timeout_s
@@ -294,9 +465,123 @@ class ModelFleet:
                     model, old_v, timeout,
                     self._table.inflight(model, old_v),
                 )
+            # Rollout finished: clear the gossiped intent, tombstone
+            # the drained version so no bootstrap re-adopts it.
+            self._table.set_intent(model, None)
+            self._publish_model(
+                model, tombstone_versions=((old_v,) if drained else ()),
+            )
             return {
                 "version": new_v, "previous": old_v, "epoch": epoch,
                 "replicas": len(res["ok"]), "failed": res["failed"],
+                "drained": drained,
+            }
+
+    def resume_rollout(
+        self,
+        model: str,
+        drain_timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Finish — or cleanly abort — a rollout whose controller died,
+        from the gossiped ``rollout_intent`` record (usually on a fleet
+        built with :meth:`from_seeds`). The intent's phase decides:
+
+        * ``registering``/``warming`` — nothing flipped; ABORT: drop
+          the half-registered to-version everywhere, clear the intent.
+          The old version never stopped serving.
+        * ``flipped``/``draining`` — the fleet was told the flip was
+          happening; COMPLETE: make the to-version active (the flip is
+          idempotent — re-activating the already-active version just
+          re-bumps the epoch), drain and drop the from-version, clear
+          the intent.
+
+        Returns ``{"action": "aborted"|"completed"|"none", ...}``.
+        """
+        from spark_rapids_ml_tpu import config
+
+        with self._lock:
+            intent = self._table.intent(model)
+            if not intent:
+                return {"action": "none", "model": model}
+            phase = str(intent.get("phase") or "")
+            to_v = int(intent["to_version"])
+            from_v = intent.get("from_version")
+            from_v = None if from_v is None else int(from_v)
+            if phase in ("registering", "warming"):
+                reg = self._table.reg_name(model, to_v)
+                for r in self._table.replicas():
+                    try:
+                        self._client(r.key).drop_model(reg)
+                    except (OSError, protocol.ProtocolError, RuntimeError):
+                        pass  # never registered there, or dead replica
+                try:
+                    self._table.retire(model, to_v)
+                except (KeyError, ValueError):
+                    pass  # never installed locally (successor table)
+                self._table.set_intent(model, None)
+                self._publish_model(model, tombstone_versions=(to_v,))
+                logger.warning(
+                    "aborted interrupted rollout of %s to v%d (died in "
+                    "phase %r before the flip); v%s keeps serving",
+                    model, to_v, phase, from_v,
+                )
+                return {
+                    "action": "aborted", "model": model, "phase": phase,
+                    "version": to_v, "previous": from_v,
+                }
+            if phase not in ("flipped", "draining"):
+                raise ValueError(
+                    f"unknown rollout-intent phase {phase!r} for "
+                    f"{model!r}"
+                )
+            self._table.ensure_version(model, to_v)
+            try:
+                cur_v, epoch, _ = self._table.snapshot(model)
+            except KeyError:
+                cur_v, epoch = None, 0
+            if cur_v != to_v:
+                epoch = self._table.activate(model, to_v)
+            # Publish the (re-)flip BEFORE dropping the from-version's
+            # registrations: a client still pinned to it that races the
+            # drop resyncs from a view that already names the new
+            # active, instead of re-pinning the version being dropped.
+            self._publish_model(model)
+            timeout = float(
+                config.get("fleet_drain_timeout_s")
+                if drain_timeout_s is None else drain_timeout_s
+            )
+            drained = True
+            if from_v is not None:
+                drained = self._table.wait_drained(model, from_v, timeout)
+                _M_DRAINS.inc(outcome="drained" if drained else "timeout")
+                if drained:
+                    old_reg = self._table.reg_name(model, from_v)
+                    for r in self._table.replicas():
+                        try:
+                            self._client(r.key).drop_model(old_reg)
+                        except (OSError, protocol.ProtocolError,
+                                RuntimeError):
+                            pass
+                    try:
+                        self._table.retire(model, from_v)
+                    except (KeyError, ValueError):
+                        pass
+            self._table.set_intent(model, None)
+            _M_EPOCH.set(epoch, model=model)
+            self._publish_model(
+                model,
+                tombstone_versions=(
+                    (from_v,) if drained and from_v is not None else ()
+                ),
+            )
+            logger.warning(
+                "completed interrupted rollout of %s to v%d (died in "
+                "phase %r after the flip; drained=%s)",
+                model, to_v, phase, drained,
+            )
+            return {
+                "action": "completed", "model": model, "phase": phase,
+                "version": to_v, "previous": from_v, "epoch": epoch,
                 "drained": drained,
             }
 
@@ -351,6 +636,9 @@ class ModelFleet:
                 "seeded and warm (%d replicas in the ring)",
                 key, len(seeded), n,
             )
+            # Gossip the grown membership (and seed the newcomer's view
+            # with the fleet's model records in the same push).
+            self._push_view()
             return {"replica": key, "models": seeded, "replicas": n}
 
     def scale_in(
@@ -375,6 +663,14 @@ class ModelFleet:
             if not live:
                 raise ValueError("no live replica to scale in")
             key = min(live, key=lambda r: (r.load(), r.key)).key
+        # Capture the victim's gossip identity while it is still a
+        # member — its record must flip to a tombstone, not vanish.
+        victim = self._identities.get(key)
+        if victim is None:
+            try:
+                victim = self._client(key).server_info()
+            except (OSError, protocol.ProtocolError, RuntimeError):
+                victim = None
         self._table.remove_replica(key)
         rollouts: Dict[str, Any] = {}
         drained = True
@@ -391,7 +687,13 @@ class ModelFleet:
             c = self._clients.pop(key, None)
             if c is not None:
                 c.close()
+            self._identities.pop(key, None)
+            if victim is not None and victim.get("id"):
+                self._view.tombstone_replica(str(victim["id"]))
             n = len(self._table.replicas())
+            # Gossip the shrunk membership so no bootstrapping client
+            # ever admits the retiree into its ring again.
+            self._push_view()
         logger.info(
             "scaled IN: replica %s retired (%d replicas remain; "
             "drained=%s)", key, n, drained,

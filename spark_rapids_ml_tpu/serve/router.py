@@ -74,6 +74,7 @@ __all__ = [
     "FleetClient",
     "FleetUnavailable",
     "RoutingTable",
+    "bootstrap_table",
 ]
 
 #: Router telemetry (docs/observability.md catalogs all of these).
@@ -98,6 +99,13 @@ _M_REPAIRS = metrics_mod.counter(
     "Replicas re-registered in-band after answering 'no such model' "
     "(a restarted replica lost its registry; the routing table re-seeds "
     "it from the fleet's stored model payload)",
+)
+_M_BOOTSTRAPS = metrics_mod.counter(
+    "srml_fleet_bootstraps_total",
+    "Client pulls of the gossiped FleetView, by outcome (ok = a "
+    "bootstrap built a routing table from one seed; error = a seed "
+    "attempt failed; resync = a serving ack's version/epoch mismatch "
+    "re-pulled the view mid-traffic)",
 )
 
 
@@ -244,6 +252,10 @@ class RoutingTable:
         #: model → {"active": int|None, "epoch": int,
         #:          "versions": {int: version-info dict}}
         self._models: Dict[str, Dict[str, Any]] = {}
+        # Highest gossiped FleetView epoch this table has merged
+        # (apply_view) — the client's convergence probe; 0 until the
+        # table first sees a gossiped view.
+        self._view_epoch = 0
 
     # -- replicas ----------------------------------------------------------
 
@@ -326,6 +338,128 @@ class RoutingTable:
                 r.health = health
                 r.health_ts = time.monotonic()
 
+    # -- gossiped fleet view (serve/gossip.py; docs/protocol.md) -----------
+
+    @property
+    def view_epoch(self) -> int:
+        with self._lock:
+            return self._view_epoch
+
+    def apply_view(self, wire: Dict[str, Any]) -> Dict[str, int]:
+        """Merge a gossiped FleetView wire dict into this table: admit
+        unknown live replicas, retire tombstoned ones (never the last
+        live member), and adopt each model's active version/epoch when
+        the view's fleet epoch is AHEAD of the local one — the fleet
+        epoch only ever moves forward, so a stale island's view can
+        never rewind a table past a flip it already saw.
+
+        Version entries created here are PAYLOAD-LESS (``arrays=None``):
+        the client can route to them — the replicas already hold the
+        registration — but in-band repair refuses, because there is
+        nothing local to re-seed a replica from; the client resyncs
+        instead. Tolerant by design: this is the bootstrap/resync path
+        and must never throw on a half-converged view."""
+        out = {"replicas_added": 0, "replicas_retired": 0, "models": 0}
+        wire = wire or {}
+        with self._lock:
+            self._view_epoch = max(
+                self._view_epoch, int(wire.get("epoch", 0) or 0)
+            )
+            for rec in (wire.get("replicas") or {}).values():
+                addr = str(rec.get("addr") or "")
+                if ":" not in addr:
+                    continue
+                liveness = rec.get("liveness")
+                existing = self._replicas.get(addr)
+                if liveness == "tombstone":
+                    if existing is not None and not existing.retired:
+                        live = sum(
+                            1 for r in self._replicas.values()
+                            if not r.retired
+                        )
+                        if live > 1:
+                            existing.retired = True
+                            out["replicas_retired"] += 1
+                elif liveness == "up":
+                    if existing is None or existing.retired:
+                        host, _, port = addr.rpartition(":")
+                        self._replicas[addr] = _Replica(
+                            host or "127.0.0.1", int(port)
+                        )
+                        out["replicas_added"] += 1
+                # liveness == "down": keep the member — gossip decides
+                # MEMBERSHIP; the router's own health probes decide
+                # moment-to-moment aliveness.
+            if out["replicas_added"] or out["replicas_retired"]:
+                self._rebuild_ring_locked()
+            for name, rec in (wire.get("models") or {}).items():
+                entry = self._models.setdefault(
+                    name, {"active": None, "epoch": 0, "versions": {}}
+                )
+                # Lamport-dominance per record: a record this table
+                # already merged (or wrote) at a higher gossip epoch
+                # wins over a stale island's copy.
+                ge = int(rec.get("epoch", 0) or 0)
+                if ge < int(entry.get("_gossip_epoch", 0)):
+                    continue
+                entry["_gossip_epoch"] = ge
+                out["models"] += 1
+                active = rec.get("active_version")
+                active = None if active is None else int(active)
+                fe = int(rec.get("fleet_epoch", 0) or 0)
+                if (
+                    active is not None
+                    and active not in entry["versions"]
+                    and fe >= entry["epoch"]
+                ):
+                    entry["versions"][active] = {
+                        "reg_name": self.reg_name(name, active),
+                        "algo": None, "arrays": None, "params": {},
+                        "inflight": 0,
+                    }
+                for vs in (rec.get("tombstones") or {}):
+                    v = int(vs)
+                    info = entry["versions"].get(v)
+                    if (
+                        v != active and v != entry["active"]
+                        and info is not None and info["inflight"] <= 0
+                    ):
+                        entry["versions"].pop(v, None)
+                if fe > entry["epoch"] or (
+                    fe == entry["epoch"] and entry["active"] is None
+                ):
+                    entry["active"] = active
+                    entry["epoch"] = fe
+                entry["intent"] = rec.get("intent")
+        return out
+
+    def intent(self, model: str) -> Optional[Dict[str, Any]]:
+        """The model's gossiped rollout-intent record, or None — what a
+        successor controller reads to complete or abort an interrupted
+        rollout (ModelFleet.resume_rollout)."""
+        with self._lock:
+            entry = self._models.get(model)
+            return None if entry is None else entry.get("intent")
+
+    def set_intent(self, model: str,
+                   intent: Optional[Dict[str, Any]]) -> None:
+        with self._lock:
+            entry = self._models.setdefault(
+                model, {"active": None, "epoch": 0, "versions": {}}
+            )
+            entry["intent"] = intent
+
+    def intents(self) -> Dict[str, Dict[str, Any]]:
+        """Every model with a live rollout intent — what the
+        autoscaler's orphan-adoption sweep iterates. Includes models
+        with NO active version (a rollout interrupted while
+        registering a brand-new model)."""
+        with self._lock:
+            return {
+                m: dict(e["intent"]) for m, e in self._models.items()
+                if e.get("intent")
+            }
+
     # -- version table -----------------------------------------------------
 
     @staticmethod
@@ -359,6 +493,26 @@ class RoutingTable:
                 "params": dict(params or {}),
                 "inflight": 0 if prev is None else prev["inflight"],
             }
+        return self.reg_name(model, version)
+
+    def ensure_version(self, model: str, version: int) -> str:
+        """Make sure a version ENTRY exists, creating a payload-less
+        one (``arrays=None`` — routable, not repairable) when absent.
+        A successor controller completing a gossiped rollout intent
+        needs the to-version activatable even though the payload died
+        with its predecessor: the replicas still hold the registration.
+        Returns the registration name."""
+        version = int(version)
+        with self._lock:
+            entry = self._models.setdefault(
+                model, {"active": None, "epoch": 0, "versions": {}}
+            )
+            if version not in entry["versions"]:
+                entry["versions"][version] = {
+                    "reg_name": self.reg_name(model, version),
+                    "algo": None, "arrays": None, "params": {},
+                    "inflight": 0,
+                }
         return self.reg_name(model, version)
 
     def activate(self, model: str, version: int) -> int:
@@ -490,10 +644,111 @@ class RoutingTable:
                 self._drained.wait(timeout=remaining)
 
 
+def _seed_list(seeds) -> List[str]:
+    """Normalize a seeds argument — None (fall back to the
+    ``fleet_seed_addresses`` config/env/Spark-conf ladder), one
+    comma-separated string, or an iterable — into a list of
+    ``host:port`` strings."""
+    from spark_rapids_ml_tpu import config
+
+    if seeds is None:
+        seeds = config.get("fleet_seed_addresses")
+    if isinstance(seeds, str):
+        seeds = [s.strip() for s in seeds.split(",") if s.strip()]
+    out: List[str] = []
+    for s in seeds or []:
+        if isinstance(s, str):
+            out.append(s)
+        else:  # ("host", port) pairs — daemon.address and friends
+            out.append(f"{s[0]}:{int(s[1])}")
+    return out
+
+
+def bootstrap_table(
+    seeds=None,
+    token: Optional[str] = None,
+    vnodes: Optional[int] = None,
+    client_kwargs: Optional[Dict[str, Any]] = None,
+    passes: int = 3,
+) -> RoutingTable:
+    """Build a :class:`RoutingTable` from ONE reachable seed daemon.
+
+    The fleet's membership and version tables live IN the daemons
+    (gossiped FleetView, serve/gossip.py), so a fresh client needs no
+    endpoint roster and no surviving predecessor: it pulls the view
+    from the first seed that answers and builds its ring from the live
+    replicas in it. Seeds are tried in order; after each full failed
+    pass the client backs off on the decorrelated-jitter ladder
+    (utils/retry.py) before the next, up to ``passes`` passes. Each
+    attempt crosses the ``fleet.bootstrap`` fault site first, so chaos
+    tests can fail seeds deterministically (docs/fault_injection.md).
+
+    Raises :class:`FleetUnavailable` when no seed yields a usable view.
+    """
+    from spark_rapids_ml_tpu.utils import faults
+    from spark_rapids_ml_tpu.utils.retry import decorrelated_jitter
+
+    seeds = _seed_list(seeds)
+    if not seeds:
+        raise ValueError(
+            "fleet bootstrap needs at least one seed address: pass "
+            "seeds=, or set fleet_seed_addresses / "
+            "SRML_FLEET_SEED_ADDRESSES / spark.srml.fleet.seed_addresses"
+        )
+    kw: Dict[str, Any] = {
+        "timeout": 5.0, "op_deadline_s": 10.0, "max_op_attempts": 1,
+    }
+    kw.update(client_kwargs or {})
+    last_err: Optional[BaseException] = None
+    delay = 0.0
+    for p in range(max(int(passes), 1)):
+        if p:
+            delay = decorrelated_jitter(delay, 0.05, 2.0)
+            time.sleep(delay)
+        for addr in seeds:
+            host, _, port = str(addr).rpartition(":")
+            try:
+                faults.checkpoint("fleet.bootstrap")
+                with DataPlaneClient(
+                    host or "127.0.0.1", int(port), token=token, **kw
+                ) as c:
+                    view = c.gossip_pull()
+                endpoints = sorted(
+                    r["addr"] for r in (view.get("replicas") or {}).values()
+                    if r.get("liveness") == "up" and r.get("addr")
+                )
+                if not endpoints:
+                    raise FleetUnavailable(
+                        f"seed {addr} answered with no live replicas in "
+                        "its view"
+                    )
+                table = RoutingTable(endpoints, vnodes=vnodes)
+                table.apply_view(view)
+                _M_BOOTSTRAPS.inc(outcome="ok")
+                logger.info(
+                    "bootstrapped fleet from seed %s: %d replica(s), "
+                    "%d model(s), view epoch %d",
+                    addr, len(endpoints), len(table.models()),
+                    table.view_epoch,
+                )
+                return table
+            except (OSError, ValueError, protocol.ProtocolError,
+                    RuntimeError) as e:
+                last_err = e
+                _M_BOOTSTRAPS.inc(outcome="error")
+                logger.warning("fleet bootstrap via seed %s failed: %s",
+                               addr, e)
+    raise FleetUnavailable(
+        f"no seed of {seeds} yielded a usable fleet view "
+        f"(last error: {last_err})"
+    ) from last_err
+
+
 class FleetClient:
     """Route serving requests across a fleet's replicas (module
     docstring has the routing contract). Constructed from a shared
-    :class:`RoutingTable` — usually via ``ModelFleet.client()``."""
+    :class:`RoutingTable` — usually via ``ModelFleet.client()``, or
+    bootstrapped from one seed daemon via :meth:`from_seeds`."""
 
     def __init__(
         self,
@@ -542,6 +797,30 @@ class FleetClient:
         #: debugging read it; the process-wide aggregate lives in the
         #: srml_router_* registry metrics).
         self.stats: Dict[str, int] = {}
+
+    @classmethod
+    def from_seeds(
+        cls,
+        seeds=None,
+        token: Optional[str] = None,
+        health_poll_s: Optional[float] = None,
+        failover_attempts: Optional[int] = None,
+        client_kwargs: Optional[Dict[str, Any]] = None,
+        vnodes: Optional[int] = None,
+    ) -> "FleetClient":
+        """A fully routable client from ONE seed address (or the
+        ``fleet_seed_addresses`` ladder) — no endpoint roster, no
+        surviving predecessor client: the table comes from the seed's
+        gossiped FleetView (:func:`bootstrap_table`)."""
+        table = bootstrap_table(
+            seeds, token=token, vnodes=vnodes,
+            client_kwargs=client_kwargs,
+        )
+        return cls(
+            table, token=token, health_poll_s=health_poll_s,
+            failover_attempts=failover_attempts,
+            client_kwargs=client_kwargs,
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -667,6 +946,11 @@ class FleetClient:
             info = self._table.version_info(model, version)
         except KeyError:
             return False
+        if info.get("arrays") is None:
+            # A PAYLOAD-LESS entry adopted from a gossiped view
+            # (RoutingTable.apply_view) — nothing local to re-seed the
+            # replica from; the caller falls through to a resync.
+            return False
         try:
             self._client(key).ensure_model(
                 info["reg_name"], info["algo"], info["arrays"],
@@ -685,6 +969,28 @@ class FleetClient:
         )
         return True
 
+    def _resync(self, key: str, model: str) -> bool:
+        """Re-pull the gossiped FleetView from the ANSWERING replica
+        after a ``version mismatch`` ack or an unrepairable "no such
+        model" — the replica that refused KNOWS the fleet state this
+        client's table missed (a rollout it slept through), so resyncing
+        from it beats erroring out (docs/protocol.md "Fleet gossip &
+        bootstrap"). Never raises; False just continues the failover."""
+        try:
+            view = self._client(key).gossip_pull()
+        except (OSError, protocol.ProtocolError, RuntimeError) as e:
+            logger.warning("fleet resync from %s failed: %s", key, e)
+            return False
+        if not view:
+            return False
+        self._table.apply_view(view)
+        _M_BOOTSTRAPS.inc(outcome="resync")
+        logger.info(
+            "resynced routing table from %s for model %r (view epoch %d)",
+            key, model, self._table.view_epoch,
+        )
+        return True
+
     def _request(self, kind: str, model: str, route_key, attempt_fn):
         # ONE atomic snapshot-and-refcount pins this request — and every
         # failover retry of it — to a single version (docs/protocol.md
@@ -696,6 +1002,7 @@ class FleetClient:
         key = self._route_key(route_key)
         last_err: Optional[BaseException] = None
         tried = 0
+        resynced = False
         attempts = self._attempts or len(self._table.ring.members)
         try:
             with journal.span(
@@ -730,13 +1037,39 @@ class FleetClient:
                                 break
                             except RuntimeError as e:
                                 last_err = e
+                                msg = str(e)
                                 if (
                                     not repaired
-                                    and "no such model" in str(e)
+                                    and "no such model" in msg
                                     and self._repair(rk, model, version)
                                 ):
                                     repaired = True
                                     continue  # retry THIS replica once
+                                if (
+                                    not resynced
+                                    and ("version mismatch" in msg
+                                         or "no such model" in msg)
+                                    and self._resync(rk, model)
+                                ):
+                                    # The replica refused because OUR
+                                    # pin is stale (a rollout flipped
+                                    # while this client slept). Re-pin
+                                    # on the resynced table — acquire
+                                    # the NEW version before releasing
+                                    # the old, so the drain refcounts
+                                    # stay exactly-once — and retry
+                                    # this replica on the fresh pin.
+                                    resynced = True
+                                    try:
+                                        nv, ne, nr = (
+                                            self._table.acquire(model)
+                                        )
+                                    except KeyError:
+                                        _M_FAILOVERS.inc(reason="error")
+                                        break
+                                    self._table.done(model, version)
+                                    version, epoch, reg_name = nv, ne, nr
+                                    continue
                                 _M_FAILOVERS.inc(reason="error")
                                 break
                     finally:

@@ -264,6 +264,25 @@ def resolve_all(spark=None) -> list:
     return [_parse_addr(a.strip()) for a in addrs.split(",") if a.strip()]
 
 
+def fleet_seeds(spark=None) -> list:
+    """Seed addresses for the gossiped-fleet bootstrap
+    (``router.bootstrap_table`` — ONE reachable seed is enough; the
+    seed's FleetView names the rest). The config/env/Spark-conf ladder:
+    ``$SRML_FLEET_SEED_ADDRESSES`` / ``spark.srml.fleet.seed_addresses``
+    / ``config "fleet_seed_addresses"`` (comma-separated host:port).
+    Empty when unconfigured."""
+    from spark_rapids_ml_tpu import config
+
+    addrs = os.environ.get("SRML_FLEET_SEED_ADDRESSES")
+    if not addrs and spark is not None:
+        addrs = _spark_conf_get(spark, "spark.srml.fleet.seed_addresses")
+    if not addrs:
+        addrs = config.get("fleet_seed_addresses")
+    if not addrs:
+        return []
+    return [a.strip() for a in str(addrs).split(",") if a.strip()]
+
+
 def _local_daemon():
     global _owned_daemon
     with _lock:

@@ -530,6 +530,107 @@ def check_chaos_grow(
     return ok, lines
 
 
+def check_chaos_partition(
+    fresh: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    max_regression: float = DEFAULT_MAX_REGRESSION,
+) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --chaos-partition`` record (the 2-island
+    gossip split + heal — docs/protocol.md "Fleet gossip &
+    bootstrap"). Correctness gates are ABSOLUTE — a record whose four
+    views did not converge after the bridge push, whose partitioned
+    traffic failed or wobbled (``failed_during_partition`` /
+    ``mismatched_during_partition`` nonzero, or no traffic routed at
+    all), or whose stale version was not tombstoned on every view
+    (``tombstones_clean``) FAILS regardless of history: a partition
+    may degrade freshness, never correctness, and a heal must never
+    resurrect the losing island's version. The COST gate is
+    trajectory-relative: ``time_to_converge_s`` (``value``, lower is
+    better) must not grow past (1 + max_regression) × the
+    metric-matched median. Partition records share the CHAOS_r* glob
+    with the elastic degrade/grow families; the mode+metric filter
+    keeps the trajectories separate. No history → the cost gate SKIPs
+    with a note (first record seeds the trajectory) — never a silent
+    pass."""
+    lines: List[str] = []
+    if fresh.get("mode") != "chaos_partition":
+        return False, [
+            "record has no mode=chaos_partition — not a "
+            "bench.py --chaos-partition record?"
+        ]
+    ok = True
+    if not bool(fresh.get("converged")):
+        ok = False
+        lines.append(
+            "partition correctness [FAIL] the four FleetViews did NOT "
+            "converge after the bridge push — anti-entropy itself is "
+            "broken; no cost number matters"
+        )
+    else:
+        lines.append(
+            "partition correctness [OK] all "
+            f"{fresh.get('n_daemons')} views converged "
+            "(one active version, one epoch, stale version tombstoned)"
+        )
+    routed = int(fresh.get("routed_during_partition") or 0)
+    failed = int(fresh.get("failed_during_partition") or 0)
+    wobbled = int(fresh.get("mismatched_during_partition") or 0)
+    if routed <= 0:
+        ok = False
+        lines.append(
+            "partition correctness [FAIL] record routed 0 requests "
+            "inside the split — the bench never exercised the "
+            "partitioned data plane"
+        )
+    elif failed or wobbled:
+        ok = False
+        lines.append(
+            f"partition correctness [FAIL] traffic inside the split "
+            f"failed={failed} mismatched={wobbled} over {routed:,} "
+            "routed — a partition must degrade freshness, never "
+            "correctness"
+        )
+    else:
+        lines.append(
+            f"partition correctness [OK] {routed:,} requests routed "
+            "inside the split, zero failed, bitwise-stable"
+        )
+    if not bool(fresh.get("tombstones_clean")):
+        ok = False
+        lines.append(
+            "partition correctness [FAIL] the losing island's version "
+            "is not tombstoned on every view — the heal can resurrect "
+            "it"
+        )
+    matching = [
+        h for h in history
+        if h.get("mode") == "chaos_partition"
+        and h.get("metric") == fresh.get("metric")
+    ]
+    value = float(fresh.get("value") or 0.0)
+    if not matching:
+        lines.append(
+            f"partition cost [SKIP] no CHAOS_r* history matches metric "
+            f"{fresh.get('metric')!r} — recorded {value}s to converge "
+            f"(interval {fresh.get('gossip_interval_s')}s, fanout "
+            f"{fresh.get('gossip_fanout')}), nothing gated"
+        )
+        return ok, lines
+    base = _median([
+        float(h["value"]) for h in matching if h.get("value") is not None
+    ] or [value])
+    ceil = (1.0 + max_regression) * base
+    verdict = "OK" if value <= ceil else "REGRESSION"
+    lines.append(
+        f"time to converge [{verdict}] {value:.4f}s vs ceiling "
+        f"{ceil:.4f}s (median {base:.4f}s over {len(matching)} "
+        f"record(s), gate at +{max_regression:.0%})"
+    )
+    if value > ceil:
+        ok = False
+    return ok, lines
+
+
 def check_forest(
     fresh: Dict[str, Any],
     history: List[Dict[str, Any]],
@@ -738,12 +839,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     fleet = str(fresh.get("metric", "")).startswith("serve_fleet_")
     chaos = str(fresh.get("metric", "")).startswith("chaos_elastic_")
     grow = str(fresh.get("metric", "")).startswith("chaos_grow_")
+    partition = str(fresh.get("metric", "")).startswith("chaos_partition_")
     forest = str(fresh.get("metric", "")).startswith("forest_")
     kernels = str(fresh.get("metric", "")).startswith("kernel_")
     default_glob = (
         "KERNELS_r*.json" if kernels
         else "FOREST_r*.json" if forest
-        else "CHAOS_r*.json" if chaos or grow
+        else "CHAOS_r*.json" if chaos or grow or partition
         else "FLEET_r*.json" if fleet
         else "MULTICHIP_r*.json" if multichip else "BENCH_r*.json"
     )
@@ -762,6 +864,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     elif grow:
         ok, lines = check_chaos_grow(
+            fresh, history, max_regression=args.max_regression,
+        )
+    elif partition:
+        ok, lines = check_chaos_partition(
             fresh, history, max_regression=args.max_regression,
         )
     elif fleet:

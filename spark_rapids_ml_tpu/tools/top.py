@@ -22,6 +22,14 @@ per replica daemon (identity, boot, uptime, connections, served models,
 scheduler queue, busy state), with dead replicas shown as DOWN rather
 than killing the poll — the operator view of a serve/fleet.py
 deployment. The single-address view is unchanged.
+
+``--fleet`` renders the GOSSIPED fleet panel from ONE seed address: it
+pulls the seed's FleetView (the ``gossip_pull`` wire op) and shows every
+replica record (liveness, boot, record epoch) and every model's version
+table (active version, fleet epoch, tombstoned versions, any live
+rollout intent) the fleet itself knows — no roster to maintain, and if
+the seed dies the next pull fails over to any replica the last view
+listed.
 """
 
 from __future__ import annotations
@@ -341,6 +349,80 @@ def render_fleet(healths: Dict[str, Optional[Dict[str, Any]]]) -> str:
     return "\n".join(lines)
 
 
+def render_fleet_view(
+    view: Dict[str, Any],
+    healths: Optional[Dict[str, Optional[Dict[str, Any]]]] = None,
+) -> str:
+    """The GOSSIPED fleet panel (``--fleet``): rendered from ONE seed
+    daemon's FleetView wire dict (``gossip_pull``) — per-replica
+    liveness records and the per-model version table with any live
+    rollout intent — optionally joined with live ``health`` polls
+    (``healths``: addr → health dict or None). Pure function — the
+    unit under test; ``main`` feeds it live pulls."""
+    healths = healths or {}
+    lines: List[str] = []
+    reps = (view or {}).get("replicas") or {}
+    models = (view or {}).get("models") or {}
+    counts: Dict[str, int] = {}
+    for r in reps.values():
+        lv = str(r.get("liveness", "?"))
+        counts[lv] = counts.get(lv, 0) + 1
+    tally = "  ".join(f"{k}:{n}" for k, n in sorted(counts.items()))
+    lines.append(
+        f"fleet (gossiped) — view epoch {int((view or {}).get('epoch', 0))}"
+        f"  replicas {tally or '-'}"
+    )
+    lines.append(
+        f"{'replica':<16}{'addr':<22}{'boot':<14}{'liveness':>10}"
+        f"{'epoch':>7}{'health':>8}"
+    )
+    for sid in sorted(reps):
+        r = reps[sid]
+        h = healths.get(str(r.get("addr") or ""))
+        if r.get("liveness") == "tombstone":
+            state = "-"
+        elif h is None:
+            state = "DOWN" if str(r.get("addr") or "") in healths else "?"
+        else:
+            state = "BUSY" if h.get("busy") else "ok"
+        lines.append(
+            f"{str(sid):<16}{str(r.get('addr') or '-'):<22}"
+            f"{str(r.get('boot_id') or '-'):<14}"
+            f"{str(r.get('liveness', '?')):>10}"
+            f"{int(r.get('epoch', 0)):>7}{state:>8}"
+        )
+    if models:
+        lines.append("")
+        lines.append(
+            f"{'model':<16}{'active':>8}{'fleet ep':>10}{'tombs':>12}"
+            f"  intent"
+        )
+        for name in sorted(models):
+            m = models[name]
+            av = m.get("active_version")
+            tombs = ",".join(
+                f"v{v}" for v in sorted(
+                    (m.get("tombstones") or {}), key=int
+                )
+            )
+            intent = m.get("intent")
+            if intent:
+                itxt = (
+                    f"{intent.get('phase', '?')} "
+                    f"v{intent.get('from_version')}→"
+                    f"v{intent.get('to_version')} by "
+                    f"{intent.get('by', '?')}"
+                )
+            else:
+                itxt = "-"
+            lines.append(
+                f"{name:<16}{('v%d' % av) if av is not None else '-':>8}"
+                f"{int(m.get('fleet_epoch', 0)):>10}{(tombs or '-'):>12}"
+                f"  {itxt}"
+            )
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_ml_tpu.tools.top",
@@ -360,12 +442,66 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--token", default=os.environ.get("SRML_DAEMON_TOKEN"),
                     help="shared-secret daemon token (default: "
                     "$SRML_DAEMON_TOKEN)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the GOSSIPED fleet panel from ONE seed "
+                    "address: pull the seed's FleetView (gossip_pull) "
+                    "and show every replica and model the fleet knows — "
+                    "no roster needed")
     args = ap.parse_args(argv)
     if not args.address:
         ap.error("no daemon address: pass host:port or set $SRML_DAEMON_ADDRESS")
 
     from spark_rapids_ml_tpu.serve.client import DataPlaneClient
     from spark_rapids_ml_tpu.spark.daemon_session import _parse_addr
+
+    if args.fleet:
+        # Gossiped-fleet mode: ONE seed is enough — the view names every
+        # replica; health is polled per up-replica from the view, and if
+        # the seed itself dies, the next pull fails over to any replica
+        # the last view listed (the same resilience a FleetClient has).
+        seeds = [a.strip() for a in args.address.split(",") if a.strip()]
+        last_view: Dict[str, Any] = {}
+        polls = 0
+        while True:
+            view: Dict[str, Any] = {}
+            candidates = list(seeds) + sorted(
+                r["addr"] for r in (last_view.get("replicas") or {}).values()
+                if r.get("liveness") == "up" and r.get("addr")
+                and r["addr"] not in seeds
+            )
+            for a in candidates:
+                try:
+                    with DataPlaneClient(
+                        *_parse_addr(a), token=args.token,
+                        timeout=5.0, max_op_attempts=1,
+                    ) as c:
+                        view = c.gossip_pull()
+                    break
+                except Exception:
+                    continue
+            last_view = view or last_view
+            healths: Dict[str, Optional[Dict[str, Any]]] = {}
+            for r in (view.get("replicas") or {}).values():
+                if r.get("liveness") != "up" or not r.get("addr"):
+                    continue
+                try:
+                    with DataPlaneClient(
+                        *_parse_addr(r["addr"]), token=args.token,
+                        timeout=5.0, max_op_attempts=1,
+                    ) as c:
+                        healths[r["addr"]] = c.health()
+                except Exception:
+                    healths[r["addr"]] = None
+            body = render_fleet_view(view, healths)
+            if args.once or args.count:
+                print(body)
+                print()
+            else:
+                print("\x1b[2J\x1b[H" + body, flush=True)
+            polls += 1
+            if args.once or (args.count and polls >= args.count):
+                return 0
+            time.sleep(args.interval)
 
     if "," in args.address:
         # Fleet mode: one health poll per replica per tick, rendered as

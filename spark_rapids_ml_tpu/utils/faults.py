@@ -44,6 +44,26 @@ fires):
                           a drop/refuse here is translated into a shed —
                           the request is answered with the busy/
                           retry_after_s contract, never queued
+``gossip.push``           serve/daemon.py, before each per-peer gossip
+                          exchange of a tick: a faulted push just drops
+                          that peer for that tick — the FleetView is
+                          merged only from COMPLETE acks, so a dropped
+                          push can delay convergence but never corrupt
+                          the view (docs/protocol.md "Fleet gossip &
+                          bootstrap")
+``fleet.bootstrap``       serve/router.py, before each seed-address
+                          pull of a client bootstrap: a faulted seed
+                          makes the client retry the NEXT seed with the
+                          PR 2 decorrelated-jitter backoff ladder —
+                          bootstrap succeeds if ANY seed answers
+``fleet.rollout``         serve/fleet.py, after each rollout phase's
+                          intent record is gossiped and before the
+                          phase runs: a crash here is the controller
+                          dying mid-rollout with its intent already on
+                          the wire — the crash-safe-rollout chaos tests
+                          prove a successor completes or aborts from
+                          the gossiped intent, never a half-flipped
+                          fleet
 ``autoscale.action``      serve/autoscaler.py, between a scale decision
                           and its rollout action: a fault here is the
                           controller dying (or being refused) after

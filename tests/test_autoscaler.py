@@ -260,6 +260,42 @@ def test_drain_callback_only_after_full_drain():
     assert released[0] == fleet.ins[-1]
 
 
+@pytest.mark.gossip
+def test_orphaned_rollout_intent_adopted_after_drain_horizon():
+    """Crash-safe rollouts' closed loop (serve/gossip.py): a gossiped
+    rollout intent OLDER than the drain horizon was orphaned by a dead
+    controller — the autoscaler's tick adopts it through
+    ``resume_rollout``. A younger intent belongs to a live controller
+    and is left alone; fleets without the gossip plane are skipped
+    (the other units here never trip this path)."""
+    from spark_rapids_ml_tpu import config
+
+    fleet = _FakeFleet(2)
+    horizon = float(config.get("fleet_drain_timeout_s"))
+    intents = {
+        "orphan": {"model": "orphan", "from_version": 1, "to_version": 2,
+                   "phase": "flipped", "by": "ctl-dead",
+                   "at": time.time() - horizon - 60.0},
+        "young": {"model": "young", "from_version": 1, "to_version": 2,
+                  "phase": "registering", "by": "ctl-live",
+                  "at": time.time()},
+    }
+    fleet.table.intents = lambda: dict(intents)
+    calls = []
+    fleet.resume_rollout = lambda model: (
+        calls.append(model) or {"action": "completed", "model": model,
+                                "version": 2}
+    )
+    t = [0.0]
+    sample = {"queued": 4.0, "sheds_total": 0.0, "p99_s": None}  # hold band
+    sc = _scaler(fleet, sample, lambda: t[0])
+    metrics_mod.reset()
+    sc.tick()
+    assert calls == ["orphan"]
+    assert _counter("srml_autoscale_actions_total",
+                    action="resume_rollout", outcome="ok") == 1.0
+
+
 def test_inverted_watermarks_rejected():
     with pytest.raises(ValueError, match="hysteresis"):
         _scaler(_FakeFleet(1), {}, time.monotonic,

@@ -1,0 +1,650 @@
+"""Gossiped fleet control-plane tests (serve/gossip.py + the bootstrap
+and crash-safe-rollout planes of serve/router.py / serve/fleet.py).
+
+The load-bearing claims, in test order:
+
+* **merge rule** — ``(epoch, boot_id)`` dominance is a total order, the
+  merge is commutative (two islands converge on ONE winner), tombstones
+  never resurrect a retired replica/version, and a record NEWER than a
+  tombstone re-deploying the same version number survives the merge;
+* **bootstrap** — one seed address yields the whole fleet (ring, active
+  versions, live intent); dead seeds fail over down the seed list on
+  the backoff ladder; a faulted ``fleet.bootstrap`` attempt retries the
+  next seed;
+* **resync** — a bootstrapped client that straddles a rollout heals
+  in-band from the answering daemon instead of erroring;
+* **partition heal** — two gossip islands with divergent model records
+  converge after one bridged push: the dominant-epoch record wins
+  everywhere and the retired version stays tombstoned on every view;
+* **crash-safe rollouts** — a controller dying BEFORE the flip is
+  aborted by its successor (the old version never stops serving,
+  bitwise); dying AT/AFTER the flip is completed (the new version
+  serves, bitwise); aborted-then-retried re-deploys of the SAME version
+  number work despite the tombstone;
+* **chaos flagships** — a traffic client SIGKILLed mid-stream is
+  replaced by a successor booted from ONE different seed with zero
+  failures; the acceptance flagship kills a REAL subprocess controller
+  (``SRML_FAULT_PLAN`` crash, exit 17) mid-rollout under live traffic —
+  the successor finishes the rollout from the gossiped intent and no
+  request fails or spans versions.
+
+Also here: the ``tools.top --fleet`` gossiped-panel unit.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve import (
+    DataPlaneClient,
+    DataPlaneDaemon,
+    FleetClient,
+    FleetUnavailable,
+    FleetView,
+    ModelFleet,
+    bootstrap_table,
+)
+from spark_rapids_ml_tpu.serve.gossip import dominates
+from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+
+pytestmark = pytest.mark.gossip
+
+D = 16
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leaks():
+    yield
+    faults.deactivate()
+    assert faults.active_plan() is None
+
+
+def _counter(name, **labels):
+    snap = metrics_mod.snapshot()
+    total = 0.0
+    for s in (snap.get(name) or {}).get("samples", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += float(s.get("value", 0.0))
+    return total
+
+
+@pytest.fixture
+def pca_v1_v2(rng, mesh8):
+    """Two DIFFERENT fitted PCA versions + their transform oracles for a
+    fixed query batch: the bitwise ground truth per version."""
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    basis = rng.normal(size=(D, D)) * np.logspace(0, -1.5, D)
+    data = rng.normal(size=(400, D)) @ basis
+    m1 = PCA(mesh=mesh8).setK(3).fit({"features": data})
+    m2 = PCA(mesh=mesh8).setK(2).fit({"features": data})
+    q = rng.normal(size=(12, D))
+    return {
+        "q": q,
+        "v1": m1._model_data(),
+        "v2": m2._model_data(),
+        "ref1": np.asarray(m1.transform_matrix(q)["output"]),
+        "ref2": np.asarray(m2.transform_matrix(q)["output"]),
+    }
+
+
+@pytest.fixture
+def trio(mesh8):
+    """Three in-process replica daemons + their seed address strings."""
+    daemons = [DataPlaneDaemon(mesh=mesh8).start() for _ in range(3)]
+    try:
+        yield daemons, [f"{h}:{p}" for h, p in (d.address for d in daemons)]
+    finally:
+        for d in daemons:
+            d.stop()
+
+
+def _endpoints(addrs):
+    return [(a.rsplit(":", 1)[0], int(a.rsplit(":", 1)[1])) for a in addrs]
+
+
+def _launch_worker(args, fault_spec=None):
+    """One tests/rollout_worker.py subprocess with the shared f64 parity
+    env (same profile as conftest's daemon workers — the worker's routed
+    responses are compared bitwise against in-session oracles)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "True"
+    env["SRML_TPU_ACCUM_DTYPE"] = "float64"
+    env["SRML_TPU_COMPUTE_DTYPE"] = "float64"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    if fault_spec:
+        env["SRML_FAULT_PLAN"] = fault_spec
+    argv = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "rollout_worker.py"),
+    ] + [str(a) for a in args]
+    return subprocess.Popen(
+        argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        cwd=repo_root, env=env, text=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# FleetView merge rule: dominance, commutativity, tombstones
+# ---------------------------------------------------------------------------
+
+
+def test_dominance_is_a_total_order():
+    assert dominates(2, "a", 1, "z")  # higher epoch wins outright
+    assert not dominates(1, "z", 2, "a")
+    assert dominates(3, "b", 3, "a")  # tie breaks on boot_id
+    assert not dominates(3, "a", 3, "b")
+    assert not dominates(3, "a", 3, "a")  # equal records: neither wins
+
+
+def test_merge_is_commutative_and_converges():
+    """Two views with a conflicting model record converge to the SAME
+    winner whichever direction the wires flow — the property that lets
+    two healed islands agree without a coordinator."""
+    a, b = FleetView(), FleetView()
+    a.set_model("m", 1, 1, "ctl-a")
+    b.set_model("m", 2, 2, "ctl-b")  # later write on the shared clock
+    a.observe_replica("s1", "h:1", "boot1")
+    b.observe_replica("s2", "h:2", "boot2")
+    wa, wb = a.to_wire(), b.to_wire()
+    a.merge(wb)
+    b.merge(wa)
+    ra, rb = a.model("m"), b.model("m")
+    assert ra == rb
+    assert ra["active_version"] == 2 and ra["boot_id"] == "ctl-b"
+    assert {r["server_id"] for r in a.replicas()} == {"s1", "s2"}
+    assert {r["server_id"] for r in b.replicas()} == {"s1", "s2"}
+    # Idempotent: re-merging the same wire adopts nothing.
+    assert a.merge(b.to_wire()) == 0
+
+
+def test_replica_tombstone_never_resurrects():
+    a, b = FleetView(), FleetView()
+    a.observe_replica("s1", "h:1", "boot1")
+    stale = a.to_wire()  # an island's last sight of s1 alive
+    b.merge(stale)
+    a.tombstone_replica("s1")
+    b.merge(a.to_wire())
+    assert b.replicas(liveness="tombstone")[0]["server_id"] == "s1"
+    # The stale "up" record arrives AFTER the tombstone (partition
+    # heal): its epoch is older, so the tombstone holds.
+    b.merge(stale)
+    assert b.replicas(liveness="up") == []
+    assert b.replicas(liveness="tombstone")[0]["server_id"] == "s1"
+
+
+def test_model_tombstone_degrades_only_stale_actives():
+    """A record whose active version is tombstoned at a NEWER epoch
+    degrades to no-active (never resurrect); a record written AFTER the
+    tombstone that re-activates the same version number is a genuine
+    re-deploy and survives."""
+    v = FleetView(tombstone_ttl_s=0)  # keep tombstones forever
+    # Stale active (record epoch 3) vs newer tombstone (epoch 5).
+    v.merge({"epoch": 5, "models": {"m": {
+        "active_version": 2, "fleet_epoch": 1, "epoch": 3,
+        "boot_id": "ctl-x", "tombstones": {"2": {"epoch": 5, "at": 1.0}},
+    }}})
+    assert v.model("m")["active_version"] is None
+    # Re-deploy: record epoch 9 beats the tombstone's 5.
+    v.merge({"epoch": 9, "models": {"m": {
+        "active_version": 2, "fleet_epoch": 2, "epoch": 9,
+        "boot_id": "ctl-y", "tombstones": {"2": {"epoch": 5, "at": 1.0}},
+    }}})
+    rec = v.model("m")
+    assert rec["active_version"] == 2
+    assert "2" in rec["tombstones"]  # the tombstone itself still gossips
+
+
+def test_tombstone_ttl_prunes_after_the_window():
+    now = [100.0]
+    v = FleetView(tombstone_ttl_s=10.0, clock=lambda: now[0])
+    v.observe_replica("s1", "h:1", "boot1")
+    v.tombstone_replica("s1")
+    v.set_model("m", 2, 1, "ctl-a", tombstone_versions=(1,))
+    now[0] += 5.0
+    v.merge({})  # prune runs on every merge: inside the window, kept
+    assert v.replicas(liveness="tombstone")
+    assert "1" in v.model("m")["tombstones"]
+    now[0] += 20.0
+    v.merge({})
+    assert v.replicas() == []
+    assert v.model("m")["tombstones"] == {}
+
+
+def test_top_renders_gossiped_fleet_panel():
+    from spark_rapids_ml_tpu.tools.top import render_fleet_view
+
+    view = {
+        "wire_v": 1, "epoch": 7,
+        "replicas": {
+            "s1": {"server_id": "s1", "addr": "127.0.0.1:7001",
+                   "boot_id": "boot1", "liveness": "up", "epoch": 5,
+                   "last_seen": 0.0},
+            "s2": {"server_id": "s2", "addr": "127.0.0.1:7002",
+                   "boot_id": "boot2", "liveness": "tombstone",
+                   "epoch": 6, "last_seen": 0.0},
+        },
+        "models": {"m": {
+            "model": "m", "active_version": 2, "fleet_epoch": 3,
+            "epoch": 7, "boot_id": "ctl-x",
+            "intent": {"model": "m", "from_version": 1, "to_version": 2,
+                       "phase": "draining", "by": "ctl-x", "at": 0.0},
+            "tombstones": {"1": {"epoch": 7, "at": 0.0}},
+        }},
+    }
+    txt = render_fleet_view(
+        view, healths={"127.0.0.1:7001": {"busy": False}}
+    )
+    assert "view epoch 7" in txt
+    assert "tombstone:1" in txt and "up:1" in txt
+    assert "draining v1→v2 by ctl-x" in txt
+    assert "v1" in txt.splitlines()[-1]  # the tombstone column
+
+
+# ---------------------------------------------------------------------------
+# bootstrap: one seed → whole fleet; seed failover; resync
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_bootstrap_from_one_seed_builds_the_whole_ring(trio, pca_v1_v2):
+    daemons, addrs = trio
+    with ModelFleet(_endpoints(addrs)) as fleet:
+        fleet.register("bm", "pca", pca_v1_v2["v1"], version=1)
+        t = bootstrap_table([addrs[0]])  # ONE seed, no roster
+        assert len(t.replicas()) == 3
+        assert t.snapshot("bm") == (1, 1, "bm@v1")
+        with FleetClient.from_seeds([addrs[2]]) as fc:
+            out = np.asarray(
+                fc.transform("bm", pca_v1_v2["q"])["output"]
+            )
+        assert np.array_equal(out, pca_v1_v2["ref1"])
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_bootstrap_fails_over_dead_and_faulted_seeds(trio, pca_v1_v2):
+    daemons, addrs = trio
+    with ModelFleet(_endpoints(addrs)) as fleet:
+        fleet.register("sm", "pca", pca_v1_v2["v1"], version=1)
+        dead = "127.0.0.1:9"  # no listener: refused instantly
+        metrics_mod.reset()
+        # A dead first seed falls through to the live second.
+        t = bootstrap_table([dead, addrs[0]])
+        assert len(t.replicas()) == 3
+        # An INJECTED drop on the first attempt does the same.
+        plan = faults.FaultPlan().rule("fleet.bootstrap", "drop", times=1)
+        with faults.active(plan):
+            t = bootstrap_table([dead, addrs[1]])
+        assert t.snapshot("sm") == (1, 1, "sm@v1")
+        assert _counter("srml_fleet_bootstraps_total", outcome="ok") == 2
+        assert _counter("srml_fleet_bootstraps_total", outcome="error") >= 2
+        # All seeds dead: FleetUnavailable after the pass budget.
+        with pytest.raises(FleetUnavailable):
+            bootstrap_table([dead], passes=1)
+
+
+@pytest.mark.fleet
+def test_stale_bootstrapped_client_resyncs_across_a_rollout(trio, pca_v1_v2):
+    """A client bootstrapped BEFORE a rollout keeps serving across it:
+    its first post-rollout request hits the version fence / dropped
+    registration, pulls the view from the answering daemon, re-pins,
+    and answers bitwise from the NEW version — no surfaced error."""
+    daemons, addrs = trio
+    q = pca_v1_v2["q"]
+    with ModelFleet(_endpoints(addrs)) as fleet:
+        fleet.register("rm", "pca", pca_v1_v2["v1"], version=1)
+        with FleetClient.from_seeds([addrs[0]]) as fc:
+            out = np.asarray(fc.transform("rm", q)["output"])
+            assert np.array_equal(out, pca_v1_v2["ref1"])
+            metrics_mod.reset()
+            fleet.rollout("rm", "pca", pca_v1_v2["v2"], version=2,
+                          warm=False)
+            out = np.asarray(fc.transform("rm", q)["output"])
+            assert np.array_equal(out, pca_v1_v2["ref2"])
+            assert _counter(
+                "srml_fleet_bootstraps_total", outcome="resync"
+            ) >= 1
+
+
+# ---------------------------------------------------------------------------
+# partition heal: two islands converge, dominant epoch wins
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_partition_heal_converges_and_never_resurrects(mesh8, pca_v1_v2):
+    """Two 2-daemon gossip islands with DIVERGENT model records (island
+    B last saw v1 active; island A rolled to v2 and tombstoned v1) heal
+    through one bridged push + anti-entropy ticks: every daemon's view
+    converges on island A's dominant-epoch record, and the tombstoned
+    v1 never comes back anywhere."""
+    daemons = [
+        DataPlaneDaemon(mesh=mesh8, gossip_interval_s=0).start()
+        for _ in range(4)
+    ]
+    try:
+        addrs = [f"{h}:{p}" for h, p in (d.address for d in daemons)]
+        island_a, island_b = _endpoints(addrs[:2]), _endpoints(addrs[2:])
+        # Island B writes first (older epochs): v1 active.
+        with ModelFleet(island_b) as fb:
+            fb.register("pm", "pca", pca_v1_v2["v1"], version=1)
+        # Island A writes later (dominant epochs): v1 → v2, v1 tombstoned.
+        with ModelFleet(island_a) as fa:
+            fa.register("pm", "pca", pca_v1_v2["v1"], version=1)
+            res = fa.rollout("pm", "pca", pca_v1_v2["v2"], version=2,
+                            warm=False)
+            assert res["drained"]
+        rec_b = daemons[2].fleet_view.model("pm")
+        assert rec_b["active_version"] == 1  # divergence before the heal
+
+        def converged():
+            wires = [d.fleet_view.to_wire() for d in daemons]
+            recs = [w["models"].get("pm") for w in wires]
+            if any(r is None for r in recs):
+                return False
+            if any(r["active_version"] != 2 for r in recs):
+                return False
+            if len({r["epoch"] for r in recs}) != 1:
+                return False
+            if any("1" not in (r["tombstones"] or {}) for r in recs):
+                return False
+            return all(
+                len([x for x in w["replicas"].values()
+                     if x["liveness"] == "up"]) == 4
+                for w in wires
+            )
+
+        # The heal: ONE bridged push introduces the islands...
+        with DataPlaneClient(*island_b[0]) as c:
+            c.gossip_push(daemons[0].fleet_view.to_wire())
+        # ...and plain anti-entropy ticks finish the convergence.
+        deadline = time.time() + 15.0
+        while not converged() and time.time() < deadline:
+            for d in daemons:
+                d._gossip_tick()
+        assert converged(), [
+            d.fleet_view.model("pm") for d in daemons
+        ]
+        assert _counter("srml_gossip_ticks_total") > 0
+    finally:
+        for d in daemons:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe rollouts: interrupted controllers, successors
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_interrupted_rollout_before_flip_aborts_cleanly(trio, pca_v1_v2):
+    """Controller dies right after gossiping the ``registering`` intent:
+    nothing flipped, so the successor ABORTS — v1 never stops serving
+    (bitwise), v2 is tombstoned... and a RETRIED rollout to the same
+    version number still works (the re-deploy rule)."""
+    daemons, addrs = trio
+    q = pca_v1_v2["q"]
+    with ModelFleet(_endpoints(addrs)) as fleet:
+        fleet.register("am", "pca", pca_v1_v2["v1"], version=1)
+        plan = faults.FaultPlan().rule("fleet.rollout", "drop", times=1)
+        with faults.active(plan):
+            with pytest.raises(ConnectionError):
+                fleet.rollout("am", "pca", pca_v1_v2["v2"], version=2,
+                              warm=False)
+    with ModelFleet.from_seeds([addrs[0]]) as successor:
+        intent = successor.table.intent("am")
+        assert intent and intent["phase"] == "registering"
+        res = successor.resume_rollout("am")
+        assert res["action"] == "aborted" and res["version"] == 2
+        assert successor.table.snapshot("am") == (1, 1, "am@v1")
+        with successor.client() as fc:
+            out = np.asarray(fc.transform("am", q)["output"])
+        assert np.array_equal(out, pca_v1_v2["ref1"])
+        # Retried re-deploy of the SAME version number despite its
+        # tombstone: a record newer than the tombstone wins.
+        res = successor.resume_rollout("am")
+        assert res["action"] == "none"  # intent is gone
+        successor.rollout("am", "pca", pca_v1_v2["v2"], version=2,
+                          warm=False)
+        with successor.client() as fc:
+            out = np.asarray(fc.transform("am", q)["output"])
+        assert np.array_equal(out, pca_v1_v2["ref2"])
+    rec = daemons[0].fleet_view.model("am")
+    assert rec["active_version"] == 2 and rec["intent"] is None
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_interrupted_rollout_after_flip_completes(trio, pca_v1_v2):
+    """Controller dies at the ``draining`` intent: the flip is durable
+    in the view, so the successor COMPLETES — v2 serves bitwise, v1 is
+    drained, dropped and tombstoned everywhere."""
+    daemons, addrs = trio
+    q = pca_v1_v2["q"]
+    with ModelFleet(_endpoints(addrs)) as fleet:
+        fleet.register("cm", "pca", pca_v1_v2["v1"], version=1)
+        # Checkpoints with warm=False: registering(1), flipped(2),
+        # draining(3) — after=2 dies at the third.
+        plan = faults.FaultPlan().rule("fleet.rollout", "drop",
+                                       after=2, times=1)
+        with faults.active(plan):
+            with pytest.raises(ConnectionError):
+                fleet.rollout("cm", "pca", pca_v1_v2["v2"], version=2,
+                              warm=False)
+    with ModelFleet.from_seeds([addrs[1]]) as successor:
+        intent = successor.table.intent("cm")
+        assert intent and intent["phase"] == "draining"
+        res = successor.resume_rollout("cm")
+        assert res["action"] == "completed"
+        assert res["version"] == 2 and res["drained"]
+        with successor.client() as fc:
+            out = np.asarray(fc.transform("cm", q)["output"])
+        assert np.array_equal(out, pca_v1_v2["ref2"])
+    rec = daemons[0].fleet_view.model("cm")
+    assert rec["active_version"] == 2 and rec["intent"] is None
+    assert "1" in rec["tombstones"]
+
+
+# ---------------------------------------------------------------------------
+# chaos flagships: SIGKILLed client, SIGKILLed controller
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_client_sigkilled_mid_traffic_successor_boots_from_one_seed(
+        trio, pca_v1_v2, tmp_path):
+    """A REAL subprocess client (its own bootstrap, its own routing
+    table) is SIGKILLed mid-stream; a successor built from ONE
+    *different* seed address resumes routing with zero failed requests
+    and bitwise-identical responses — client state is disposable."""
+    daemons, addrs = trio
+    q = pca_v1_v2["q"]
+    with ModelFleet(_endpoints(addrs)) as fleet:
+        fleet.register("km", "pca", pca_v1_v2["v1"], version=1)
+        npz = tmp_path / "km.npz"
+        np.savez(npz, q=q, ref=pca_v1_v2["ref1"])
+        proc = _launch_worker(["traffic", addrs[0], npz, "km", 0])
+        try:
+            lines = [proc.stdout.readline().strip() for _ in range(3)]
+            assert all(ln.startswith("OK") for ln in lines), lines
+            proc.kill()  # SIGKILL, mid-traffic
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:  # pragma: no cover
+                proc.kill()
+        assert proc.returncode == -signal.SIGKILL
+        with FleetClient.from_seeds([addrs[1]]) as fc:
+            for i in range(10):
+                out = np.asarray(
+                    fc.transform("km", q,
+                                 route_key=f"k{i}")["output"]
+                )
+                assert np.array_equal(out, pca_v1_v2["ref1"])
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_flagship_controller_dies_mid_rollout_successor_finishes(
+        trio, pca_v1_v2, tmp_path):
+    """THE acceptance flagship: a 3-replica fleet under live traffic; a
+    subprocess controller — itself bootstrapped from one seed — dies
+    abruptly (exit 17) at the ``flipped`` intent checkpoint, AFTER the
+    flip intent gossiped but BEFORE the flip ran. A successor
+    controller bootstraps from a DIFFERENT single seed, finishes the
+    rollout from the gossiped intent, and across the whole timeline
+    zero requests fail and every response is bitwise v1 or bitwise v2
+    — none ever spans versions, and each client's stream flips
+    monotonically."""
+    daemons, addrs = trio
+    q, ref1, ref2 = pca_v1_v2["q"], pca_v1_v2["ref1"], pca_v1_v2["ref2"]
+    with ModelFleet(_endpoints(addrs)) as boss:
+        boss.register("gm", "pca", pca_v1_v2["v1"], version=1)
+    # The boss is GONE (closed): everything below runs on gossiped
+    # state alone.
+    npz = tmp_path / "gm.npz"
+    np.savez(npz, **{
+        f"v2.{k}": np.asarray(v) for k, v in pca_v1_v2["v2"].items()
+    })
+
+    n_workers = 3
+    stop = threading.Event()
+    results = [[] for _ in range(n_workers)]
+
+    def pound(i):
+        with FleetClient.from_seeds([addrs[i % len(addrs)]]) as fc:
+            def one():
+                try:
+                    out = np.asarray(fc.transform(
+                        "gm", q, route_key=f"w{i}"
+                    )["output"])
+                except Exception as e:  # noqa: BLE001 - tallied below
+                    results[i].append(("fail", repr(e)))
+                    return
+                if np.array_equal(out, ref1):
+                    results[i].append("v1")
+                elif np.array_equal(out, ref2):
+                    results[i].append("v2")
+                else:
+                    results[i].append(("mixed", out.shape))
+            while not stop.is_set():
+                one()
+                time.sleep(0.01)
+            one()  # one guaranteed post-resume request per worker
+
+    threads = [threading.Thread(target=pound, args=(i,))
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    try:
+        # Checkpoints with warm=False: registering(1), flipped(2) —
+        # after=1 dies at the second, with v2 registered everywhere
+        # and the flip intent gossiped but the flip NOT executed.
+        proc = _launch_worker(
+            ["rollout", addrs[0], npz, "gm", 2],
+            fault_spec="fleet.rollout:crash:after=1,times=1",
+        )
+        assert proc.wait(timeout=180) == 17  # a real mid-rollout death
+        with ModelFleet.from_seeds([addrs[1]]) as successor:
+            intent = successor.table.intent("gm")
+            assert intent, "the rollout intent did not survive its controller"
+            assert intent["phase"] == "flipped"
+            assert intent["to_version"] == 2
+            res = successor.resume_rollout("gm")
+        assert res["action"] == "completed"
+        assert res["version"] == 2 and res["drained"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    flat = [r for rs in results for r in rs]
+    fails = [r for r in flat if not isinstance(r, str)]
+    assert fails == [], fails[:5]  # ZERO failed / mixed-version responses
+    for rs in results:
+        assert rs, "a worker routed nothing"
+        assert rs[-1] == "v2"  # every stream ends on the new version
+        cut = rs.index("v2")
+        assert all(r == "v1" for r in rs[:cut])
+        assert all(r == "v2" for r in rs[cut:])  # monotone flip
+    # The gossiped record agrees from ANY daemon: v2 active, v1
+    # tombstoned, no intent left behind.
+    h, p = _endpoints(addrs)[2]
+    with DataPlaneClient(h, p) as c:
+        rec = c.gossip_pull()["models"]["gm"]
+    assert rec["active_version"] == 2
+    assert rec["intent"] is None
+    assert "1" in rec["tombstones"]
+
+
+# ------------- bench --chaos-partition + perfcheck gate ---------------------
+
+
+def test_perfcheck_chaos_partition_gates():
+    """The partition-heal gate's unit matrix (mirror of the chaos
+    elastic/grow ones): correctness — four-view convergence, zero
+    failed/wobbling requests inside the split (with at least one
+    routed), every view tombstoning the losing island's version — is
+    ABSOLUTE; time-to-converge gates against the metric-matched
+    trajectory and SKIPs — never passes — without history; the other
+    chaos families sharing the CHAOS_r* glob never pollute the
+    partition trajectory."""
+    from spark_rapids_ml_tpu.tools import perfcheck
+
+    good = {
+        "metric": "chaos_partition_converge_d4",
+        "mode": "chaos_partition", "value": 0.09,
+        "time_to_converge_s": 0.09, "converged": True,
+        "routed_during_partition": 13, "failed_during_partition": 0,
+        "mismatched_during_partition": 0, "tombstones_clean": True,
+        "n_daemons": 4, "gossip_interval_s": 0.05, "gossip_fanout": 2,
+    }
+    ok, lines = perfcheck.check_chaos_partition(good, [])
+    assert ok and any("SKIP" in ln for ln in lines)
+    ok, lines = perfcheck.check_chaos_partition(
+        dict(good, converged=False), []
+    )
+    assert not ok and any("FAIL" in ln for ln in lines)
+    ok, _ = perfcheck.check_chaos_partition(
+        dict(good, routed_during_partition=0), [good]
+    )
+    assert not ok  # the split's data plane was never exercised
+    ok, _ = perfcheck.check_chaos_partition(
+        dict(good, failed_during_partition=3), [good]
+    )
+    assert not ok  # a partition must never fail requests
+    ok, _ = perfcheck.check_chaos_partition(
+        dict(good, mismatched_during_partition=1), [good]
+    )
+    assert not ok  # ... nor wobble their bytes
+    ok, _ = perfcheck.check_chaos_partition(
+        dict(good, tombstones_clean=False), [good]
+    )
+    assert not ok  # the heal could resurrect the losing version
+    ok, _ = perfcheck.check_chaos_partition(dict(good, value=0.5), [good])
+    assert not ok  # convergence got slower than the ceiling
+    ok, _ = perfcheck.check_chaos_partition(dict(good), [good])
+    assert ok  # healthy vs its own trajectory
+    # Degrade/grow records sharing the glob are filtered out: the
+    # partition gate still SKIPs rather than compare across families.
+    elastic = {
+        "metric": "chaos_elastic_replay_rows_per_s_d64_k8",
+        "mode": "chaos_elastic", "value": 1000.0,
+    }
+    ok, lines = perfcheck.check_chaos_partition(good, [elastic])
+    assert ok and any("SKIP" in ln for ln in lines)
+    ok, _ = perfcheck.check_chaos_partition({"metric": "x"}, [])
+    assert not ok  # not a chaos-partition record at all

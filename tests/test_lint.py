@@ -54,10 +54,12 @@ def test_fault_checkpoints_exist_at_contract_sites():
         "serve/client.py": ["client.connect", "client.op"],
         "serve/daemon.py": ["daemon.conn", "daemon.op",
                             "daemon.pass_boundary", "daemon.vanish",
-                            "daemon.join"],
+                            "daemon.join", "gossip.push"],
         "serve/scheduler.py": ["daemon.scheduler"],
         "serve/protocol.py": ["wire.send_frame"],
         "serve/autoscaler.py": ["autoscale.action"],
+        "serve/router.py": ["fleet.bootstrap"],
+        "serve/fleet.py": ["fleet.rollout"],
         "spark/estimator.py": ["daemon.join"],
         "bridge/arrow.py": ["bridge.to_matrix", "bridge.to_ipc"],
     }
@@ -189,10 +191,12 @@ def test_serve_config_keys_have_env_alias_and_docs():
     elastic-scale keys (``autoscale_*`` + ``fit_daemon_join_*``) with
     the scale-up PR (``fit_daemon_join`` specifically — the older
     ``fit_daemon_loss_tolerance``/``fit_daemon_death_timeout_s`` keys
-    predate the gate and use the legacy SRML_TPU_ env prefix)."""
+    predate the gate and use the legacy SRML_TPU_ env prefix); the
+    gossip keys (``gossip_*`` + ``fleet_seed_*``) with the gossiped
+    control-plane PR."""
     text = (PKG / "config.py").read_text()
     keys = sorted(set(re.findall(
-        r'^\s+"((?:serve|fleet|rf|forest|autoscale|fit_daemon_join)'
+        r'^\s+"((?:serve|fleet|rf|forest|autoscale|fit_daemon_join|gossip)'
         r'_[a-z0-9_]+)"\s*:', text, re.M
     )))
     assert len(keys) >= 5, (
@@ -218,6 +222,14 @@ def test_serve_config_keys_have_env_alias_and_docs():
     assert any(k.startswith("fit_daemon_join_") for k in keys), (
         "no fit_daemon_join_* config keys found — the mid-fit join "
         "config block or this regex regressed"
+    )
+    assert any(k.startswith("gossip_") for k in keys), (
+        "no gossip_* config keys found — the gossip config block or "
+        "this regex regressed"
+    )
+    assert any(k.startswith("fleet_seed_") for k in keys), (
+        "no fleet_seed_* config keys found — the bootstrap-seed config "
+        "or this regex regressed"
     )
     docs = (PKG.parent / "docs" / "protocol.md").read_text()
     missing_env = [k for k in keys if f"SRML_{k.upper()}" not in text]
