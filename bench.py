@@ -31,8 +31,12 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 ``python bench.py --serve`` (or SRML_BENCH_SERVE=1) runs the SERVING
 benchmark instead: N concurrent transform clients against one in-process
-daemon, scheduler off then on (serve/scheduler.py), and prints one JSON
-line with QPS, p50/p99 latency, and mean batch occupancy for both modes.
+daemon, scheduler off then on (serve/scheduler.py), then on again with
+the TELEMETRY PLANE hot (SLO evaluation ticking, span ring armed, a live
+``telemetry_pull``/``trace_pull`` scraper — docs/observability.md), and
+prints one JSON line with QPS, p50/p99 latency, and mean batch occupancy
+for all modes plus ``telemetry_overhead`` (the telemetry run's fractional
+QPS cost, gated < 2% by tools/perfcheck.py).
 
 ``python bench.py --chaos-elastic`` (or SRML_BENCH_CHAOS_ELASTIC=1)
 runs the ELASTIC-DEGRADE micro-benchmark: a 3-daemon hub-protocol
@@ -564,10 +568,16 @@ def multichip_bench() -> None:
 def serve_bench() -> None:
     """Serving-plane benchmark: N concurrent transform clients against
     one daemon, micro-batching scheduler off vs on (the PR-5 acceptance
-    number: batching must raise QPS on the same workload). Emits ONE
-    JSON line with both modes' QPS + latency quantiles, the scheduler
-    run's mean batch occupancy, and the standard per-phase metrics
-    breakdown."""
+    number: batching must raise QPS on the same workload), then on WITH
+    the telemetry plane hot — SLO burn-rate evaluation ticking fast, the
+    journal span ring armed, and a concurrent wire scraper draining
+    ``telemetry_pull`` + cursored ``trace_pull`` the way ``tools/top
+    --fleet --telemetry`` does. Emits ONE JSON line with every mode's
+    QPS + latency quantiles, the scheduler run's mean batch occupancy,
+    ``telemetry_overhead`` (fractional QPS cost of the telemetry run vs
+    the plain scheduler-on run; tools/perfcheck.py gates it < 2%), and
+    the standard per-phase metrics breakdown."""
+    import contextlib
     import threading
 
     from spark_rapids_ml_tpu import config
@@ -586,12 +596,25 @@ def serve_bench() -> None:
     arrays = model._model_data()
     queries = rng.standard_normal((clients, rows, d)).astype(np.float32)
 
-    def run(batching: bool) -> dict:
+    def run(batching: bool, telemetry: bool = False) -> dict:
         metrics.reset()
         lat: list = []
         lat_lock = threading.Lock()
         errors: list = []
-        with config.option("serve_batching", batching):
+        opts = [("serve_batching", batching)]
+        if telemetry:
+            # The telemetry plane at its most expensive supported
+            # setting: an SLO objective to evaluate every 50 ms, plus
+            # the wire scraper below. The span ring is armed in every
+            # mode (the production default) — the delta measured here
+            # is evaluation + scraping.
+            opts += [
+                ("slo_objectives", "transform:p99_ms=250@0.01"),
+                ("telemetry_eval_interval_s", 0.05),
+            ]
+        with contextlib.ExitStack() as stack:
+            for key, val in opts:
+                stack.enter_context(config.option(key, val))
             with DataPlaneDaemon() as daemon:
                 host, port = daemon.address
                 with DataPlaneClient(host, port) as c0:
@@ -600,6 +623,30 @@ def serve_bench() -> None:
                         c0.warmup("bench-serve", n_cols=d, dtype="float32")
                     else:  # same warm jit caches for the off mode
                         c0.transform("bench-serve", queries[0])
+                scrape_stop = threading.Event()
+                pulls = [0]
+
+                def scraper() -> None:
+                    # What tools/top --fleet --telemetry does to every
+                    # replica, at an aggressive cadence: full telemetry
+                    # export + cursored trace drain, on its own
+                    # connection, competing with the serving traffic.
+                    cursor = 0
+                    with DataPlaneClient(host, port) as sc:
+                        while not scrape_stop.wait(0.05):
+                            sc.telemetry_pull()
+                            cursor = int(
+                                sc.trace_pull(cursor).get("seq") or cursor
+                            )
+                            pulls[0] += 1
+
+                scrape_thread = None
+                if telemetry:
+                    scrape_thread = threading.Thread(
+                        target=scraper, name="bench-telemetry-scraper",
+                        daemon=True,
+                    )
+                    scrape_thread.start()
                 barrier = threading.Barrier(clients)
 
                 def worker(i: int) -> None:
@@ -632,6 +679,9 @@ def serve_bench() -> None:
                 for t in threads:
                     t.join()
                 wall = time.perf_counter() - t0
+                if scrape_thread is not None:
+                    scrape_stop.set()
+                    scrape_thread.join(timeout=10)
         if errors:
             raise RuntimeError(
                 f"{len(errors)}/{clients} serve-bench workers failed "
@@ -649,20 +699,35 @@ def serve_bench() -> None:
         }
         if count:
             out["mean_batch_occupancy"] = round(total / count, 2)
+        if telemetry:
+            out["scrapes"] = pulls[0]
         return out
 
     off = run(False)
     metrics.reset()
     on = run(True)
+    on_breakdown = _metrics_breakdown(metrics.snapshot())
+    metrics.reset()
+    tel = run(True, telemetry=True)
+    overhead = (
+        round(max(0.0, 1.0 - tel["qps"] / on["qps"]), 4)
+        if on["qps"] else None
+    )
     print(json.dumps({
         "metric": f"serve_transform_qps_d{d}_k{k}_c{clients}_b{rows}",
+        # Headline value = the production configuration's QPS
+        # (scheduler on, telemetry plane at its defaults): what the
+        # perfcheck throughput gate tracks against the trajectory.
+        "value": on["qps"],
         "unit": "transforms/s",
         "clients": clients,
         "batch_rows": rows,
         "scheduler_off": off,
         "scheduler_on": on,
+        "telemetry_on": tel,
+        "telemetry_overhead": overhead,
         "speedup": round(on["qps"] / off["qps"], 3) if off["qps"] else None,
-        "metrics": _metrics_breakdown(metrics.snapshot()),
+        "metrics": on_breakdown,
     }))
 
 

@@ -133,6 +133,13 @@ _DEFAULTS: Dict[str, Any] = {
     # no I/O). Env key is SRML_RUN_JOURNAL — deployment-facing like
     # SRML_DAEMON_ADDRESS / SRML_FAULT_PLAN, hence no SRML_TPU_ prefix.
     "run_journal": os.environ.get("SRML_RUN_JOURNAL") or None,
+    # Journal file rotation (utils/journal.py): when > 0, the journal
+    # rotates logrotate-style (path → path.1 → …) before a line would
+    # cross the byte cap; run_journal_keep rotated segments are
+    # retained. 0 = unbounded append (REQUIRED when several processes
+    # share one journal path — rotation is single-writer).
+    "run_journal_max_bytes": _env_named("SRML_RUN_JOURNAL_MAX_BYTES", 0, int),
+    "run_journal_keep": _env_named("SRML_RUN_JOURNAL_KEEP", 4, int),
     # Jit-ledger device timing mode (utils/xprof.py): every ledgered jit
     # call is bracketed with block_until_ready so per-call execution
     # wall-clock (and thus achieved flops/s and bytes/s) is measurable.
@@ -349,6 +356,65 @@ _DEFAULTS: Dict[str, Any] = {
     "autoscale_p99_deadline_s": _env_named(
         "SRML_AUTOSCALE_P99_DEADLINE_S", 0.0, float
     ),
+    # --- Telemetry plane (docs/observability.md, docs/protocol.md
+    # "Telemetry plane ops"). Env keys are deployment-facing (SRML_*),
+    # like SRML_SERVE_*. ---
+    # In-memory journal-event ring the daemon arms at start
+    # (utils/journal.py ring_arm): the event source for the `trace_pull`
+    # wire op and the flight recorder, independent of any journal FILE.
+    # 0 disables both (trace_pull answers empty, incident bundles carry
+    # no spans).
+    "telemetry_trace_buffer": _env_named(
+        "SRML_TELEMETRY_TRACE_BUFFER", 4096, int
+    ),
+    # Histogram exemplar freshness window (utils/metrics.py): per bucket,
+    # the worst exemplared sample of the last window is kept; an older
+    # exemplar yields the slot to the next sample regardless of value.
+    "telemetry_exemplar_window_s": _env_named(
+        "SRML_TELEMETRY_EXEMPLAR_WINDOW_S", 60.0, float
+    ),
+    # Daemon telemetry-evaluation cadence: the background thread that
+    # snapshots metrics, evaluates SLO burn rates (utils/slo.py), and
+    # checks flight-recorder trigger conditions. 0 disables the thread
+    # (SLO gauges and automatic incident capture off; telemetry_pull /
+    # trace_pull still answer).
+    "telemetry_eval_interval_s": _env_named(
+        "SRML_TELEMETRY_EVAL_INTERVAL_S", 1.0, float
+    ),
+    # Declared per-op SLOs (utils/slo.py), semicolon-separated:
+    # "<op>:<kind>[=<target>]@<budget>" with kind ∈ p99_ms|error|shed,
+    # e.g. "transform:p99_ms=50@0.01;transform:error@0.001". Empty = no
+    # objectives, nothing evaluated.
+    "slo_objectives": _env_named("SRML_SLO_OBJECTIVES", "", str),
+    # Multi-window burn-rate windows (SRE convention: BOTH windows must
+    # burn above slo_burn_threshold to breach — the fast window catches
+    # it quickly, the slow window debounces blips).
+    "slo_fast_window_s": _env_named("SRML_SLO_FAST_WINDOW_S", 60.0, float),
+    "slo_slow_window_s": _env_named("SRML_SLO_SLOW_WINDOW_S", 300.0, float),
+    # Burn-rate breach threshold: burning budget at ≥ this multiple of
+    # the sustainable rate in both windows raises srml_slo_breach (and
+    # the flight-recorder trigger).
+    "slo_burn_threshold": _env_named("SRML_SLO_BURN_THRESHOLD", 14.4, float),
+    # Flight recorder (utils/flight.py): incident bundles land in
+    # state_dir/incidents/, newest-first, capped at this many (oldest
+    # deleted). 0 disables dumping entirely.
+    "incident_max_bundles": _env_named("SRML_INCIDENT_MAX_BUNDLES", 16, int),
+    # Debounce: minimum seconds between bundles for the SAME trigger
+    # reason — a sustained storm yields one bundle per window, not one
+    # per tick.
+    "incident_min_interval_s": _env_named(
+        "SRML_INCIDENT_MIN_INTERVAL_S", 30.0, float
+    ),
+    # Automatic trigger thresholds, evaluated per telemetry tick as
+    # RATES (events/second over the tick window). 0 = trigger off.
+    "incident_shed_rate": _env_named("SRML_INCIDENT_SHED_RATE", 0.0, float),
+    "incident_deadline_rate": _env_named(
+        "SRML_INCIDENT_DEADLINE_RATE", 0.0, float
+    ),
+    # Dump a bundle on fatal teardown (SIGTERM / atexit while a recorder
+    # is armed). Off by default: test daemons exit constantly and a
+    # bundle per clean exit is noise; production supervisors flip it on.
+    "incident_on_fatal": _env_named("SRML_INCIDENT_ON_FATAL", False, _as_bool),
     # Served-model registry cap (0 = unbounded): past it, the least-
     # recently-used re-creatable registration is evicted (clients
     # re-register on miss); daemon-built KNN indexes are evicted only
@@ -480,6 +546,22 @@ def reset() -> None:
     with _lock:
         _conf.clear()
         _conf.update(_DEFAULTS)
+
+
+def fingerprint() -> str:
+    """Stable short hash of the CURRENT config (raw values, no "auto"
+    resolution — the fingerprint must not touch a backend). Two
+    processes answering ``telemetry_pull`` with different fingerprints
+    are running different effective configs — the first thing to check
+    when one replica of a fleet misbehaves. Incident bundles
+    (utils/flight.py) carry it for the same reason."""
+    import hashlib
+    import json as _json
+
+    with _lock:
+        items = sorted(_conf.items())
+    blob = _json.dumps(items, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
 
 class option:

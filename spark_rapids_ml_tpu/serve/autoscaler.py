@@ -231,12 +231,21 @@ class AutoScaler:
                 for le, n in (s.get("buckets") or {}).items():
                     merged[le] = merged.get(le, 0) + int(n)
             p99 = quantile_from_buckets(merged, 0.99)
+        # SLO burn (utils/slo.py, exported into this same registry):
+        # objectives currently breaching — fast AND slow window both over
+        # slo_burn_threshold. A leading indicator: the burn crosses while
+        # the raw queue still sits below the high watermark.
+        slo_breaches = sum(
+            1 for s in (snap.get("srml_slo_breach") or {}).get("samples", [])
+            if float(s.get("value", 0.0)) >= 1.0
+        )
         return {
             "replicas": len(live),
             "queued": queued,
             "busy": busy,
             "sheds_total": sheds,
             "p99_s": p99,
+            "slo_breaches": slo_breaches,
         }
 
     # -- decision ----------------------------------------------------------
@@ -261,8 +270,15 @@ class AutoScaler:
             self.p99_deadline_s and p99 is not None
             and p99 > self.p99_deadline_s
         )
+        slo_breaches = int(sample.get("slo_breaches") or 0)
         reason = "load"
-        if load >= self.high:
+        if slo_breaches > 0:
+            # A burning SLO (utils/slo.py: fast AND slow window both over
+            # slo_burn_threshold) forces up BEFORE the raw watermarks
+            # trip: the burn rate is budget-relative, so it pages on a
+            # p99 regression the absolute queue signal cannot see yet.
+            verdict, reason = "up", "slo"
+        elif load >= self.high:
             verdict = "up"
         elif shed_delta > 0:
             # Sheds are refused requests: the fleet is ALREADY over

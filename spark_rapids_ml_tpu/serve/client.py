@@ -428,6 +428,28 @@ class DataPlaneClient:
             return str(resp.get("text", ""))
         return resp.get("metrics", {})
 
+    def telemetry_pull(self) -> Dict[str, Any]:
+        """One-shot wire-native telemetry export (additive op,
+        docs/protocol.md "Telemetry plane ops"): ``text`` (OpenMetrics
+        exposition WITH per-bucket exemplars), ``metrics`` (the JSON
+        registry snapshot), ``xprof`` (jit-ledger summary),
+        ``fingerprint`` (config fingerprint — differing fingerprints
+        across a fleet mean differing effective configs), plus identity
+        and ``uptime_s``. Cursor-free: every pull is the full current
+        state."""
+        resp, _ = self._roundtrip({"op": "telemetry_pull"})
+        return {k: v for k, v in resp.items() if k != "ok"}
+
+    def trace_pull(self, cursor: int = 0) -> Dict[str, Any]:
+        """Journal events from the daemon's in-memory ring with ``seq``
+        greater than ``cursor`` (additive op): ``{"events": […],
+        "seq": N, "id": …, "boot_id": …}``. Store the returned ``seq``
+        as the next call's cursor to stream without duplication; reset
+        the cursor to 0 when ``boot_id`` changes (seq is per-boot). The
+        ring is bounded — events older than the buffer are gone."""
+        resp, _ = self._roundtrip({"op": "trace_pull", "cursor": int(cursor)})
+        return {k: v for k, v in resp.items() if k != "ok"}
+
     def server_id(self) -> Optional[str]:
         """The daemon's self-reported instance id (from ping). Address
         strings alias (localhost vs 127.0.0.1 vs FQDN); this id is how

@@ -97,8 +97,11 @@ from spark_rapids_ml_tpu.serve import gossip as gossip_mod
 from spark_rapids_ml_tpu.serve import protocol
 from spark_rapids_ml_tpu.serve import scheduler as scheduler_mod
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import flight as flight_mod
 from spark_rapids_ml_tpu.utils import journal
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+from spark_rapids_ml_tpu.utils import slo as slo_mod
+from spark_rapids_ml_tpu.utils import xprof as xprof_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.daemon")
@@ -216,6 +219,7 @@ _KNOWN_OPS = frozenset((
     "get_iterate", "set_iterate", "ensure_model", "transform",
     "kneighbors", "model_status", "drop_model", "warmup", "sample_rows",
     "mesh_info", "reduce_mesh", "gossip_push", "gossip_pull",
+    "telemetry_pull", "trace_pull",
 ))
 
 
@@ -229,7 +233,7 @@ def _op_label(op) -> str:
 #: fit tree under polling noise.
 _UNJOURNALED_OPS = frozenset((
     "ping", "health", "metrics", "model_status", "gossip_push",
-    "gossip_pull",
+    "gossip_pull", "telemetry_pull", "trace_pull",
 ))
 
 
@@ -242,18 +246,23 @@ def _op_trace(op: str, req: Dict[str, Any]):
     CALLER's run. One fit then journals a single tree spanning driver +
     executors + N daemons, mergeable by ``tools/trace.py``. Without a
     ctx the span roots itself (the PR 3 standalone-daemon behavior);
-    with the journal off everything here is an early return."""
+    with the journal fully off everything here is an early return.
+
+    Yields the op span's own ``{"run", "span"}`` identity (None when
+    unjournaled): the request-latency histogram records it as the
+    sample's EXEMPLAR, so a latency-bucket outlier on the scrape side
+    links to the exact trace that caused it."""
     tc = req.get("trace_ctx")
     tc = tc if isinstance(tc, dict) else {}
     with journal.adopt(tc.get("run"), tc.get("span")):
-        if op not in _UNJOURNALED_OPS and journal.enabled():
+        if op not in _UNJOURNALED_OPS and journal.active():
             fields = {
                 k: req[k] for k in ("job", "model") if req.get(k) is not None
             }
             with journal.span(f"daemon.{op}", **fields):
-                yield
+                yield journal.trace_ctx()
         else:
-            yield
+            yield None
 
 
 #: Cap on a request's declared raw-array frame count (_recv_arrays_aligned):
@@ -2101,6 +2110,20 @@ class DataPlaneDaemon:
         # sharing a process never walk identical peer sequences.
         self._gossip_rng = random.Random(self.boot_id)
         self._gossip_thread: Optional[threading.Thread] = None
+        # Telemetry plane (docs/observability.md): the journal-event
+        # ring backing trace_pull + the flight recorder, the SLO
+        # evaluator, and the evaluation thread's cadence. 0 interval =
+        # no thread (pull ops still answer).
+        self._trace_buffer = int(config.get("telemetry_trace_buffer") or 0)
+        self._telemetry_eval_s = float(
+            config.get("telemetry_eval_interval_s") or 0.0
+        )
+        self._telemetry_thread: Optional[threading.Thread] = None
+        self._flight: Optional[flight_mod.FlightRecorder] = None
+        self._slo: Optional[slo_mod.SloEvaluator] = None
+        self._last_telemetry_ts: Optional[float] = None
+        self._prev_deadline_sheds = 0.0
+        self._ring_armed = False
         self._stop = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -2157,6 +2180,34 @@ class DataPlaneDaemon:
                 daemon=True,
             )
             self._gossip_thread.start()
+        # Telemetry plane: arm the in-memory journal ring (the event
+        # source for trace_pull and incident bundles — works with no
+        # journal FILE at all), install the flight recorder as this
+        # process's default, subscribe it to fired fault sites, and run
+        # the evaluation thread (SLO burn rates + automatic triggers).
+        if self._trace_buffer > 0:
+            journal.ring_arm(self._trace_buffer)
+            self._ring_armed = True
+        self._flight = flight_mod.FlightRecorder(
+            state_dir=self._state_dir,
+            providers={
+                "identity": lambda: {
+                    **self._identity(),
+                    "addr": f"{adv_host}:{self._port}",
+                },
+                "gossip": self.fleet_view.to_wire,
+            },
+        )
+        flight_mod.set_default(self._flight)
+        faults.subscribe(self._flight.on_fault)
+        self._flight.arm_fatal()
+        self._slo = slo_mod.SloEvaluator()
+        if self._telemetry_eval_s > 0:
+            self._telemetry_thread = threading.Thread(
+                target=self._telemetry_loop, name="srml-dataplane-telemetry",
+                daemon=True,
+            )
+            self._telemetry_thread.start()
         logger.info("data-plane daemon listening on %s:%d", self._host, self._port)
         return self
 
@@ -2246,6 +2297,82 @@ class DataPlaneDaemon:
             self._reaper_thread.join(timeout=5)
         if self._gossip_thread is not None:
             self._gossip_thread.join(timeout=5)
+        if self._telemetry_thread is not None:
+            self._telemetry_thread.join(timeout=5)
+        if self._flight is not None:
+            faults.unsubscribe(self._flight.on_fault)
+            flight_mod.set_default(None)
+        if self._ring_armed:
+            journal.ring_disarm()
+            self._ring_armed = False
+
+    # -- telemetry evaluation ----------------------------------------------
+
+    def _telemetry_loop(self) -> None:
+        """The telemetry-evaluation thread: each tick snapshots the
+        registry, evaluates SLO burn rates (publishing ``srml_slo_*``
+        gauges), rolls the flight recorder's metrics delta, and checks
+        the automatic incident triggers. Host-side math only — it never
+        touches the device plane or a daemon lock, so it cannot stall
+        serving traffic."""
+        while not self._stop.wait(self._telemetry_eval_s):
+            try:
+                self._telemetry_tick()
+            except Exception:
+                logger.exception("telemetry tick failed")
+
+    def _telemetry_tick(self) -> None:
+        from spark_rapids_ml_tpu import config
+
+        now = time.time()
+        elapsed = (
+            now - self._last_telemetry_ts
+            if self._last_telemetry_ts is not None
+            else self._telemetry_eval_s
+        )
+        # Tick bookkeeping is single-writer: only the telemetry thread
+        # reaches this method (start() runs one), so the unlocked writes
+        # here cannot race anything.
+        self._last_telemetry_ts = now  # srml: disable=thread-shared-state
+        elapsed = max(elapsed, 1e-6)
+        snap = metrics_mod.snapshot()
+        deltas = self._flight.observe(snap, now) if self._flight else {}
+        # SLO burn rates: a breach is itself a flight-recorder trigger.
+        if self._slo is not None and self._slo.objectives:
+            evals = self._slo.tick(snap, now)
+            breaches = [e["objective"] for e in evals if e["breach"]]
+            if breaches and self._flight is not None:
+                self._flight.trigger("slo_breach", {"objectives": breaches})
+        if self._flight is None:
+            return
+        # Shed storm: total sheds/second over the tick across all ops.
+        shed_cap = float(config.get("incident_shed_rate") or 0.0)
+        if shed_cap > 0:
+            sheds = sum(d["shed"] for d in deltas.values())
+            if sheds / elapsed >= shed_cap:
+                self._flight.trigger(
+                    "shed_storm",
+                    {"sheds": sheds, "window_s": elapsed},
+                )
+        # Deadline-breach rate: scheduler sheds with reason="deadline"
+        # (requests whose deadline the backlog would already miss).
+        dl_cap = float(config.get("incident_deadline_rate") or 0.0)
+        if dl_cap > 0:
+            dl_now = sum(
+                float(s["value"])
+                for s in snap.get("srml_scheduler_sheds_total", {}).get(
+                    "samples", []
+                )
+                if s["labels"].get("reason") == "deadline"
+            )
+            dl_delta = max(0.0, dl_now - self._prev_deadline_sheds)
+            # Same single-writer bookkeeping as _last_telemetry_ts.
+            self._prev_deadline_sheds = dl_now  # srml: disable=thread-shared-state
+            if dl_delta / elapsed >= dl_cap:
+                self._flight.trigger(
+                    "deadline_breach",
+                    {"breaches": dl_delta, "window_s": elapsed},
+                )
 
     def _reap_loop(self) -> None:
         """Evict jobs idle > ttl: a driver that crashed between feed and
@@ -2837,8 +2964,9 @@ class DataPlaneDaemon:
                 op = _op_label(req.get("op"))
                 t0 = time.perf_counter()
                 outcome = "ok"
+                exemplar = None
                 try:
-                    with _op_trace(op, req):
+                    with _op_trace(op, req) as exemplar:
                         self._dispatch(conn, req)
                 except (ConnectionError, TimeoutError):
                     # A transport-level failure (peer died mid-frame,
@@ -2861,7 +2989,11 @@ class DataPlaneDaemon:
                 finally:
                     # Per-op request accounting (a shed op counts "ok"
                     # here; srml_daemon_busy_sheds_total carries the shed).
-                    _M_REQ_SECONDS.observe(time.perf_counter() - t0, op=op)
+                    # The op span's trace identity rides along as the
+                    # sample's exemplar (utils/metrics.py).
+                    _M_REQ_SECONDS.observe(
+                        time.perf_counter() - t0, exemplar=exemplar, op=op
+                    )
                     _M_REQUESTS.inc(op=op, outcome=outcome)
 
     def _dispatch(self, conn, req: Dict[str, Any]) -> None:
@@ -3019,6 +3151,10 @@ class DataPlaneDaemon:
             self._op_health(conn)
         elif op == "metrics":
             self._op_metrics(conn, req)
+        elif op == "telemetry_pull":
+            self._op_telemetry_pull(conn)
+        elif op == "trace_pull":
+            self._op_trace_pull(conn, req)
         elif op == "ping":
             protocol.send_json(
                 conn,
@@ -3113,15 +3249,7 @@ class DataPlaneDaemon:
         buckets cumulative) or "prometheus" (text exposition v0.0.4 in
         ``text``). Never shed: a scrape is O(registry) host work and is
         exactly what an operator needs most when the daemon is busy."""
-        _M_STAGED.set(self._staged_bytes_total())
-        with self._jobs_lock:
-            _M_JOBS.set(len(self._jobs))
-        with self._models_lock:
-            _M_MODELS.set(len(self._models))
-        with self._conns_lock:
-            _M_CONNS.set(self._active_conns)
-        if self._scheduler is not None:
-            self._scheduler.snapshot()  # refreshes the queue-depth gauge
+        self._refresh_level_gauges()
         fmt = str(_opt(req, "format", "json"))
         base = {
             "ok": True,
@@ -3137,6 +3265,66 @@ class DataPlaneDaemon:
             protocol.send_json(conn, {**base, "metrics": metrics_mod.snapshot()})
         else:
             raise ValueError(f"unknown metrics format {fmt!r} (json|prometheus)")
+
+    def _refresh_level_gauges(self) -> None:
+        """At-scrape refresh of the level gauges (staged bytes, jobs,
+        models, connections, scheduler queue depths), so every exported
+        snapshot is self-consistent with what `health` would report."""
+        _M_STAGED.set(self._staged_bytes_total())
+        with self._jobs_lock:
+            _M_JOBS.set(len(self._jobs))
+        with self._models_lock:
+            _M_MODELS.set(len(self._models))
+        with self._conns_lock:
+            _M_CONNS.set(self._active_conns)
+        if self._scheduler is not None:
+            self._scheduler.snapshot()  # refreshes the queue-depth gauge
+
+    def _op_telemetry_pull(self, conn) -> None:
+        """Additive wire-native telemetry export (docs/protocol.md
+        "Telemetry plane ops"): everything an operator or fleet tool
+        needs from this daemon in ONE cursor-free pull — the metrics
+        registry as OpenMetrics text WITH per-bucket exemplars
+        (``text``) and as the JSON snapshot (``metrics``), the xprof
+        jit-ledger summary (``xprof``), and the config fingerprint
+        (``fingerprint``; two replicas answering different fingerprints
+        run different effective configs). Never shed, never journaled —
+        it is the scrape path of ``tools/top.py --fleet`` and must
+        answer while the daemon is melting down."""
+        from spark_rapids_ml_tpu import config
+
+        self._refresh_level_gauges()
+        protocol.send_json(conn, {
+            "ok": True,
+            "v": protocol.PROTOCOL_VERSION,
+            **self._identity(),
+            "uptime_s": float(self._clock() - self._started),
+            "text": metrics_mod.render_openmetrics(),
+            "metrics": metrics_mod.snapshot(),
+            "xprof": xprof_mod.snapshot(),
+            "fingerprint": config.fingerprint(),
+        })
+
+    def _op_trace_pull(self, conn, req: Dict[str, Any]) -> None:
+        """Additive wire-native trace export: journal events from the
+        in-memory ring with ``seq`` greater than the request's
+        ``cursor`` (0 = everything the ring still holds), plus this
+        process's current ``seq`` — the caller stores it as its next
+        cursor, so repeated pulls stream WITHOUT duplication
+        (docs/protocol.md has the cursor contract). The cursor is
+        per-daemon and per-boot: compare ``boot_id`` across pulls and
+        restart from 0 when it changes. Events that aged out of the
+        bounded ring between pulls are gone — the ring is a flight
+        recorder, not a durable log."""
+        cursor = int(_opt(req, "cursor", 0) or 0)
+        events, seq = journal.tail(cursor)
+        protocol.send_json(conn, {
+            "ok": True,
+            "v": protocol.PROTOCOL_VERSION,
+            **self._identity(),
+            "events": events,
+            "seq": seq,
+        })
 
     def _get_job(self, req) -> _Job:
         name = str(req.get("job"))

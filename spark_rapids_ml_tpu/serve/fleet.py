@@ -53,6 +53,7 @@ from spark_rapids_ml_tpu.serve.router import (
     bootstrap_table,
 )
 from spark_rapids_ml_tpu.utils import faults
+from spark_rapids_ml_tpu.utils import flight
 from spark_rapids_ml_tpu.utils import metrics as metrics_mod
 from spark_rapids_ml_tpu.utils.logging import get_logger
 
@@ -395,6 +396,13 @@ class ModelFleet:
                 self._table.set_intent(model, None)
                 self._publish_model(model)
                 _M_ROLLOUTS.inc(outcome="error")
+                # An aborted rollout is an incident: snapshot the
+                # context NOW, while the failed registrations are still
+                # in the span ring (no-op without a default recorder).
+                flight.record("rollout_abort", {
+                    "model": model, "phase": "registering",
+                    "version": new_v, "failed": list(res["failed"]),
+                })
                 raise FleetRolloutError(
                     f"no replica accepted {model!r} v{new_v}; "
                     f"v{old_v} keeps serving"
@@ -424,6 +432,11 @@ class ModelFleet:
                         self._table.set_intent(model, None)
                         self._publish_model(model)
                         _M_ROLLOUTS.inc(outcome="error")
+                        flight.record("rollout_abort", {
+                            "model": model, "phase": "warming",
+                            "version": new_v,
+                            "failed": list(res["failed"]),
+                        })
                         raise FleetRolloutError(
                             f"every replica failed warming {model!r} "
                             f"v{new_v}; v{old_v} keeps serving"
@@ -525,6 +538,10 @@ class ModelFleet:
                     "phase %r before the flip); v%s keeps serving",
                     model, to_v, phase, from_v,
                 )
+                flight.record("rollout_abort", {
+                    "model": model, "phase": phase, "version": to_v,
+                    "previous": from_v, "via": "resume_rollout",
+                })
                 return {
                     "action": "aborted", "model": model, "phase": phase,
                     "version": to_v, "previous": from_v,

@@ -51,6 +51,13 @@ MULTICHIP_MIN_EFFICIENCY = 0.8
 #: replicas serialize somewhere and "scale-out" is mostly overhead.
 FLEET_MIN_EFFICIENCY = 0.7
 
+#: Cap on the telemetry plane's serving cost (``bench.py --serve``
+#: ``telemetry_overhead``: fractional QPS lost with SLO evaluation
+#: ticking, the span ring armed, and a live telemetry_pull/trace_pull
+#: scraper vs the plain scheduler-on run). Observability that eats more
+#: than 2% of the thing it observes is a tax, not a plane.
+TELEMETRY_MAX_OVERHEAD = 0.02
+
 
 def parse_record(obj: Dict[str, Any]) -> Dict[str, Any]:
     """Normalize either record shape to {metric, value, ...}: the raw
@@ -262,6 +269,29 @@ def check_multichip(
         allow_compiles=allow_compiles,
     )
     return ok and t_ok, lines + t_lines
+
+
+def check_telemetry_overhead(fresh: Dict[str, Any]) -> Tuple[bool, List[str]]:
+    """Gate a ``bench.py --serve`` record's telemetry cost: the
+    fractional QPS lost to the hot telemetry plane must stay under
+    :data:`TELEMETRY_MAX_OVERHEAD`. Absolute, not trajectory-relative —
+    the bound is a product promise (docs/observability.md), so a slow
+    round must not ratchet it."""
+    ov = fresh.get("telemetry_overhead")
+    if ov is None:
+        return True, [
+            "telemetry [SKIP] record carries no telemetry_overhead "
+            "(pre-telemetry bench.py --serve round) — nothing gated"
+        ]
+    ov = float(ov)
+    ok = ov < TELEMETRY_MAX_OVERHEAD
+    scrapes = (fresh.get("telemetry_on") or {}).get("scrapes")
+    return ok, [
+        f"telemetry [{'OK' if ok else 'REGRESSION'}] overhead "
+        f"{ov * 100:.2f}% of serving QPS (SLO eval + ring + "
+        f"{scrapes} wire scrapes) vs cap "
+        f"{TELEMETRY_MAX_OVERHEAD * 100:.0f}%"
+    ]
 
 
 def check_serve_fleet(
@@ -890,6 +920,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # they keep the soft SKIP like the fleet/chaos families.
             require_xla=not str(fresh.get("metric", "")).startswith("serve_"),
         )
+        if str(fresh.get("metric", "")).startswith("serve_"):
+            t_ok, t_lines = check_telemetry_overhead(fresh)
+            ok, lines = ok and t_ok, lines + t_lines
     for line in lines:
         print(line)
     print("perfcheck:", "PASS" if ok else "FAIL")

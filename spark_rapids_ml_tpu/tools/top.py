@@ -67,6 +67,8 @@ AUTO_WATERMARK = "srml_autoscale_watermark"
 AUTO_COOLDOWN = "srml_autoscale_cooldown_seconds"
 AUTO_REPLICAS = "srml_autoscale_replicas"
 AUTO_ACTIONS = "srml_autoscale_actions_total"
+SLO_BURN = "srml_slo_burn_rate"
+SLO_BREACH = "srml_slo_breach"
 
 
 
@@ -197,6 +199,10 @@ def render(
     if autoscale:
         lines.append("")
         lines.extend(autoscale)
+    slo = _slo_lines(snap)
+    if slo:
+        lines.append("")
+        lines.extend(slo)
     phases = _hist_by_label(snap.get(PHASES), "phase")
     if phases:
         lines.append("")
@@ -315,6 +321,96 @@ def _autoscale_lines(snap: Dict[str, Any]) -> List[str]:
             + "  ".join(f"{k}:{int(n)}" for k, n in sorted(actions.items()))
         )
     return lines
+
+
+def _slo_lines(snap: Dict[str, Any]) -> List[str]:
+    """The SLO panel (docs/observability.md "SLO burn rates"): per
+    objective, the fast- and slow-window error-budget burn rates and
+    whether the objective is currently breaching (both windows over
+    ``slo_burn_threshold``). Burn 1.0 = spending exactly the budget;
+    14.4 = the classic page-worthy fast burn. Empty when no SloEvaluator
+    runs in the scraped process."""
+    burn = snap.get(SLO_BURN)
+    if not burn or not burn.get("samples"):
+        return []
+    breach: Dict[Tuple[str, str], float] = {}
+    for s in (snap.get(SLO_BREACH) or {}).get("samples", []):
+        key = (s["labels"].get("objective", ""), s["labels"].get("op", ""))
+        breach[key] = float(s.get("value", 0.0))
+    rows: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for s in burn.get("samples", []):
+        labels = s["labels"]
+        key = (labels.get("objective", ""), labels.get("op", ""))
+        rows.setdefault(key, {})[labels.get("window", "")] = float(
+            s.get("value", 0.0)
+        )
+    lines = [
+        f"{'slo objective':<24}{'op':<14}{'fast burn':>11}"
+        f"{'slow burn':>11}{'state':>9}"
+    ]
+    for key in sorted(rows):
+        w = rows[key]
+        state = "BREACH" if breach.get(key, 0.0) >= 1.0 else "ok"
+        lines.append(
+            f"{key[0]:<24}{key[1]:<14}{w.get('fast', 0.0):>11.2f}"
+            f"{w.get('slow', 0.0):>11.2f}{state:>9}"
+        )
+    return lines
+
+
+def render_fleet_telemetry(
+    pulls: Dict[str, Optional[Dict[str, Any]]],
+) -> str:
+    """The one-seed fleet METRICS panel (``--fleet --telemetry``):
+    one row per replica from its ``telemetry_pull`` answer (None =
+    unreachable → DOWN) — request totals, error count, serving p99,
+    SLO breach count, and the config fingerprint. Differing
+    fingerprints are the classic silent-drift incident, so the header
+    calls them out. Pure function — the unit under test."""
+    lines: List[str] = []
+    up = sum(1 for p in pulls.values() if p is not None)
+    prints = {
+        str(p.get("fingerprint", "?"))
+        for p in pulls.values() if p is not None
+    }
+    drift = "" if len(prints) <= 1 else \
+        "  CONFIG DRIFT: %d distinct fingerprints" % len(prints)
+    lines.append(f"fleet telemetry — {up}/{len(pulls)} replicas up{drift}")
+    lines.append(
+        f"{'replica':<22}{'id':<14}{'up':>7}{'reqs':>9}{'errs':>7}"
+        f"{'p99':>9}{'breach':>8}  fingerprint"
+    )
+    for addr in sorted(pulls):
+        p = pulls[addr]
+        if p is None:
+            lines.append(
+                f"{addr:<22}{'-':<14}{'-':>7}{'-':>9}{'-':>7}{'-':>9}"
+                f"{'-':>8}  DOWN"
+            )
+            continue
+        snap = p.get("metrics") or {}
+        reqs = errs = 0.0
+        for s in (snap.get(REQ) or {}).get("samples", []):
+            v = float(s.get("value", 0.0))
+            reqs += v
+            if s["labels"].get("outcome") in ("error", "transport"):
+                errs += v
+        buckets: Dict[str, float] = {}
+        for s in (snap.get(LAT) or {}).get("samples", []):
+            for le, n in (s.get("buckets") or {}).items():
+                buckets[le] = buckets.get(le, 0.0) + float(n)
+        breaches = sum(
+            1 for s in (snap.get(SLO_BREACH) or {}).get("samples", [])
+            if float(s.get("value", 0.0)) >= 1.0
+        )
+        lines.append(
+            f"{addr:<22}{str(p.get('id', '?')):<14}"
+            f"{float(p.get('uptime_s', 0.0)):>6.0f}s"
+            f"{int(reqs):>9}{int(errs):>7}"
+            f"{_fmt_secs(quantile_from_buckets(buckets, 0.99)):>9}"
+            f"{breaches:>8}  {p.get('fingerprint', '?')}"
+        )
+    return "\n".join(lines)
 
 
 def render_fleet(healths: Dict[str, Optional[Dict[str, Any]]]) -> str:
@@ -447,6 +543,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "address: pull the seed's FleetView (gossip_pull) "
                     "and show every replica and model the fleet knows — "
                     "no roster needed")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="with --fleet: render the fleet METRICS panel "
+                    "instead of health — one telemetry_pull per "
+                    "up-replica from the gossiped view (request/error "
+                    "totals, p99, SLO breaches, config fingerprint "
+                    "drift)")
     args = ap.parse_args(argv)
     if not args.address:
         ap.error("no daemon address: pass host:port or set $SRML_DAEMON_ADDRESS")
@@ -489,10 +591,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         *_parse_addr(r["addr"]), token=args.token,
                         timeout=5.0, max_op_attempts=1,
                     ) as c:
-                        healths[r["addr"]] = c.health()
+                        healths[r["addr"]] = (
+                            c.telemetry_pull() if args.telemetry
+                            else c.health()
+                        )
                 except Exception:
                     healths[r["addr"]] = None
-            body = render_fleet_view(view, healths)
+            body = (
+                render_fleet_telemetry(healths) if args.telemetry
+                else render_fleet_view(view, healths)
+            )
             if args.once or args.count:
                 print(body)
                 print()

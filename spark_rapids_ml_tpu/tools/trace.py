@@ -20,20 +20,38 @@ Usage::
 
     python -m spark_rapids_ml_tpu.tools.trace journal.jsonl [more.jsonl ...] \
         [--out trace.json] [--run RUN_ID] [--flame]
+    python -m spark_rapids_ml_tpu.tools.trace --fleet HOST:PORT [--flame]
 
-Spans whose ``parent_id`` is not in the merged set (a daemon span whose
-parent lives in a journal file you did not pass) root at their run — the
-tree degrades, it never drops events.
+Three kinds of source, freely mixable:
+
+* **journal files** — rotated segments (``journal.jsonl.1`` …) are
+  folded in transparently (utils/journal.py ``segments``);
+* **incident bundles** — a flight-recorder dump
+  (``state_dir/incidents/incident-*.json``, utils/flight.py) loads as a
+  trace source through its ``events`` list, so a daemon that died five
+  minutes ago stitches into the tree like a live one;
+* **the fleet itself** — ``--fleet HOST:PORT`` needs ONE gossip seed
+  and ZERO filesystem access: it pulls the seed's FleetView
+  (``gossip_pull``), then drains every live replica's in-memory span
+  ring over the wire (``trace_pull``), and stitches the union.
+
+Merged events sort by ``(ts, pid, seq)`` — the per-process monotonic
+``seq`` breaks wall-clock ties, so the merge order is stable no matter
+how many processes share a timestamp. Spans whose ``parent_id`` is not
+in the merged set (a daemon span whose parent lives in a journal file
+you did not pass) root at their run — the tree degrades, it never drops
+events.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-from spark_rapids_ml_tpu.utils import journal
+from spark_rapids_ml_tpu.utils import flight, journal
 
 #: Events that appear in the trace: phases and run_ends carry durations;
 #: marks become instants. run_start is the run_end's open bracket — it
@@ -41,12 +59,81 @@ from spark_rapids_ml_tpu.utils import journal
 _SPAN_EVENTS = ("phase", "run_end")
 
 
+def _sort_key(e: Dict[str, Any]):
+    """Stable merge order: wall clock, then pid, then the per-process
+    monotonic ``seq`` — two events stamped in the same clock tick by the
+    same process keep their emission order."""
+    return (
+        float(e.get("ts", 0.0)),
+        int(e.get("pid", 0)),
+        int(e.get("seq", 0)),
+    )
+
+
+def _load_source(path: str) -> List[Dict[str, Any]]:
+    """One source file → its events: an incident bundle (a single JSON
+    object with ``kind: srml_incident_bundle``) contributes its
+    ``events`` list; anything else is read as a journal file, rotated
+    segments included."""
+    try:
+        bundle = flight.load_bundle(path)
+    except (ValueError, OSError):
+        return journal.read(str(path))
+    events = bundle.get("events")
+    return [e for e in events if isinstance(e, dict)] \
+        if isinstance(events, list) else []
+
+
 def load(paths: Iterable[str]) -> List[Dict[str, Any]]:
-    """Merge journal files into one event list, sorted by start time."""
+    """Merge journal files and/or incident bundles into one event list,
+    sorted by ``(ts, pid, seq)``."""
     events: List[Dict[str, Any]] = []
     for p in paths:
-        events.extend(journal.read(str(p)))
-    events.sort(key=lambda e: e.get("ts", 0.0))
+        events.extend(_load_source(str(p)))
+    events.sort(key=_sort_key)
+    return events
+
+
+def fleet_load(
+    seed: str,
+    token: Optional[str] = None,
+    timeout: float = 5.0,
+) -> List[Dict[str, Any]]:
+    """Drain the whole fleet's span rings from ONE gossip seed — zero
+    filesystem access. ``gossip_pull`` on the seed names every replica;
+    each up-replica answers ``trace_pull`` with its in-memory journal
+    ring. A replica that dies mid-drain is skipped (its spans may still
+    arrive via the others' rings or an incident bundle); duplicate
+    addresses collapse by server id."""
+    from spark_rapids_ml_tpu.serve.client import DataPlaneClient
+    from spark_rapids_ml_tpu.spark.daemon_session import _parse_addr
+
+    with DataPlaneClient(
+        *_parse_addr(seed), token=token, timeout=timeout, max_op_attempts=1,
+    ) as c:
+        view = c.gossip_pull()
+    addrs: Dict[str, str] = {}  # server_id → addr (view wins over seed)
+    for sid, rec in (view.get("replicas") or {}).items():
+        if rec.get("liveness") == "up" and rec.get("addr"):
+            addrs[str(sid)] = str(rec["addr"])
+    if not addrs:  # pre-gossip daemon: the seed is the whole "fleet"
+        addrs[""] = seed
+    events: List[Dict[str, Any]] = []
+    for sid in sorted(addrs):
+        try:
+            with DataPlaneClient(
+                *_parse_addr(addrs[sid]), token=token,
+                timeout=timeout, max_op_attempts=1,
+            ) as c:
+                pulled = c.trace_pull()
+        except Exception as e:
+            print(f"trace: replica {addrs[sid]} unreachable: {e}",
+                  file=sys.stderr)
+            continue
+        evs = pulled.get("events")
+        if isinstance(evs, list):
+            events.extend(ev for ev in evs if isinstance(ev, dict))
+    events.sort(key=_sort_key)
     return events
 
 
@@ -166,8 +253,8 @@ def tree(
         else:
             roots.append(n)
     for n in nodes:
-        n.children.sort(key=lambda c: c.event.get("ts", 0.0))
-    roots.sort(key=lambda r: r.event.get("ts", 0.0))
+        n.children.sort(key=lambda c: _sort_key(c.event))
+    roots.sort(key=lambda r: _sort_key(r.event))
     return roots
 
 
@@ -219,7 +306,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Merge run-journal files into a Chrome trace and/or "
         "a text flame summary.",
     )
-    ap.add_argument("journals", nargs="+", help="journal .jsonl file(s)")
+    ap.add_argument(
+        "journals", nargs="*",
+        help="journal .jsonl file(s) and/or incident bundle .json file(s)",
+    )
+    ap.add_argument(
+        "--fleet", metavar="HOST:PORT",
+        help="pull the whole fleet's spans over the wire from ONE gossip "
+        "seed (gossip_pull + trace_pull per replica) — no files needed; "
+        "mixes with file sources",
+    )
+    ap.add_argument(
+        "--token", default=os.environ.get("SRML_DAEMON_TOKEN"),
+        help="shared-secret daemon token for --fleet (default: "
+        "$SRML_DAEMON_TOKEN)",
+    )
     ap.add_argument("--out", "-o", help="write Chrome-trace JSON here")
     ap.add_argument("--run", help="restrict to one run_id")
     ap.add_argument(
@@ -231,8 +332,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="print run_id → name and exit",
     )
     args = ap.parse_args(argv)
+    if not args.journals and not args.fleet:
+        ap.error("no sources: pass journal/bundle files and/or --fleet")
 
     events = load(args.journals)
+    if args.fleet:
+        events.extend(fleet_load(args.fleet, token=args.token))
+        events.sort(key=_sort_key)
     if not events:
         print("no journal events found", file=sys.stderr)
         return 1

@@ -109,6 +109,8 @@ __all__ = [
     "active_plan",
     "checkpoint",
     "truncation",
+    "subscribe",
+    "unsubscribe",
 ]
 
 
@@ -280,6 +282,10 @@ class FaultPlan:
     def hit(self, site: str) -> None:
         for rule in self._rules.get(site, ()):
             if rule.kind != "partial" and rule.fires():
+                # Notify BEFORE performing: a crash-kind rule may end
+                # the process inside _perform, and the flight recorder
+                # (the main subscriber) wants its bundle on disk first.
+                _notify(site, rule.kind)
                 self._perform(rule, site)
 
     def cut(self, site: str, n: int) -> Optional[int]:
@@ -295,6 +301,34 @@ class FaultPlan:
 #: The active plan. None = every hook is a no-op (the zero-overhead
 #: production state). Set via activate()/active()/SRML_FAULT_PLAN.
 _PLAN: Optional[FaultPlan] = None
+
+#: Fired-fault subscribers: ``cb(site, kind)`` called when a rule FIRES
+#: (not on every checkpoint pass), before the fault is performed. The
+#: flight recorder (utils/flight.py) subscribes so an injected fault
+#: auto-captures an incident bundle. Subscriber errors are swallowed —
+#: observability must never change what the fault does.
+_SUBSCRIBERS: list = []
+
+
+def subscribe(cb) -> None:
+    """Register a fired-fault callback ``cb(site, kind)``."""
+    if cb not in _SUBSCRIBERS:
+        _SUBSCRIBERS.append(cb)
+
+
+def unsubscribe(cb) -> None:
+    try:
+        _SUBSCRIBERS.remove(cb)
+    except ValueError:
+        pass
+
+
+def _notify(site: str, kind: str) -> None:
+    for cb in list(_SUBSCRIBERS):
+        try:
+            cb(site, kind)
+        except Exception:
+            pass
 
 
 def activate(plan: FaultPlan) -> FaultPlan:

@@ -32,6 +32,31 @@ root (fresh ``run_id``, ``parent_id`` null) — daemon-side phases journal
 standalone. Files are opened append-mode and written one line per event
 under a lock, so daemon threads (and multiple processes on a shared
 file, via O_APPEND line writes) interleave whole lines, never halves.
+
+Every event additionally carries ``seq`` (additive): a per-process
+monotonic sequence number, so merge tools order same-timestamp events
+deterministically (sort key ``(ts, pid, seq)``) instead of by file
+order. ``seq`` restarts at 1 per process — it is only meaningful within
+one ``pid``.
+
+**In-memory ring (additive).** ``ring_arm(cap)`` turns on a bounded
+in-process event buffer that captures every event the journal hooks see
+— with or without a file configured. The daemon arms it at start
+(``telemetry_trace_buffer`` events) so the ``trace_pull`` wire op and
+the flight recorder (utils/flight.py) can export recent spans with zero
+filesystem dependency; ``tail(since_seq)`` drains it cursor-style.
+Arming is refcounted (several daemons in one test process share the
+ring); an unarmed process with no journal path keeps the original
+zero-allocation early-return contract.
+
+**Rotation (additive).** ``run_journal_max_bytes`` > 0 rotates the
+journal file logrotate-style when the next line would cross the cap:
+``path`` → ``path.1`` → … → ``path.K`` (``run_journal_keep`` segments
+retained, oldest deleted). ``read()`` concatenates rotated segments
+oldest-first, so consumers see one continuous stream. Rotation is
+single-writer: multiple PROCESSES sharing one journal path should leave
+the cap at 0 (unbounded append) — a rotating writer would pull the file
+out from under its peers' O_APPEND handles.
 """
 
 from __future__ import annotations
@@ -42,20 +67,29 @@ import os
 import threading
 import time
 import uuid
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
-    "enabled", "run", "span", "mark", "read", "close", "adopt", "trace_ctx",
+    "enabled", "active", "run", "span", "mark", "read", "close", "adopt",
+    "trace_ctx", "ring_arm", "ring_disarm", "tail", "last_seq", "segments",
 ]
 
 _lock = threading.Lock()
-_files: Dict[str, Any] = {}  # path -> open append handle
+_files: Dict[str, Any] = {}  # path -> [open append handle, bytes written]
 _tls = threading.local()
 #: Latched True after a write failure (bad path, disk full, read-only
 #: FS): telemetry must NEVER take the workload down — the journal logs
 #: one warning, disables itself for the process, and every fit keeps
 #: running. close() re-arms (a fresh path can be configured after).
 _broken = False
+#: Per-process monotonic event sequence (under ``_lock``): the merge
+#: tiebreaker for same-``ts`` events and the ``trace_pull`` cursor.
+_seq = 0
+#: Bounded in-memory event buffer; captures only while ``_ring_arms`` > 0.
+_ring: Deque[Dict[str, Any]] = deque()
+_ring_arms = 0
+_ring_cap = 0
 
 
 def _path() -> Optional[str]:
@@ -70,6 +104,55 @@ def _path() -> Optional[str]:
 def enabled() -> bool:
     """True when a journal path is configured for this process."""
     return _path() is not None
+
+
+def active() -> bool:
+    """True when ANY sink would record an event: a journal file is
+    configured or the in-memory ring is armed."""
+    return _path() is not None or _ring_on()
+
+
+def ring_arm(cap: int) -> None:
+    """Enable the in-memory event ring (≤ ``cap`` most-recent events).
+    Refcounted: each ``ring_arm`` needs a matching ``ring_disarm``; the
+    largest requested cap wins while any holder is armed."""
+    global _ring_arms, _ring_cap
+    cap = int(cap)
+    with _lock:
+        _ring_arms += 1
+        _ring_cap = max(_ring_cap, cap)
+        while len(_ring) > _ring_cap:
+            _ring.popleft()
+
+
+def ring_disarm() -> None:
+    """Drop one arm; the ring empties when the last holder disarms."""
+    global _ring_arms, _ring_cap
+    with _lock:
+        _ring_arms = max(0, _ring_arms - 1)
+        if _ring_arms == 0:
+            _ring.clear()
+            _ring_cap = 0
+
+
+def _ring_on() -> bool:
+    return _ring_arms > 0 and _ring_cap > 0
+
+
+def tail(since_seq: int = 0) -> Tuple[List[Dict[str, Any]], int]:
+    """(events with ``seq`` > ``since_seq`` still in the ring, current
+    last seq). The ``trace_pull`` primitive: a caller holding the
+    returned seq as its cursor streams without duplication; events that
+    aged out of the bounded ring before a pull are simply gone."""
+    with _lock:
+        events = [dict(e) for e in _ring if e.get("seq", 0) > since_seq]
+        return events, _seq
+
+
+def last_seq() -> int:
+    """Current per-process sequence number (0 before any event)."""
+    with _lock:
+        return _seq
 
 
 def _stack() -> List[Tuple[str, str]]:
@@ -89,16 +172,48 @@ def _new_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
-def _write(path: str, obj: Dict[str, Any]) -> None:
+def _rotation() -> Tuple[int, int]:
+    from spark_rapids_ml_tpu import config
+
+    return (
+        int(config.peek("run_journal_max_bytes") or 0),
+        max(1, int(config.peek("run_journal_keep") or 1)),
+    )
+
+
+def _rotate_locked(path: str) -> None:
+    """Shift ``path`` → ``path.1`` → … under ``_lock`` (handle already
+    closed by the caller). Best-effort: a missing segment is fine."""
+    _, keep = _rotation()
+    for i in range(keep, 0, -1):
+        src = path if i == 1 else f"{path}.{i - 1}"
+        dst = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, dst)
+    extra = f"{path}.{keep + 1}"
+    if os.path.exists(extra):  # keep shrank between rotations
+        os.remove(extra)
+
+
+def _write(path: str, line: str) -> None:
     global _broken
-    line = json.dumps(obj, separators=(",", ":"), default=str) + "\n"
     try:
         with _lock:
-            f = _files.get(path)
-            if f is None:
-                f = _files[path] = open(path, "a", encoding="utf-8")
-            f.write(line)
-            f.flush()
+            entry = _files.get(path)
+            if entry is None:
+                f = open(path, "a", encoding="utf-8")
+                entry = _files[path] = [f, f.tell()]
+            max_bytes, _ = _rotation()
+            nbytes = len(line.encode("utf-8"))
+            if max_bytes > 0 and entry[1] + nbytes > max_bytes and entry[1] > 0:
+                entry[0].close()
+                del _files[path]
+                _rotate_locked(path)
+                f = open(path, "a", encoding="utf-8")
+                entry = _files[path] = [f, f.tell()]
+            entry[0].write(line)
+            entry[0].flush()
+            entry[1] += nbytes
     except (OSError, ValueError) as e:  # ValueError: write on closed file
         # Emitted from finally blocks (span/run exits): raising here would
         # MASK the workload's own in-flight exception — and an unwritable
@@ -111,8 +226,14 @@ def _write(path: str, obj: Dict[str, Any]) -> None:
         )
 
 
+def _active() -> Tuple[Optional[str], bool]:
+    """(journal path or None, ring armed?) — an event is emitted when
+    either sink is on; neither on is the zero-allocation early return."""
+    return _path(), _ring_on()
+
+
 def _event(
-    path: str,
+    path: Optional[str],
     event: str,
     name: str,
     run_id: str,
@@ -122,6 +243,7 @@ def _event(
     fields: Dict[str, Any],
     duration_s: Optional[float] = None,
 ) -> None:
+    global _seq
     obj: Dict[str, Any] = {
         "ts": ts,
         "pid": os.getpid(),
@@ -135,7 +257,15 @@ def _event(
     if duration_s is not None:
         obj["duration_s"] = duration_s
     obj.update(fields)
-    _write(path, obj)
+    with _lock:
+        _seq += 1
+        obj["seq"] = _seq
+        if _ring_on():
+            _ring.append(obj)
+            while len(_ring) > _ring_cap:
+                _ring.popleft()
+    if path is not None:
+        _write(path, json.dumps(obj, separators=(",", ":"), default=str) + "\n")
 
 
 @contextlib.contextmanager
@@ -144,8 +274,8 @@ def run(name: str, **fields: Any) -> Iterator[Optional[str]]:
     ``run_start`` now and ``run_end`` (with ``duration_s``) on exit;
     spans on this thread inside the block parent to it. Yields the
     run_id (None when the journal is off)."""
-    path = _path()
-    if path is None:
+    path, ring = _active()
+    if path is None and not ring:
         yield None
         return
     run_id = _new_id()
@@ -171,8 +301,8 @@ def span(name: str, **fields: Any) -> Iterator[Optional[str]]:
     """One phase: emits a single ``phase`` line on exit (ts = phase
     start). ``trace_span`` routes here, so every instrumented phase in
     the package journals for free when the journal is on."""
-    path = _path()
-    if path is None:
+    path, ring = _active()
+    if path is None and not ring:
         yield None
         return
     stack = _stack()
@@ -231,8 +361,8 @@ def adopt(
 
 def mark(name: str, **fields: Any) -> None:
     """One-shot event (no duration) under the current run, if any."""
-    path = _path()
-    if path is None:
+    path, ring = _active()
+    if path is None and not ring:
         return
     run_id, parent = current()
     _event(
@@ -241,16 +371,34 @@ def mark(name: str, **fields: Any) -> None:
     )
 
 
+def segments(path: str) -> List[str]:
+    """Existing on-disk segments of a journal, OLDEST first:
+    ``path.K … path.2 path.1 path`` (rotation shifts upward, so higher
+    suffixes are older). The live file is last even when absent peers
+    leave suffix gaps."""
+    out: List[str] = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        out.append(f"{path}.{i}")
+        i += 1
+    out.reverse()
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
 def read(path: str) -> List[Dict[str, Any]]:
-    """Parse a journal file back into event dicts (tools and tests).
-    Blank lines are skipped; a torn final line (killed process) raises —
-    the journal's whole-line write discipline makes that a real error."""
+    """Parse a journal file back into event dicts (tools and tests),
+    transparently concatenating rotated segments oldest-first. Blank
+    lines are skipped; a torn final line (killed process) raises — the
+    journal's whole-line write discipline makes that a real error."""
     out: List[Dict[str, Any]] = []
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+    for seg in segments(path):
+        with open(seg, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
     return out
 
 
@@ -260,7 +408,7 @@ def close() -> None:
     self-disabled after a write failure."""
     global _broken
     with _lock:
-        files = list(_files.values())
+        files = [entry[0] for entry in _files.values()]
         _files.clear()
         _broken = False
     for f in files:

@@ -30,6 +30,7 @@ never in the background.
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -45,6 +46,7 @@ __all__ = [
     "histogram",
     "snapshot",
     "render_prometheus",
+    "render_openmetrics",
     "reset",
     "quantile_from_buckets",
 ]
@@ -66,6 +68,19 @@ def _enabled() -> bool:
     from spark_rapids_ml_tpu import config
 
     return bool(config.peek("metrics"))
+
+
+def _exemplar_window() -> float:
+    """Seconds an exemplar stays "fresh": inside the window only a worse
+    sample evicts it; past it, the next exemplared sample takes the slot
+    regardless — so each bucket tracks the worst RECENT trace, not the
+    worst ever."""
+    from spark_rapids_ml_tpu import config
+
+    try:
+        return float(config.peek("telemetry_exemplar_window_s") or 60.0)
+    except (TypeError, ValueError):
+        return 60.0
 
 
 def quantile_from_buckets(buckets: Dict[str, int], q: float
@@ -182,6 +197,34 @@ class Histogram(_Metric):
                 f"ascending, got {buckets!r}"
             )
         self.buckets = uppers
+        #: (series key, bucket idx) → (value, ts, trace dict): the worst
+        #: sample of the current exemplar window, per bucket.
+        self._exemplars: Dict[Tuple[Any, int], Tuple[float, float, Dict[str, str]]] = {}
+
+    def _clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._exemplars.clear()
+
+    def _le(self, idx: int) -> str:
+        return _fmt_float(self.buckets[idx]) if idx < len(self.buckets) else "+Inf"
+
+    def exemplars(self, **labels: Any) -> Dict[str, Dict[str, Any]]:
+        """Fresh (within-window) exemplars of one series, keyed by the
+        bucket's ``le`` bound: ``{le: {"value", "ts", …trace fields}}``."""
+        key = _label_key(labels)
+        now = time.time()
+        window = _exemplar_window()
+        with self._lock:
+            items = [
+                (idx, v, ts, dict(trace))
+                for (k, idx), (v, ts, trace) in self._exemplars.items()
+                if k == key and now - ts <= window
+            ]
+        return {
+            self._le(idx): {"value": v, "ts": ts, **trace}
+            for idx, v, ts, trace in sorted(items)
+        }
 
     def _samples(self):
         # Deep-copy rows under the lock: the base copies the mapping but a
@@ -192,7 +235,18 @@ class Histogram(_Metric):
                 for k, row in sorted(self._series.items())
             ]
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def observe(
+        self,
+        value: float,
+        exemplar: Optional[Dict[str, str]] = None,
+        **labels: Any,
+    ) -> None:
+        """Record one sample. ``exemplar`` (optional, additive) is a
+        small trace reference — ``{"run": …, "span": …}`` — kept per
+        (series, bucket) for the WORST sample of the current exemplar
+        window (``telemetry_exemplar_window_s``): a p99 breach on the
+        scrape side links straight to the trace that caused it. A label
+        literally named ``exemplar`` is therefore reserved."""
         if not _enabled():
             return
         value = float(value)
@@ -207,6 +261,16 @@ class Histogram(_Metric):
             row[0][idx] += 1
             row[1] += value
             row[2] += 1
+            if exemplar:
+                now = time.time()
+                slot = (key, idx)
+                prev = self._exemplars.get(slot)
+                if (
+                    prev is None
+                    or now - prev[1] > _exemplar_window()
+                    or value >= prev[0]
+                ):
+                    self._exemplars[slot] = (value, now, dict(exemplar))
 
     def series(self, **labels: Any):
         """(cumulative buckets {le_str: n}, sum, count) of one series, or
@@ -301,14 +365,16 @@ class Registry:
             samples = []
             if isinstance(m, Histogram):
                 for labels, row in m._samples():
-                    samples.append(
-                        {
-                            "labels": labels,
-                            "buckets": m._cumulate(row[0]),
-                            "sum": row[1],
-                            "count": row[2],
-                        }
-                    )
+                    sample = {
+                        "labels": labels,
+                        "buckets": m._cumulate(row[0]),
+                        "sum": row[1],
+                        "count": row[2],
+                    }
+                    ex = m.exemplars(**labels)
+                    if ex:
+                        sample["exemplars"] = ex
+                    samples.append(sample)
             else:
                 for labels, v in m._samples():
                     samples.append({"labels": labels, "value": v})
@@ -316,9 +382,7 @@ class Registry:
                 out[name] = {"type": m.kind, "help": m.help, "samples": samples}
         return out
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format v0.0.4 (the format every
-        scraper accepts), metrics and series in sorted order."""
+    def _render(self, exemplars: bool) -> str:
         lines: List[str] = []
         with self._lock:
             metrics = sorted(self._metrics.items())
@@ -332,11 +396,26 @@ class Registry:
             if isinstance(m, Histogram):
                 for labels, row in samples:
                     cum = m._cumulate(row[0])
+                    ex = m.exemplars(**labels) if exemplars else {}
                     for le, n in cum.items():
-                        lines.append(
+                        line = (
                             f"{name}_bucket"
                             f"{_render_labels({**labels, 'le': le})} {n}"
                         )
+                        e = ex.get(le)
+                        if e is not None:
+                            # OpenMetrics exemplar syntax: the trace
+                            # labelset, then the sample's value and ts.
+                            trace = {
+                                k: v for k, v in e.items()
+                                if k not in ("value", "ts")
+                            }
+                            line += (
+                                f" # {_render_labels(trace) or '{}'} "
+                                f"{_fmt_float(e['value'])} "
+                                f"{_fmt_float(e['ts'])}"
+                            )
+                        lines.append(line)
                     lines.append(
                         f"{name}_sum{_render_labels(labels)} "
                         f"{_fmt_float(row[1])}"
@@ -349,7 +428,22 @@ class Registry:
                     lines.append(
                         f"{name}{_render_labels(labels)} {_fmt_float(v)}"
                     )
+        if exemplars:
+            lines.append("# EOF")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format v0.0.4 (the format every
+        scraper accepts), metrics and series in sorted order."""
+        return self._render(exemplars=False)
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics-style text: the v0.0.4 exposition plus per-bucket
+        exemplar suffixes (``… # {run="…",span="…"} value ts``) and the
+        terminating ``# EOF`` — what the ``telemetry_pull`` wire op
+        ships, so a scraped p99 breach carries the trace that caused
+        it."""
+        return self._render(exemplars=True)
 
 
 #: The process-wide registry every layer records into.
@@ -374,6 +468,10 @@ def snapshot() -> Dict[str, Any]:
 
 def render_prometheus() -> str:
     return REGISTRY.render_prometheus()
+
+
+def render_openmetrics() -> str:
+    return REGISTRY.render_openmetrics()
 
 
 def reset() -> None:

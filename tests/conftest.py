@@ -91,14 +91,18 @@ import subprocess  # noqa: E402
 import sys  # noqa: E402
 
 
-def _launch_daemon_worker(port=0, state_dir=None, fault_spec=None):
+def _launch_daemon_worker(port=0, state_dir=None, fault_spec=None,
+                          extra_env=None):
     """Start one tests/daemon_worker.py subprocess WITHOUT waiting for
     its READY line (callers that spawn several overlap the ~4 s jax
     imports by deferring the reads). The ONE place the worker env is
     built: SRML_* stripped, then the parent session's f64 parity profile
     pinned — worker-side folds must be bitwise-comparable with
     in-session oracles, and a drift between two spawn sites would break
-    every worker-vs-oracle contract silently."""
+    every worker-vs-oracle contract silently. ``extra_env`` overlays
+    LAST (telemetry tests configure SRML_SLO_*/SRML_INCIDENT_* knobs on
+    the worker; the parity profile still wins unless overridden
+    explicitly)."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items() if not k.startswith("SRML_")}
     env["JAX_PLATFORMS"] = "cpu"
@@ -110,6 +114,8 @@ def _launch_daemon_worker(port=0, state_dir=None, fault_spec=None):
     )
     if fault_spec:
         env["SRML_FAULT_PLAN"] = fault_spec
+    if extra_env:
+        env.update({str(k): str(v) for k, v in extra_env.items()})
     argv = [
         sys.executable,
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -130,10 +136,11 @@ def _read_ready(proc) -> int:
     return int(line.split()[1])
 
 
-def spawn_daemon_worker(port=0, state_dir=None, fault_spec=None):
+def spawn_daemon_worker(port=0, state_dir=None, fault_spec=None,
+                        extra_env=None):
     """One worker subprocess (READY <port> contract, stdin-close
     shutdown). Returns (proc, port)."""
-    proc = _launch_daemon_worker(port, state_dir, fault_spec)
+    proc = _launch_daemon_worker(port, state_dir, fault_spec, extra_env)
     return proc, _read_ready(proc)
 
 

@@ -193,10 +193,12 @@ def test_serve_config_keys_have_env_alias_and_docs():
     ``fit_daemon_loss_tolerance``/``fit_daemon_death_timeout_s`` keys
     predate the gate and use the legacy SRML_TPU_ env prefix); the
     gossip keys (``gossip_*`` + ``fleet_seed_*``) with the gossiped
-    control-plane PR."""
+    control-plane PR; the telemetry-plane keys (``slo_*`` /
+    ``telemetry_*`` / ``incident_*``) with the fleet-telemetry PR."""
     text = (PKG / "config.py").read_text()
     keys = sorted(set(re.findall(
-        r'^\s+"((?:serve|fleet|rf|forest|autoscale|fit_daemon_join|gossip)'
+        r'^\s+"((?:serve|fleet|rf|forest|autoscale|fit_daemon_join|gossip'
+        r'|slo|telemetry|incident)'
         r'_[a-z0-9_]+)"\s*:', text, re.M
     )))
     assert len(keys) >= 5, (
@@ -231,6 +233,11 @@ def test_serve_config_keys_have_env_alias_and_docs():
         "no fleet_seed_* config keys found — the bootstrap-seed config "
         "or this regex regressed"
     )
+    for fam in ("slo_", "telemetry_", "incident_"):
+        assert any(k.startswith(fam) for k in keys), (
+            f"no {fam}* config keys found — the telemetry-plane config "
+            "block or this regex regressed"
+        )
     docs = (PKG.parent / "docs" / "protocol.md").read_text()
     missing_env = [k for k in keys if f"SRML_{k.upper()}" not in text]
     assert missing_env == [], (

@@ -1,0 +1,682 @@
+"""Fleet telemetry plane (docs/protocol.md "Telemetry plane ops",
+docs/observability.md): wire-native export, SLO burn rates, and the
+flight recorder.
+
+The load-bearing claims, in test order:
+
+* **exemplars** — the latency histogram keeps the worst sample of the
+  window per (series, bucket) with its ``{run, span}`` trace identity;
+  ``render_openmetrics`` ships it as an OpenMetrics exemplar suffix and
+  terminates with ``# EOF``, while the frozen Prometheus exposition
+  stays byte-compatible (no suffixes);
+* **wire round-trip** — one ``telemetry_pull`` answers text + JSON
+  snapshot + xprof + config fingerprint, and the exemplar it carries
+  names a span that the SAME daemon's ``trace_pull`` ring contains: the
+  scraped p99 tail links to its trace with zero filesystem access;
+* **cursor contract** — repeated ``trace_pull`` with the acked ``seq``
+  as the next cursor streams without duplication; cursor 0 replays
+  whatever the bounded ring still holds;
+* **journal seq + rotation** — every journal line carries a dense
+  per-process ``seq`` (the merge tie-breaker), size-capped journals
+  rotate logrotate-style, and ``journal.read``/``tools/trace.py`` read
+  the rotated segments transparently;
+* **SLO burn-rate units** — synthetic cumulative snapshots with
+  explicit timestamps produce exact fast/slow burns (violating
+  fraction / budget), breaches require BOTH windows over the
+  threshold, and the ``srml_slo_*`` gauges publish the numbers;
+* **flight recorder** — a seeded deadline-breach storm makes the
+  daemon's telemetry thread dump an incident bundle on its own; the
+  bundle is atomic, complete (span ring, metrics WITH the exemplar
+  whose span is in that same ring, xprof, gossip view, fingerprint),
+  rotated at the cap, and loads in tools/trace.py as a trace source; a
+  fleet rollout abort records one through the process-default recorder;
+* **autoscaler coupling** — a burning SLO forces scale-up BEFORE any
+  raw watermark (queue, sheds, p99) trips: the burn is budget-relative,
+  the watermarks are not;
+* **flagships (slow)** — a SIGKILL-style crash-kind fault leaves a
+  loadable ``fault_site`` bundle behind (faults notify pre-perform);
+  a 3-replica fleet of real OS-process daemons is stitched into one
+  cross-replica trace tree from ONE gossip seed with zero file access,
+  while an error storm on a replica drives its ``srml_slo_breach``
+  gauge over the wire.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.serve import DataPlaneClient, DataPlaneDaemon, ModelFleet
+from spark_rapids_ml_tpu.serve.autoscaler import AutoScaler
+from spark_rapids_ml_tpu.serve.fleet import FleetRolloutError
+from spark_rapids_ml_tpu.serve.gossip import FleetView
+from spark_rapids_ml_tpu.serve import scheduler as scheduler_mod
+from spark_rapids_ml_tpu.tools import top, trace
+from spark_rapids_ml_tpu.utils import flight, journal, slo
+from spark_rapids_ml_tpu.utils import metrics as metrics_mod
+
+from conftest import (  # noqa: E402
+    _launch_daemon_worker,
+    _read_ready,
+    spawn_daemon_worker,
+    stop_daemon_worker,
+)
+
+pytestmark = pytest.mark.telemetry
+
+D = 8
+
+
+@pytest.fixture(autouse=True)
+def _closed_journal():
+    """Every test starts and ends with the journal closed (complete
+    lines on disk, no handle reuse across tests)."""
+    journal.close()
+    yield
+    journal.close()
+
+
+def _phase_span_ids(events):
+    return {
+        e.get("span_id") for e in events if e.get("event") == "phase"
+    }
+
+
+# ---------------------------------------------------------------------------
+# exemplars: worst-of-window capture + OpenMetrics rendering
+# ---------------------------------------------------------------------------
+
+
+def test_exemplar_keeps_worst_sample_of_window_per_bucket():
+    metrics_mod.reset()
+    h = metrics_mod.histogram(
+        "srml_telemetrytest_seconds", "exemplar unit", buckets=(0.1, 1.0)
+    )
+    h.observe(0.5, exemplar={"run": "r1", "span": "s1"}, op="x")
+    # Same bucket, smaller sample: the worst of the window stays.
+    h.observe(0.3, exemplar={"run": "r2", "span": "s2"}, op="x")
+    ex = h.exemplars(op="x")
+    vals = {e["run"]: e["value"] for e in ex.values()}
+    assert vals == {"r1": 0.5}
+    # Same bucket, worse sample: replaced.
+    h.observe(0.7, exemplar={"run": "r3", "span": "s3"}, op="x")
+    # Different bucket: its own slot.
+    h.observe(0.05, exemplar={"run": "r4", "span": "s4"}, op="x")
+    ex = h.exemplars(op="x")
+    vals = {e["run"]: e["value"] for e in ex.values()}
+    assert vals == {"r3": 0.7, "r4": 0.05}
+    # The JSON snapshot ships them per sample, keyed by the bucket le.
+    snap = metrics_mod.snapshot()
+    sample = snap["srml_telemetrytest_seconds"]["samples"][0]
+    assert {e["run"] for e in sample["exemplars"].values()} == {"r3", "r4"}
+
+
+def test_openmetrics_render_has_exemplars_prometheus_stays_frozen():
+    metrics_mod.reset()
+    h = metrics_mod.histogram(
+        "srml_telemetrytest_seconds", "exemplar unit", buckets=(0.1, 1.0)
+    )
+    h.observe(0.5, exemplar={"run": "rr", "span": "ss"}, op="x")
+    om = metrics_mod.render_openmetrics()
+    assert 'run="rr"' in om and 'span="ss"' in om
+    assert " # {" in om  # the exemplar suffix, not a comment line
+    assert om.rstrip().endswith("# EOF")
+    prom = metrics_mod.render_prometheus()
+    assert " # {" not in prom and "# EOF" not in prom
+    assert "srml_telemetrytest_seconds_bucket" in prom
+
+
+# ---------------------------------------------------------------------------
+# wire round-trip: telemetry_pull / trace_pull against a live daemon
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_pull_exemplar_links_a_span_in_trace_pull(mesh8, tmp_path):
+    """The acceptance linkage at unit scale: the histogram exemplar a
+    telemetry_pull ships names {run, span}; the run is the CALLER's
+    journal run and the span is a daemon op span that the same daemon's
+    trace_pull ring still holds."""
+    metrics_mod.reset()
+    p = tmp_path / "driver.jsonl"
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(p)):
+            fp = config.fingerprint()  # effective config at pull time
+            with journal.run("telemetry-demo") as run_id:
+                with DataPlaneClient(*d.address) as c:
+                    c.feed("texj", np.ones((8, D)), algo="pca")
+                    pull = c.telemetry_pull()
+                    traced = c.trace_pull()
+    # Envelope: identity + every export surface in one cursor-free ack.
+    assert pull["boot_id"] == d.boot_id
+    assert pull["fingerprint"] == fp
+    assert pull["uptime_s"] >= 0.0
+    assert isinstance(pull["xprof"], dict)
+    assert pull["text"].rstrip().endswith("# EOF")
+    assert "srml_daemon_requests_total" in pull["text"]
+    # The exemplar: run is the caller's run, span is a ringed op span.
+    lat = pull["metrics"]["srml_daemon_request_seconds"]
+    feed_samples = [
+        s for s in lat["samples"] if s["labels"].get("op") == "feed"
+    ]
+    assert feed_samples
+    exemplars = feed_samples[0].get("exemplars") or {}
+    assert exemplars, "journaled feed must carry an exemplar"
+    ex = next(iter(exemplars.values()))
+    assert ex["run"] == run_id
+    assert ex["span"] in _phase_span_ids(traced["events"])
+
+
+def test_trace_pull_cursor_streams_without_duplication(mesh8, tmp_path):
+    with DataPlaneDaemon(mesh=mesh8) as d:
+        with config.option("run_journal", str(tmp_path / "j.jsonl")):
+            with journal.run("cursor-demo"):
+                with DataPlaneClient(*d.address) as c:
+                    c.feed("tcur-a", np.ones((8, D)), algo="pca")
+                    first = c.trace_pull()
+                    assert first["seq"] > 0 and first["events"]
+                    assert first["boot_id"] == d.boot_id
+                    # Feeding the acked seq back returns ONLY newer
+                    # events (possibly none).
+                    second = c.trace_pull(cursor=first["seq"])
+                    assert all(
+                        e["seq"] > first["seq"] for e in second["events"]
+                    )
+                    c.feed("tcur-b", np.ones((8, D)), algo="pca")
+                    third = c.trace_pull(cursor=second["seq"])
+                    names = {e.get("name") for e in third["events"]}
+                    assert "daemon.feed" in names
+                    assert all(
+                        e["seq"] > second["seq"] for e in third["events"]
+                    )
+                    # Cursor 0 replays everything the ring still holds —
+                    # a superset of every incremental pull.
+                    replay = c.trace_pull(cursor=0)
+                    seen = {e["seq"] for e in replay["events"]}
+                    for pull in (first, second, third):
+                        assert {e["seq"] for e in pull["events"]} <= seen
+
+
+# ---------------------------------------------------------------------------
+# journal seq + rotation
+# ---------------------------------------------------------------------------
+
+
+def test_journal_lines_carry_dense_monotonic_seq(tmp_path):
+    p = tmp_path / "seq.jsonl"
+    with config.option("run_journal", str(p)):
+        with journal.run("seq-demo"):
+            for i in range(5):
+                journal.mark("tick", i=i)
+    journal.close()
+    events = journal.read(str(p))
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert len(set(seqs)) == len(seqs)  # dense, never reused
+    assert all(e["pid"] == os.getpid() for e in events)
+
+
+def test_journal_rotation_is_transparent_to_readers(tmp_path):
+    p = tmp_path / "rot.jsonl"
+    with config.option("run_journal", str(p)), \
+            config.option("run_journal_max_bytes", 2000), \
+            config.option("run_journal_keep", 3):
+        with journal.run("rotation-demo"):
+            for i in range(120):
+                journal.mark("tick", i=i)
+    journal.close()
+    segs = journal.segments(str(p))
+    assert len(segs) >= 2, "journal never rotated"
+    assert segs[-1] == str(p)  # live file last
+    assert len(segs) <= 4  # keep=3 rotated + live
+    events = journal.read(str(p))
+    marks = [e for e in events if e.get("name") == "tick"]
+    idx = [e["i"] for e in marks]
+    # Oldest segments may be reaped; the surviving tail is contiguous,
+    # ordered, and ends at the last write.
+    assert idx == list(range(idx[0], 120))
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    # tools/trace.py reads the same rotated set through load() (order
+    # differs only where it should: a run_end line carries the run's
+    # START ts, so the ts-major merge may move it — never drop it).
+    loaded = trace.load([str(p)])
+    assert sorted(e["seq"] for e in loaded) == seqs
+
+
+def test_trace_merge_orders_by_ts_then_pid_then_seq():
+    ev = lambda ts, pid, seq: {"ts": ts, "pid": pid, "seq": seq}  # noqa: E731
+    shuffled = [
+        ev(2.0, 1, 9), ev(1.0, 2, 3), ev(1.0, 2, 1), ev(1.0, 1, 7),
+    ]
+    ordered = sorted(shuffled, key=trace._sort_key)
+    assert ordered == [
+        ev(1.0, 1, 7), ev(1.0, 2, 1), ev(1.0, 2, 3), ev(2.0, 1, 9),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate units (synthetic snapshots, explicit clocks)
+# ---------------------------------------------------------------------------
+
+
+def _snap(total, err, buckets=None):
+    """One synthetic cumulative registry snapshot for op=transform."""
+    snap = {
+        "srml_daemon_requests_total": {"samples": [
+            {"labels": {"op": "transform", "outcome": "ok"},
+             "value": float(total - err)},
+            {"labels": {"op": "transform", "outcome": "error"},
+             "value": float(err)},
+        ]},
+    }
+    if buckets is not None:
+        snap["srml_daemon_request_seconds"] = {"samples": [
+            {"labels": {"op": "transform"}, "buckets": buckets,
+             "sum": 0.0, "count": buckets.get("+Inf", 0.0)},
+        ]}
+    return snap
+
+
+def test_parse_objectives_grammar_and_rejections():
+    objs = slo.parse_objectives(
+        "transform:p99_ms=50@0.01; kneighbors:error ;transform:shed@0.05"
+    )
+    assert [o.name for o in objs] == [
+        "transform:p99_ms", "kneighbors:error", "transform:shed",
+    ]
+    assert objs[0].target == 50.0 and objs[0].budget == 0.01
+    assert objs[1].budget == 0.001  # kind default
+    assert objs[2].budget == 0.05
+    assert slo.parse_objectives("  ") == []
+    with pytest.raises(ValueError):
+        slo.parse_objectives("transform")  # no kind
+    with pytest.raises(ValueError):
+        slo.parse_objectives("transform:p99_ms")  # latency needs =target
+    with pytest.raises(ValueError):
+        slo.parse_objectives("transform:error@2.0")  # budget out of (0,1)
+
+
+def test_count_le_interpolates_and_counts_inf_tail_as_violations():
+    buckets = {"0.1": 50.0, "0.5": 90.0, "+Inf": 100.0}
+    assert slo.count_le(buckets, 0.3) == pytest.approx(70.0)
+    assert slo.count_le(buckets, 0.1) == pytest.approx(50.0)
+    # Past the largest finite bound the +Inf tail stays violating.
+    assert slo.count_le(buckets, 5.0) == pytest.approx(90.0)
+
+
+def test_error_burn_rates_fast_and_slow_windows_exact():
+    metrics_mod.reset()
+    ev = slo.SloEvaluator(
+        objectives=[slo.Objective("transform", "error", None, 0.001)],
+        fast_window_s=60.0, slow_window_s=300.0, burn_threshold=14.4,
+    )
+    t0 = 1000.0
+    out = ev.tick(_snap(1000, 0), now=t0)
+    assert out[0]["fast_burn"] == 0.0 and not out[0]["breach"]
+    # 100 new requests, 3 errors: 3% violating / 0.1% budget = burn 30
+    # in BOTH windows (the slow window is still partial) → breach.
+    out = ev.tick(_snap(1100, 3), now=t0 + 60.0)
+    assert out[0]["fast_burn"] == pytest.approx(30.0)
+    assert out[0]["slow_burn"] == pytest.approx(30.0)
+    assert out[0]["breach"] is True
+    # The storm stops: the fast window forgives (burn 0), the slow one
+    # still remembers (3 errors / 200 requests = 1.5% → burn 15) — no
+    # breach, because a breach needs BOTH windows burning.
+    out = ev.tick(_snap(1200, 3), now=t0 + 120.0)
+    assert out[0]["fast_burn"] == pytest.approx(0.0)
+    assert out[0]["slow_burn"] == pytest.approx(15.0)
+    assert out[0]["breach"] is False
+    # The gauges published the latest evaluation.
+    snap = metrics_mod.snapshot()
+    burns = {
+        s["labels"]["window"]: s["value"]
+        for s in snap["srml_slo_burn_rate"]["samples"]
+    }
+    assert burns == {
+        "fast": pytest.approx(0.0), "slow": pytest.approx(15.0)
+    }
+    breach = snap["srml_slo_breach"]["samples"][0]
+    assert breach["labels"]["objective"] == "transform:error"
+    assert breach["value"] == 0.0
+
+
+def test_p99_burn_interpolates_violations_inside_the_bucket():
+    metrics_mod.reset()
+    ev = slo.SloEvaluator(
+        objectives=[slo.Objective("transform", "p99_ms", 50.0, 0.01)],
+        fast_window_s=60.0, slow_window_s=300.0, burn_threshold=14.4,
+    )
+    ev.tick(_snap(100, 0, {"0.025": 100.0, "0.1": 100.0, "+Inf": 100.0}),
+            now=0.0)
+    # 100 new requests, 90 of them in (25ms, 100ms]: linear
+    # interpolation puts 30 under the 50 ms target → 70 violations →
+    # 70% violating / 1% budget = burn 70.
+    out = ev.tick(
+        _snap(200, 0, {"0.025": 100.0, "0.1": 190.0, "+Inf": 200.0}),
+        now=60.0,
+    )
+    assert out[0]["fast_burn"] == pytest.approx(70.0)
+    assert out[0]["breach"] is True
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: automatic capture, rotation, trace-source loading
+# ---------------------------------------------------------------------------
+
+
+def test_incident_bundle_is_a_trace_source(tmp_path):
+    """A bundle's events are ordinary journal lines: tools/trace.py
+    merges file + bundle sources into one ordered stream."""
+    p = tmp_path / "j.jsonl"
+    with config.option("run_journal", str(p)):
+        with journal.run("file-run"):
+            journal.mark("from-file")
+    journal.close()
+    journal.ring_arm(100)
+    try:
+        with journal.run("ring-run"):
+            journal.mark("from-ring")
+        rec = flight.FlightRecorder(state_dir=str(tmp_path))
+        bpath = rec.trigger("fault_site", {"site": "unit"})
+    finally:
+        journal.ring_disarm()
+    assert bpath and os.path.exists(bpath)
+    b = flight.load_bundle(bpath)
+    assert b["kind"] == "srml_incident_bundle" and b["v"] == 1
+    assert b["reason"] == "fault_site" and b["detail"] == {"site": "unit"}
+    merged = trace.load([str(p), bpath])
+    names = [e.get("name") for e in merged]
+    # One ordered stream: the file run's events precede the (later)
+    # ring run's, whichever source they came from.
+    assert names.index("from-file") < names.index("from-ring")
+    assert merged == sorted(merged, key=trace._sort_key)
+    # A non-bundle .json and a missing path still fail loudly.
+    with pytest.raises(ValueError):
+        flight.load_bundle(str(p))
+
+
+def test_trigger_debounce_and_directory_rotation(tmp_path):
+    journal.ring_arm(16)
+    try:
+        rec = flight.FlightRecorder(state_dir=str(tmp_path))
+        with config.option("incident_min_interval_s", 3600.0):
+            assert rec.trigger("shed_storm") is not None
+            assert rec.trigger("shed_storm") is None  # debounced
+            assert rec.trigger("deadline_breach") is not None  # per reason
+        with config.option("incident_min_interval_s", 0.0), \
+                config.option("incident_max_bundles", 2):
+            for _ in range(4):
+                assert rec.trigger("slo_breach") is not None
+                time.sleep(0.002)  # distinct unix-ms filenames
+        bundles = sorted(os.listdir(tmp_path / "incidents"))
+        assert len(bundles) == 2  # capped, oldest deleted
+        assert all(b.startswith("incident-") for b in bundles)
+    finally:
+        journal.ring_disarm()
+
+
+def test_daemon_auto_captures_deadline_breach_storm(mesh8, tmp_path):
+    """The flagship trigger path, in process: seeded deadline sheds
+    cross ``incident_deadline_rate`` and the daemon's OWN telemetry
+    thread dumps a bundle — span ring, metrics with the exemplar whose
+    span is in that same ring, xprof, gossip view, fingerprint."""
+    metrics_mod.reset()
+    sd = tmp_path / "sd"
+    with config.option("telemetry_eval_interval_s", 0.05), \
+            config.option("incident_deadline_rate", 1.0), \
+            config.option("incident_min_interval_s", 0.0), \
+            config.option("run_journal", str(tmp_path / "j.jsonl")):
+        fp = config.fingerprint()
+        with DataPlaneDaemon(mesh=mesh8, state_dir=str(sd)) as d:
+            with journal.run("storm-demo") as run_id:
+                with DataPlaneClient(*d.address) as c:
+                    c.feed("storm-job", np.ones((8, D)), algo="pca")
+                # The storm: deadline sheds at ~4000/s against a cap of
+                # 1/s (cross-connection scheduler counters are process-
+                # global, so the test seeds them directly).
+                for _ in range(200):
+                    scheduler_mod._M_SHEDS.inc(
+                        op="transform", reason="deadline"
+                    )
+                inc_dir = sd / "incidents"
+                deadline = time.time() + 10.0
+                bundle = None
+                while time.time() < deadline and bundle is None:
+                    if inc_dir.is_dir():
+                        hits = [
+                            f for f in os.listdir(inc_dir)
+                            # .json only: the recorder stages bundles as
+                            # .json.tmp before the atomic replace, and a
+                            # poll can catch that window.
+                            if "deadline_breach" in f and f.endswith(".json")
+                        ]
+                        if hits:
+                            bundle = inc_dir / sorted(hits)[0]
+                            break
+                    time.sleep(0.02)
+                assert bundle is not None, "storm never dumped a bundle"
+                b = flight.load_bundle(str(bundle))
+    assert b["reason"] == "deadline_breach"
+    assert b["detail"]["breaches"] >= 200.0
+    assert b["fingerprint"] == fp
+    assert b["identity"]["boot_id"] == d.boot_id
+    assert d.instance_id in b["gossip"]["replicas"]
+    sheds = b["metrics"]["srml_scheduler_sheds_total"]["samples"]
+    assert any(s["labels"].get("reason") == "deadline" for s in sheds)
+    # The exemplar in the bundle's metrics links to a span in the
+    # bundle's OWN event ring — the incident is self-describing.
+    lat = b["metrics"]["srml_daemon_request_seconds"]["samples"]
+    feed = next(s for s in lat if s["labels"].get("op") == "feed")
+    ex = next(iter(feed["exemplars"].values()))
+    assert ex["run"] == run_id
+    assert ex["span"] in _phase_span_ids(b["events"])
+    # And the bundle stitches as a trace source.
+    tr = trace.tree(trace.load([str(bundle)]))
+    assert tr, "bundle events built no trace tree"
+
+
+def test_fleet_rollout_abort_records_an_incident(mesh8, tmp_path, rng):
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    data = rng.normal(size=(64, D))
+    arrays = PCA(mesh=mesh8).setK(2).fit({"features": data})._model_data()
+    d = DataPlaneDaemon(mesh=mesh8).start()
+    try:
+        fleet = ModelFleet([d.address])
+        fleet.register("tm", "pca", arrays, version=1, warm=False)
+    finally:
+        d.stop()  # every replica dead → the rollout must abort
+    rec = flight.FlightRecorder(state_dir=str(tmp_path))
+    flight.set_default(rec)
+    try:
+        with pytest.raises(FleetRolloutError):
+            fleet.rollout("tm", "pca", arrays, warm=False)
+    finally:
+        flight.set_default(None)
+        fleet.close()
+    bundles = os.listdir(tmp_path / "incidents")
+    assert len(bundles) == 1 and "rollout_abort" in bundles[0]
+    b = flight.load_bundle(str(tmp_path / "incidents" / bundles[0]))
+    assert b["detail"]["model"] == "tm"
+    assert b["detail"]["phase"] == "registering"
+    assert b["detail"]["version"] == 2
+
+
+# ---------------------------------------------------------------------------
+# autoscaler + tools/top coupling
+# ---------------------------------------------------------------------------
+
+
+def test_autoscaler_slo_breach_forces_up_before_raw_watermarks():
+    """The acceptance ordering: with the queue idle, zero sheds, and
+    p99 under the deadline — every raw watermark reading "down" — one
+    burning SLO still forces scale-up, reason ``slo``."""
+    a = AutoScaler(fleet=None, spawn=lambda: None)
+    calm = {
+        "replicas": 3, "queued": 0.0, "sheds_total": 0.0, "p99_s": 0.001,
+    }
+    assert a.evaluate(dict(calm))["verdict"] == "down"
+    d = a.evaluate(dict(calm, slo_breaches=1))
+    assert (d["verdict"], d["reason"]) == ("up", "slo")
+
+
+def test_top_renders_slo_panel_with_breach_state():
+    metrics_mod.reset()
+    ev = slo.SloEvaluator(
+        objectives=[slo.Objective("transform", "error", None, 0.001)],
+        fast_window_s=60.0, slow_window_s=300.0, burn_threshold=14.4,
+    )
+    ev.tick(_snap(100, 0), now=0.0)
+    ev.tick(_snap(200, 50), now=60.0)  # 50% errors: burn 500, breach
+    body = top.render({"uptime_s": 1.0}, metrics_mod.snapshot())
+    assert "slo objective" in body
+    assert "transform:error" in body
+    assert "BREACH" in body
+
+
+def test_top_fleet_telemetry_panel_flags_down_and_config_drift():
+    pulls = {
+        "127.0.0.1:7001": {
+            "id": "aaa", "fingerprint": "f1" * 8, "uptime_s": 5.0,
+            "metrics": _snap(100, 3),
+        },
+        "127.0.0.1:7002": {
+            "id": "bbb", "fingerprint": "f2" * 8, "uptime_s": 5.0,
+            "metrics": _snap(80, 0),
+        },
+        "127.0.0.1:7003": None,
+    }
+    body = top.render_fleet_telemetry(pulls)
+    assert "2/3 replicas up" in body
+    assert "CONFIG DRIFT: 2 distinct fingerprints" in body
+    assert "DOWN" in body
+    drifted = dict(pulls)
+    drifted["127.0.0.1:7002"] = dict(
+        pulls["127.0.0.1:7002"], fingerprint="f1" * 8
+    )
+    assert "CONFIG DRIFT" not in top.render_fleet_telemetry(drifted)
+
+
+# ---------------------------------------------------------------------------
+# flagships (slow): real OS-process daemons
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_crashed_worker_leaves_a_loadable_fault_site_bundle(tmp_path):
+    """The black-box property: a crash-kind fault kills the worker with
+    a REAL process death (exit 17, no teardown), yet the bundle is
+    already on disk — faults notify subscribers pre-perform, so the
+    recorder dumps while the process still lives."""
+    proc, port = spawn_daemon_worker(
+        state_dir=str(tmp_path),
+        fault_spec="daemon.op:crash:after=2,times=1",
+        extra_env={"SRML_INCIDENT_MIN_INTERVAL_S": "0"},
+    )
+    try:
+        with pytest.raises(Exception):
+            with DataPlaneClient(
+                "127.0.0.1", port, timeout=10.0, max_op_attempts=1
+            ) as c:
+                for i in range(10):
+                    c.feed(f"fs-{i}", np.ones((8, D)), algo="pca")
+        proc.wait(timeout=30)
+        assert proc.returncode == 17  # a real crash, not an exit path
+    finally:
+        stop_daemon_worker(proc)
+    inc_dir = tmp_path / "incidents"
+    hits = [f for f in os.listdir(inc_dir) if "fault_site" in f]
+    assert hits, f"no fault_site bundle in {os.listdir(inc_dir)}"
+    b = flight.load_bundle(str(inc_dir / hits[0]))
+    assert b["detail"] == {"site": "daemon.op", "fault": "crash"}
+    # The dead daemon's span ring survived it: the bundle holds its
+    # pre-crash op spans and loads as a trace source.
+    names = {e.get("name") for e in b["events"]}
+    assert "daemon.feed" in names
+    assert all(e["pid"] == b["pid"] for e in b["events"])
+    assert trace.load([str(inc_dir / hits[0])])
+
+
+@pytest.mark.slow
+def test_fleet_trace_stitch_and_slo_breach_from_one_seed(tmp_path):
+    """THE flagship: three real OS-process replicas under live traffic.
+    ``trace.fleet_load`` stitches every replica's spans under the
+    driver's span from ONE gossip seed with zero filesystem access, and
+    an error storm on the seed replica drives its ``srml_slo_breach``
+    gauge over the wire — the burn crosses while the queue watermarks
+    (no queueing at all here) never would."""
+    slo_env = {
+        "SRML_SLO_OBJECTIVES": "transform:error@0.001",
+        "SRML_TELEMETRY_EVAL_INTERVAL_S": "0.05",
+    }
+    procs = [_launch_daemon_worker(extra_env=slo_env) for _ in range(3)]
+    try:
+        ports = [_read_ready(p) for p in procs]
+        seed = f"127.0.0.1:{ports[0]}"
+        # Live traffic under ONE driver span: the client stamps its
+        # journal frame on every request; each daemon adopts it.
+        infos = {}
+        with config.option("run_journal", str(tmp_path / "driver.jsonl")):
+            with journal.run("fleet-demo") as run_id:
+                with journal.span("drive") as drive_span:
+                    for port in ports:
+                        with DataPlaneClient("127.0.0.1", port) as c:
+                            infos[port] = c.server_info()
+                            c.feed(
+                                f"fl-{port}", np.ones((8, D)), algo="pca"
+                            )
+        journal.close()
+        # Gossip: ONE push teaches the seed the whole replica set.
+        view = FleetView()
+        for port in ports:
+            view.observe_replica(
+                infos[port]["id"], f"127.0.0.1:{port}",
+                infos[port]["boot_id"],
+            )
+        with DataPlaneClient("127.0.0.1", ports[0]) as c:
+            c.gossip_push(view.to_wire())
+        # Zero file access from here: one seed → the whole fleet.
+        events = trace.fleet_load(seed)
+        pids = {e["pid"] for e in events}
+        assert len(pids) >= 3, f"expected 3 replica pids, got {pids}"
+        assert os.getpid() not in pids  # wire-pulled, not local
+        feeds = [
+            e for e in events
+            if e.get("event") == "phase" and e.get("name") == "daemon.feed"
+        ]
+        assert len(feeds) == 3
+        assert {e["run_id"] for e in feeds} == {run_id}  # one stitched run
+        assert {e["parent_id"] for e in feeds} == {drive_span}
+        assert trace.tree(events)
+        # Error storm on the seed replica: all-error traffic at a 0.1%
+        # budget burns ~1000× — the wire-exported breach gauge crosses.
+        with DataPlaneClient(
+            "127.0.0.1", ports[0], timeout=10.0, max_op_attempts=1
+        ) as c:
+            for _ in range(20):
+                try:
+                    c.transform("no-such-model", np.ones((4, D)))
+                except Exception:
+                    pass
+            breached = False
+            deadline = time.time() + 10.0
+            while time.time() < deadline and not breached:
+                pull = c.telemetry_pull()
+                breach = pull["metrics"].get("srml_slo_breach") or {}
+                breached = any(
+                    s["value"] >= 1.0
+                    and s["labels"]["objective"] == "transform:error"
+                    for s in breach.get("samples", [])
+                )
+                time.sleep(0.05)
+            assert breached, "SLO breach gauge never crossed on the wire"
+            # Same-config fleet: every replica answers one fingerprint.
+            fp = pull["fingerprint"]
+        for port in ports[1:]:
+            with DataPlaneClient("127.0.0.1", port) as c:
+                assert c.telemetry_pull()["fingerprint"] == fp
+    finally:
+        for p in procs:
+            stop_daemon_worker(p)
